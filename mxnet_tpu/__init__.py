@@ -1,0 +1,20 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capabilities of
+Apache MXNet ~0.12 (reference at /root/reference), built on JAX/XLA/Pallas.
+
+Layer map (SURVEY §7): engine+storage collapse into XLA's async runtime;
+ops are a single registry of pure-JAX impls; imperative NDArray+autograd ride
+jax.vjp; Gluon hybridize / symbolic executors compile whole graphs with
+jax.jit over sharded meshes; KVStore modes are mesh collectives.
+"""
+__version__ = "0.12.0.tpu1"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import base
+from . import context
+from . import random
+from . import autograd
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray

@@ -1,0 +1,68 @@
+"""mxnet_tpu.ndarray — the imperative array API (reference python/mxnet/ndarray).
+
+Namespace is registry-generated: every registered op (and alias) appears as a
+module-level function; `_internal`-style underscore ops are included.  The
+same registry feeds mxnet_tpu.symbol, so the two frontends can never drift
+(the reference guarantees this via the shared C op registry).
+"""
+import sys as _sys
+
+from .ndarray import (NDArray, array, empty, zeros, ones, full, arange,
+                      concatenate, moveaxis, waitall, invoke, onehot_encode)
+from .utils import save, load
+from . import register as _register
+from . import random  # noqa: F401
+from . import linalg  # noqa: F401
+from . import sparse  # noqa: F401
+from .sparse import RowSparseNDArray, CSRNDArray, BaseSparseNDArray
+
+_register.attach_methods()
+
+_ns = _register.build_namespace()
+
+
+class _OpModule:
+    """Holder for generated ops (mx.nd.op / mx.nd._internal equivalents)."""
+
+    def __init__(self, entries):
+        self.__dict__.update(entries)
+
+
+op = _OpModule({k: v for k, v in _ns.items() if not k.startswith("_")})
+_internal = _OpModule({k: v for k, v in _ns.items() if k.startswith("_")})
+
+_mod = _sys.modules[__name__]
+for _name, _fn in _ns.items():
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _fn)
+
+# python-level helpers the reference exposes (handle scalar operands)
+def _scalar_aware(tensor_op, scalar_op, rscalar_op=None):
+    def fn(lhs, rhs, out=None):
+        if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+            return invoke(tensor_op, [lhs, rhs], {}, out=out)
+        if isinstance(lhs, NDArray):
+            return invoke(scalar_op, [lhs], {"scalar": float(rhs)}, out=out)
+        if isinstance(rhs, NDArray):
+            op = rscalar_op or scalar_op
+            return invoke(op, [rhs], {"scalar": float(lhs)}, out=out)
+        raise TypeError("at least one operand must be an NDArray")
+    return fn
+
+
+maximum = _scalar_aware("_maximum", "_maximum_scalar")
+minimum = _scalar_aware("_minimum", "_minimum_scalar")
+add = _scalar_aware("elemwise_add", "_plus_scalar")
+subtract = _scalar_aware("elemwise_sub", "_minus_scalar", "_rminus_scalar")
+multiply = _scalar_aware("elemwise_mul", "_mul_scalar")
+divide = _scalar_aware("elemwise_div", "_div_scalar", "_rdiv_scalar")
+power = _scalar_aware("power", "_power_scalar", "_rpow_scalar")
+modulo = _scalar_aware("mod", "_mod_scalar", "_rmod_scalar")
+equal = _scalar_aware("equal", "_equal_scalar")
+not_equal = _scalar_aware("not_equal", "_not_equal_scalar")
+greater = _scalar_aware("greater", "_greater_scalar", "_lesser_scalar")
+greater_equal = _scalar_aware("greater_equal", "_greater_equal_scalar", "_lesser_equal_scalar")
+lesser = _scalar_aware("lesser", "_lesser_scalar", "_greater_scalar")
+lesser_equal = _scalar_aware("lesser_equal", "_lesser_equal_scalar", "_greater_equal_scalar")
+true_divide = divide
+negative = _ns["negative"]

@@ -1,0 +1,31 @@
+"""mx.nd.linalg namespace (reference python/mxnet/ndarray/linalg.py)."""
+from .ndarray import invoke
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "sumlogdiag",
+           "syrk", "gelqf", "syevd", "extractdiag", "makediag"]
+
+
+def _fwd(opname):
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        from .ndarray import NDArray
+        inputs = [a for a in args if isinstance(a, NDArray)]
+        attrs = {k: v for k, v in kwargs.items() if v is not None}
+        return invoke(opname, inputs, attrs, out=out)
+    fn.__name__ = opname.replace("_linalg_", "")
+    return fn
+
+
+gemm = _fwd("_linalg_gemm")
+gemm2 = _fwd("_linalg_gemm2")
+potrf = _fwd("_linalg_potrf")
+potri = _fwd("_linalg_potri")
+trmm = _fwd("_linalg_trmm")
+trsm = _fwd("_linalg_trsm")
+sumlogdiag = _fwd("_linalg_sumlogdiag")
+syrk = _fwd("_linalg_syrk")
+gelqf = _fwd("_linalg_gelqf")
+syevd = _fwd("_linalg_syevd")
+extractdiag = _fwd("_linalg_extractdiag")
+makediag = _fwd("_linalg_makediag")

@@ -1,0 +1,604 @@
+"""NDArray: the imperative tensor, a façade over ``jax.Array``.
+
+Reference: include/mxnet/ndarray.h:79 + src/ndarray/ndarray.cc — an async
+tensor handle whose ops are pushed to the dependency engine, with
+WaitToRead/WaitToWrite sync (ndarray.h:340-359) and CopyFromTo (:511).
+
+TPU-native collapse (SURVEY §7 stage 1): JAX dispatch is already async —
+an op call returns immediately with a future-backed jax.Array, ordering is
+guaranteed by data dependence (exactly the reference engine's read/write var
+contract, enforced by XLA/runtime instead of ThreadedEngine), and
+``wait_to_read`` ≡ ``block_until_ready``.  Mutation (`+=`, slice assignment,
+optimizer updates) rebinds the handle's underlying buffer — the functional
+equivalent of engine write-vars; each NDArray is a mutable *handle* over
+immutable device buffers, so aliasing NDArrays (views) are snapshots, as in
+the reference where views share Chunks.
+
+Every operator routes through :func:`invoke`: unwrap → per-(op, attrs) jitted
+XLA kernel → wrap; when autograd is recording, the call goes through
+``jax.vjp`` and lands on the tape (see mxnet_tpu.autograd).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .. import autograd
+from .. import random as _random
+from ..ops import get_op
+from ..ops.registry import OpDef
+
+__all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
+           "concatenate", "moveaxis", "imdecode", "invoke", "waitall",
+           "onehot_encode"]
+
+_DEFAULT_DTYPE = _np.float32
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _wrap(jax_array, ctx=None):
+    nd = NDArray.__new__(NDArray)
+    nd._data = jax_array
+    nd._ctx = ctx
+    nd._tape_node = None
+    nd._tape_index = None
+    nd._grad = None
+    nd._grad_req = "write"
+    return nd
+
+
+def waitall():
+    """Block until all launched computation completes (engine WaitForAll)."""
+    import jax
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def _rebind_handle(target, result):
+    """Make `target` become `result` in place — the write-var discipline.
+
+    If `target` is itself an input of the node that produced `result`
+    (x += f(x), sliced assignment, out=x), the node would become its own
+    parent on the tape; snapshot the *old* value/linkage into a fresh handle
+    and swap it into the node's inputs, exactly like the reference's engine
+    versioning separates the read-var from the write-var
+    (src/engine/threaded_engine.cc:51-115).
+    """
+    import weakref
+    node = result._tape_node
+    if node is not None:
+        snap = None
+        for i, inp in enumerate(node.inputs):
+            if inp is target:
+                if snap is None:
+                    snap = _wrap(node.saved_inputs[i], target._ctx)
+                    snap._tape_node = target._tape_node
+                    snap._tape_index = target._tape_index
+                    snap._grad = target._grad
+                    snap._grad_req = target._grad_req
+                    if snap._tape_node is not None:
+                        # the old producer must now output the snapshot, not
+                        # the rebound handle, or its cotangent lookup would
+                        # read the *new* value's cotangent by object identity
+                        snap._tape_node.outputs[snap._tape_index] = \
+                            weakref.ref(snap)
+                node.inputs[i] = snap
+        node.outputs[result._tape_index] = weakref.ref(target)
+    target._data = result._data
+    target._tape_node = node
+    target._tape_index = result._tape_index
+    return target
+
+
+# ---------------------------------------------------------------------------
+# invoke — the imperative dispatch path (Imperative::Invoke analog)
+# ---------------------------------------------------------------------------
+
+def invoke(op, inputs, attrs=None, out=None):
+    import jax
+    opdef = op if isinstance(op, OpDef) else get_op(op)
+    attrs = dict(attrs or {})
+    if opdef.variable_inputs and opdef.key_var_num_args:
+        attrs.setdefault(opdef.key_var_num_args, len(inputs))
+    attrs = opdef.normalize(attrs)
+
+    ctx = None
+    for i in inputs:
+        if isinstance(i, NDArray):
+            ctx = i.context
+            break
+    if ctx is None:
+        cs = attrs.get("ctx")
+        if isinstance(cs, str) and "(" in cs:
+            dt, rest = cs.split("(", 1)
+            ctx = Context(dt, int(rest.rstrip(")")))
+        else:
+            ctx = current_context()
+
+    jax_ins = [i._data for i in inputs]
+    training = autograd.is_training()
+    kernel = opdef.jitted(attrs, training)
+
+    if opdef.stochastic:
+        key = _random.next_key()
+        primal = lambda *ins: kernel(key, *ins)  # noqa: E731
+    else:
+        primal = kernel
+
+    recording = autograd.is_recording() and autograd.any_traced(inputs)
+
+    if not inputs:
+        # creator ops: place on the requested context
+        with jax.default_device(ctx.jax_device()):
+            outs = primal()
+        vjp_fn = None
+    elif recording:
+        outs, raw_vjp = jax.vjp(primal, *jax_ins)
+        vjp_fn = lambda cots, _v=raw_vjp: _v(tuple(cots))  # noqa: E731
+    else:
+        outs = primal(*jax_ins)
+        vjp_fn = None
+
+    # write back mutated aux/weight state (functional mutation)
+    for in_idx, out_idx in opdef.mutate_aux.items():
+        if in_idx < len(inputs):
+            inputs[in_idx]._data = outs[out_idx]
+
+    nvis = opdef.num_visible_outputs
+    if callable(nvis):
+        nvis = nvis(attrs)
+    all_out_nds = [_wrap(o, ctx) for o in outs]
+
+    if recording:
+        autograd.record_op(opdef.name, vjp_fn, primal, list(inputs),
+                           all_out_nds, jax_ins)
+
+    if out is not None:
+        targets = out if isinstance(out, (list, tuple)) else [out]
+        for i, t in enumerate(targets[:nvis]):
+            _rebind_handle(t, all_out_nds[i])
+        return out
+    vis = all_out_nds[:nvis]
+    if nvis == 0:
+        return None
+    if nvis == 1:
+        return vis[0]
+    return vis
+
+
+# ---------------------------------------------------------------------------
+# NDArray
+# ---------------------------------------------------------------------------
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_tape_node", "_tape_index", "_grad",
+                 "_grad_req", "__weakref__")
+
+    def __init__(self, data, ctx=None, dtype=None):
+        import jax
+        if isinstance(data, NDArray):
+            data = data._data
+        dt = _np.dtype(dtype) if dtype is not None else None
+        arr = _np.asarray(data, dtype=dt) if not hasattr(data, "block_until_ready") else data
+        ctx = ctx or current_context()
+        self._data = jax.device_put(arr, ctx.jax_device())
+        self._ctx = ctx
+        self._tape_node = None
+        self._tape_index = None
+        self._grad = None
+        self._grad_req = "write"
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(int(d) for d in self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        if self._ctx is None:
+            dev = list(self._data.devices())[0]
+            plat = dev.platform
+            self._ctx = Context("cpu" if plat == "cpu" else "tpu" if plat == "tpu" else "gpu",
+                                dev.id)
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def T(self):
+        return invoke("transpose", [self], {})
+
+    @property
+    def grad(self):
+        return self._grad
+
+    # -- sync / conversion -------------------------------------------------
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def astype(self, dtype, copy=True):
+        dt = _np.dtype(dtype)
+        if not copy and dt == self.dtype:
+            return self
+        return invoke("Cast", [self], {"dtype": dt.name})
+
+    def copy(self):
+        return invoke("_copy", [self], {})
+
+    def copyto(self, other):
+        import jax
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, other.context.jax_device())
+            return other
+        if isinstance(other, Context):
+            return _wrap(jax.device_put(self._data, other.jax_device()), other)
+        raise TypeError("copyto does not support type " + str(type(other)))
+
+    def as_in_context(self, context):
+        if context == self.context:
+            return self
+        return self.copyto(context)
+
+    def detach(self):
+        out = _wrap(self._data, self._ctx)
+        return out
+
+    def to_dlpack_for_read(self):
+        return self._data.__dlpack__()
+
+    to_dlpack_for_write = to_dlpack_for_read
+
+    # -- autograd ----------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        jnp = _jnp()
+        self._grad = _wrap(jnp.zeros_like(self._data), self._ctx)
+        self._grad_req = grad_req
+        self._tape_node = None
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- printing ----------------------------------------------------------
+    def __repr__(self):
+        return "\n%s\n<NDArray %s @%s>" % (
+            str(self.asnumpy()), "x".join(map(str, self.shape)), self.context)
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple "
+                         "elements is ambiguous.")
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __index__(self):
+        return int(self.asscalar())
+
+    # -- indexing ----------------------------------------------------------
+    def _convert_key(self, key):
+        if isinstance(key, NDArray):
+            return key._data.astype("int32")
+        if isinstance(key, tuple):
+            return tuple(self._convert_key(k) if isinstance(k, NDArray) else k
+                         for k in key)
+        return key
+
+    def __getitem__(self, key):
+        key = self._convert_key(key)
+        if autograd.is_recording() and autograd.any_traced([self]):
+            # route through an op so slicing stays differentiable on tape
+            import jax
+            primal = lambda x: (x[key],)  # noqa: E731
+            outs, raw_vjp = jax.vjp(primal, self._data)
+            out = _wrap(outs[0], self._ctx)
+            autograd.record_op("getitem", lambda c, _v=raw_vjp: _v(tuple(c)),
+                               primal, [self], [out], [self._data])
+            return out
+        return _wrap(self._data[key], self._ctx)
+
+    def _basic_slice_attrs(self, key):
+        """Map a basic getitem key to _slice_assign begin/end/step attrs."""
+        if not isinstance(key, tuple):
+            key = (key,)
+        begin, end, step = [], [], []
+        for i, k in enumerate(key):
+            if isinstance(k, slice):
+                begin.append(k.start if k.start is not None else 0)
+                end.append(k.stop if k.stop is not None else self.shape[i])
+                step.append(k.step if k.step is not None else 1)
+            elif isinstance(k, int):
+                begin.append(k)
+                end.append(k + 1)
+                step.append(1)
+            else:
+                return None  # advanced indexing
+        return {"begin": tuple(begin), "end": tuple(end), "step": tuple(step)}
+
+    def __setitem__(self, key, value):
+        jnp = _jnp()
+        key = self._convert_key(key)
+        if autograd.is_recording() and autograd.any_traced(
+                [self] + ([value] if isinstance(value, NDArray) else [])):
+            attrs = self._basic_slice_attrs(key)
+            if attrs is not None:
+                if isinstance(value, NDArray):
+                    tgt_shape = self._data[
+                        tuple(slice(b, e, s) for b, e, s in
+                              zip(attrs["begin"], attrs["end"], attrs["step"]))].shape
+                    v = value
+                    if v.shape != tgt_shape:
+                        v = v.broadcast_to(tgt_shape)
+                    r = invoke("_slice_assign", [self, v], attrs)
+                else:
+                    r = invoke("_slice_assign_scalar", [self],
+                               {**attrs, "scalar": float(value)})
+                self._inplace(r)
+                return
+        if isinstance(value, NDArray):
+            v = value._data
+        elif isinstance(value, (_np.ndarray, list, tuple, int, float)):
+            v = jnp.asarray(value, dtype=self._data.dtype) \
+                if not _np.isscalar(value) else value
+        else:
+            v = value
+        self._data = self._data.at[key].set(v)
+
+    # -- arithmetic dunders -------------------------------------------------
+    def _binary(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return invoke(op, [a, b], {})
+        if isinstance(other, (int, float, _np.number, bool)):
+            return invoke(scalar_op, [self], {"scalar": float(other)})
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binary(o, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        if isinstance(o, (int, float, _np.number, bool)):
+            return invoke("_rminus_scalar", [self], {"scalar": float(o)})
+        return self._binary(o, "elemwise_sub", "_minus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elemwise_div", "_div_scalar")
+
+    __div__ = __truediv__
+
+    def __rtruediv__(self, o):
+        if isinstance(o, (int, float, _np.number, bool)):
+            return invoke("_rdiv_scalar", [self], {"scalar": float(o)})
+        return self._binary(o, "elemwise_div", "_div_scalar", reverse=True)
+
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, o):
+        return self._binary(o, "mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        if isinstance(o, (int, float, _np.number, bool)):
+            return invoke("_rmod_scalar", [self], {"scalar": float(o)})
+        return self._binary(o, "mod", "_mod_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "power", "_power_scalar")
+
+    def __rpow__(self, o):
+        if isinstance(o, (int, float, _np.number, bool)):
+            return invoke("_rpow_scalar", [self], {"scalar": float(o)})
+        return NotImplemented
+
+    def __neg__(self):
+        return invoke("negative", [self], {})
+
+    def __abs__(self):
+        return invoke("abs", [self], {})
+
+    def __eq__(self, o):
+        r = self._binary(o, "equal", "_equal_scalar")
+        return r
+
+    def __ne__(self, o):
+        return self._binary(o, "not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def _inplace(self, result):
+        return _rebind_handle(self, result)
+
+    def __iadd__(self, o):
+        return self._inplace(self.__add__(o))
+
+    def __isub__(self, o):
+        return self._inplace(self.__sub__(o))
+
+    def __imul__(self, o):
+        return self._inplace(self.__mul__(o))
+
+    def __itruediv__(self, o):
+        return self._inplace(self.__truediv__(o))
+
+    __idiv__ = __itruediv__
+
+    # -- common methods (the full autogenerated set is attached in
+    #    ndarray/__init__.py from the op registry) -------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return invoke("Reshape", [self], {"shape": tuple(shape)})
+
+    def reshape_like(self, other):
+        return invoke("reshape_like", [self, other], {})
+
+    def broadcast_to(self, shape):
+        cur, tgt = self.shape, tuple(shape)
+        if len(cur) < len(tgt):
+            pad = (1,) * (len(tgt) - len(cur))
+            me = self.reshape(pad + cur)
+        else:
+            me = self
+        return invoke("broadcast_to", [me], {"shape": tgt})
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+        return _sp.cast_storage(self, stype)
+
+
+def _creation_ctx(ctx):
+    return ctx if ctx is not None else current_context()
+
+
+# ---------------------------------------------------------------------------
+# creation functions
+# ---------------------------------------------------------------------------
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        dtype = dtype or source_array.dtype
+        out = source_array.astype(dtype)
+        return out.as_in_context(_creation_ctx(ctx))
+    if dtype is None:
+        dtype = source_array.dtype if isinstance(source_array, _np.ndarray) \
+            and source_array.dtype != _np.float64 else _DEFAULT_DTYPE
+    return NDArray(_np.asarray(source_array, dtype=_np.dtype(dtype)),
+                   ctx=_creation_ctx(ctx))
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype or _DEFAULT_DTYPE)
+
+
+def zeros(shape, ctx=None, dtype=None, stype=None, **kwargs):
+    if stype not in (None, "default"):
+        from . import sparse as _sp
+        return _sp.zeros(stype, shape, ctx=ctx, dtype=dtype)
+    if isinstance(shape, int):
+        shape = (shape,)
+    with _creation_ctx(ctx) as c:
+        return invoke("_zeros", [], {"shape": tuple(shape),
+                                     "dtype": _np.dtype(dtype or _DEFAULT_DTYPE).name})
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    with _creation_ctx(ctx) as c:
+        return invoke("_ones", [], {"shape": tuple(shape),
+                                    "dtype": _np.dtype(dtype or _DEFAULT_DTYPE).name})
+
+
+def full(shape, val, ctx=None, dtype=None, out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    with _creation_ctx(ctx) as c:
+        return invoke("_full", [], {"shape": tuple(shape), "value": float(val),
+                                    "dtype": _np.dtype(dtype or _DEFAULT_DTYPE).name},
+                      out=out)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    with _creation_ctx(ctx) as c:
+        return invoke("_arange", [], {"start": float(start),
+                                      "stop": None if stop is None else float(stop),
+                                      "step": float(step), "repeat": int(repeat),
+                                      "dtype": _np.dtype(dtype or _DEFAULT_DTYPE).name})
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return invoke("Concat", list(arrays), {"dim": axis})
+
+
+def moveaxis(tensor, source, destination):
+    axes = list(range(tensor.ndim))
+    axes.remove(source % tensor.ndim)
+    axes.insert(destination % tensor.ndim, source % tensor.ndim)
+    return invoke("transpose", [tensor], {"axes": tuple(axes)})
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    return invoke("one_hot", [indices], {"depth": depth}, out=out)
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3,
+             mean=None):
+    raise NotImplementedError("use mxnet_tpu.image.imdecode")

@@ -1,0 +1,98 @@
+"""mx.nd.random namespace (reference python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import invoke, NDArray
+
+__all__ = ["uniform", "normal", "randn", "poisson", "exponential", "gamma",
+           "multinomial", "negative_binomial", "generalized_negative_binomial",
+           "shuffle", "randint"]
+
+
+def _sample(op_scalar, op_tensor, params, shape, dtype, ctx, out, kwargs):
+    tensor_args = [p for p in params if isinstance(p, NDArray)]
+    if tensor_args:
+        attrs = {"shape": shape}
+        if dtype:
+            attrs["dtype"] = _np.dtype(dtype).name
+        return invoke(op_tensor, list(params), attrs, out=out)
+    attrs = dict(kwargs)
+    if shape is not None:
+        attrs["shape"] = shape if isinstance(shape, tuple) else (shape,)
+    if dtype:
+        attrs["dtype"] = _np.dtype(dtype).name
+    if ctx is not None:
+        attrs["ctx"] = str(ctx)
+    return invoke(op_scalar, [], attrs, out=out)
+
+
+def uniform(low=0, high=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    if isinstance(low, NDArray) or isinstance(high, NDArray):
+        return _sample(None, "_sample_uniform", [low, high], shape, dtype, ctx, out, {})
+    return _sample("_random_uniform", None, [], shape, dtype, ctx, out,
+                   {"low": float(low), "high": float(high)})
+
+
+def normal(loc=0, scale=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    if isinstance(loc, NDArray) or isinstance(scale, NDArray):
+        return _sample(None, "_sample_normal", [loc, scale], shape, dtype, ctx, out, {})
+    return _sample("_random_normal", None, [], shape, dtype, ctx, out,
+                   {"loc": float(loc), "scale": float(scale)})
+
+
+def randn(*shape, **kwargs):
+    loc = kwargs.pop("loc", 0)
+    scale = kwargs.pop("scale", 1)
+    dtype = kwargs.pop("dtype", None)
+    ctx = kwargs.pop("ctx", None)
+    return normal(loc, scale, shape=shape or None, dtype=dtype, ctx=ctx)
+
+
+def poisson(lam=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    if isinstance(lam, NDArray):
+        return _sample(None, "_sample_poisson", [lam], shape, dtype, ctx, out, {})
+    return _sample("_random_poisson", None, [], shape, dtype, ctx, out,
+                   {"lam": float(lam)})
+
+
+def exponential(scale=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    if isinstance(scale, NDArray):
+        inv = 1.0 / scale
+        return _sample(None, "_sample_exponential", [inv], shape, dtype, ctx, out, {})
+    return _sample("_random_exponential", None, [], shape, dtype, ctx, out,
+                   {"lam": 1.0 / float(scale)})
+
+
+def gamma(alpha=1, beta=1, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    if isinstance(alpha, NDArray) or isinstance(beta, NDArray):
+        return _sample(None, "_sample_gamma", [alpha, beta], shape, dtype, ctx, out, {})
+    return _sample("_random_gamma", None, [], shape, dtype, ctx, out,
+                   {"alpha": float(alpha), "beta": float(beta)})
+
+
+def negative_binomial(k=1, p=1, shape=None, dtype=None, ctx=None, out=None, **kw):
+    return _sample("_random_negative_binomial", None, [], shape, dtype, ctx, out,
+                   {"k": int(k), "p": float(p)})
+
+
+def generalized_negative_binomial(mu=1, alpha=1, shape=None, dtype=None,
+                                  ctx=None, out=None, **kw):
+    return _sample("_random_generalized_negative_binomial", None, [], shape,
+                   dtype, ctx, out, {"mu": float(mu), "alpha": float(alpha)})
+
+
+def multinomial(data, shape=None, get_prob=False, out=None, dtype="int32", **kw):
+    attrs = {"get_prob": get_prob, "dtype": dtype}
+    if shape is not None:
+        attrs["shape"] = shape if isinstance(shape, tuple) else (shape,)
+    return invoke("_sample_multinomial", [data], attrs, out=out)
+
+
+def shuffle(data, **kwargs):
+    return invoke("_shuffle", [data], {}, out=kwargs.get("out"))
+
+
+def randint(low, high, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    return _sample("_random_randint", None, [], shape, dtype, ctx, out,
+                   {"low": int(low), "high": int(high)})

@@ -1,0 +1,130 @@
+"""Autogeneration of ndarray op functions from the registry.
+
+Reference: python/mxnet/ndarray/register.py + _ctypes/ndarray.py, which
+generate python functions from the C op registry at import time.  Same idea,
+no ABI: each OpDef yields a function accepting positional/keyword NDArray
+inputs plus keyword params, with ``out=`` support.
+"""
+from __future__ import annotations
+
+from ..ops import list_ops, get_op
+from .ndarray import NDArray, invoke
+
+__all__ = ["make_op_func", "build_namespace"]
+
+
+def make_op_func(opdef, public_name):
+    input_names = opdef.input_names_spec or []
+
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        inputs = list(args)
+        # inputs passed by keyword (data=..., weight=...)
+        if input_names and any(k in kwargs for k in input_names):
+            by_name = {}
+            for k in list(kwargs):
+                if k in input_names and isinstance(kwargs[k], NDArray):
+                    by_name[k] = kwargs.pop(k)
+            merged = []
+            pos = iter(inputs)
+            for nm in input_names:
+                if nm in by_name:
+                    merged.append(by_name[nm])
+                else:
+                    nxt = next(pos, None)
+                    if nxt is None:
+                        break
+                    merged.append(nxt)
+            merged.extend(pos)
+            inputs = merged
+        # strip trailing Nones (optional inputs like bias with no_bias)
+        while inputs and inputs[-1] is None:
+            inputs.pop()
+        attrs = {k: v for k, v in kwargs.items() if v is not None}
+        return invoke(opdef, inputs, attrs, out=out)
+
+    fn.__name__ = public_name
+    fn.__doc__ = opdef.doc
+    return fn
+
+
+def build_namespace():
+    """Build {name: function} for every registered op name/alias."""
+    ns = {}
+    for name in list_ops():
+        ns[name] = make_op_func(get_op(name), name)
+    return ns
+
+
+# methods attached onto NDArray that simply forward to the op of the same
+# lowercase name (mirrors the reference's generated NDArray methods)
+_NDARRAY_METHODS = [
+    "sum", "mean", "prod", "nansum", "nanprod", "max", "min", "norm",
+    "argmax", "argmin", "abs", "sign", "round", "ceil", "floor", "trunc",
+    "rint", "fix", "square", "sqrt", "rsqrt", "cbrt", "rcbrt", "exp", "log",
+    "log10", "log2", "log1p", "expm1", "sin", "cos", "tan", "arcsin",
+    "arccos", "arctan", "sinh", "cosh", "tanh", "arcsinh", "arccosh",
+    "arctanh", "degrees", "radians", "sigmoid", "relu", "softmax",
+    "log_softmax", "flatten", "expand_dims", "squeeze", "tile", "repeat",
+    "pad", "swapaxes", "split", "slice", "slice_axis", "take", "one_hot",
+    "pick", "sort", "argsort", "topk", "clip", "transpose", "flip",
+    "reciprocal",
+]
+
+
+def attach_methods():
+    from . import ndarray as _mod
+
+    def make_method(opname):
+        op = get_op(opname)
+        param_order = [k for k in op.params if not k.startswith("_")]
+        in_names = op.input_names_spec or []
+
+        def method(self, *args, **kwargs):
+            out = kwargs.pop("out", None)
+            inputs = [self]
+            attrs = {}
+            pos_params = iter(param_order)
+            for a in args:
+                if isinstance(a, NDArray):
+                    inputs.append(a)
+                else:
+                    # positional non-tensor args map onto declared params in
+                    # schema order (x.sum(1) → axis=1, like the reference)
+                    try:
+                        attrs[next(pos_params)] = a
+                    except StopIteration:
+                        raise TypeError("%s: too many positional args" % opname)
+            for k, v in kwargs.items():
+                if v is None:
+                    continue
+                if isinstance(v, NDArray) or k in in_names:
+                    inputs.append(v)
+                else:
+                    attrs[k] = v
+            return invoke(op, inputs, attrs, out=out)
+        method.__name__ = opname
+        return method
+
+    for nm in _NDARRAY_METHODS:
+        if not hasattr(NDArray, nm):
+            try:
+                setattr(NDArray, nm, make_method(nm))
+            except Exception:
+                pass
+    # clip takes positional a_min/a_max in mxnet
+    def clip_method(self, a_min=None, a_max=None, out=None):
+        return invoke("clip", [self], {"a_min": float(a_min), "a_max": float(a_max)},
+                      out=out)
+    NDArray.clip = clip_method
+
+    def transpose_method(self, axes=None):
+        return invoke("transpose", [self], {"axes": tuple(axes) if axes else ()})
+    NDArray.transpose = transpose_method
+
+    def dot_method(self, other, transpose_a=False, transpose_b=False):
+        return invoke("dot", [self, other], {"transpose_a": transpose_a,
+                                             "transpose_b": transpose_b})
+    NDArray.dot = dot_method
+    NDArray.__matmul__ = lambda self, other: invoke("dot", [self, other], {})

@@ -1,0 +1,312 @@
+"""Sparse NDArray: RowSparse + CSR storage types.
+
+Reference: include/mxnet/ndarray.h:206-311 (kRowSparseStorage/kCSRStorage
+with aux_data), src/operator/tensor/cast_storage.cc, dot.cc sparse paths,
+python/mxnet/ndarray/sparse.py.
+
+TPU reality (SURVEY §7 "hard parts" (b)): XLA has no sparse tensors; the MXU
+wants dense tiles.  So sparse storage here is *compressed host-of-device
+representation* — indices/values kept as dense jax arrays (static shapes),
+with ops implemented as gather/scatter XLA programs; `dot(csr, dense)` and
+row_sparse optimizer updates stay O(nnz) via segment-sum, everything else
+falls back to dense (the reference does the same through its storage-fallback
+executor, src/executor/attach_op_execs_pass.cc:49).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, _wrap, array as _dense_array, invoke
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "zeros", "empty", "array",
+           "cast_storage", "retain", "dot"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base; behaves as an NDArray whose dense view is materialized
+    lazily (``_data`` holds the dense buffer once needed)."""
+    __slots__ = ("_aux", "_shape", "_stype")
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._aux["data"]._data.dtype)
+
+    @property
+    def context(self):
+        return self._aux["data"].context
+
+    def _dense(self):
+        raise NotImplementedError
+
+    @property
+    def _data(self):
+        return self._dense()._data
+
+    @_data.setter
+    def _data(self, v):
+        # dense write-back converts the handle to dense storage semantics
+        raise MXNetError("cannot assign dense data to %s storage; use "
+                         "tostype('default')" % self._stype)
+
+    def asnumpy(self):
+        return self._dense().asnumpy()
+
+    def tostype(self, stype):
+        if stype == self._stype:
+            return self
+        if stype == "default":
+            return self._dense()
+        return cast_storage(self._dense(), stype)
+
+    def astype(self, dtype, copy=True):
+        aux = {k: v for k, v in self._aux.items()}
+        aux["data"] = aux["data"].astype(dtype)
+        return self.__class__._from_aux(aux, self._shape)
+
+    def copyto(self, other):
+        return self._dense().copyto(other)
+
+    def wait_to_read(self):
+        for v in self._aux.values():
+            v.wait_to_read()
+
+    def __repr__(self):
+        return "\n<%s %s @nnz-storage>" % (type(self).__name__,
+                                           "x".join(map(str, self._shape)))
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows `indices[i]` hold `values[i]`; all other rows are zero."""
+    __slots__ = ()
+
+    @classmethod
+    def _from_aux(cls, aux, shape):
+        nd = cls.__new__(cls)
+        nd._aux = aux
+        nd._shape = tuple(shape)
+        nd._stype = "row_sparse"
+        nd._ctx = aux["data"]._ctx
+        nd._tape_node = None
+        nd._tape_index = None
+        nd._grad = None
+        nd._grad_req = "write"
+        return nd
+
+    @property
+    def indices(self):
+        return self._aux["indices"]
+
+    @property
+    def data(self):
+        return self._aux["data"]
+
+    def _dense(self):
+        jnp = _jnp()
+        out = jnp.zeros(self._shape, dtype=self.data._data.dtype)
+        idx = self.indices._data.astype("int32")
+        out = out.at[idx].add(self.data._data)
+        return _wrap(out, self.context)
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Standard CSR: indptr (n_rows+1), indices (nnz), data (nnz)."""
+    __slots__ = ()
+
+    @classmethod
+    def _from_aux(cls, aux, shape):
+        nd = cls.__new__(cls)
+        nd._aux = aux
+        nd._shape = tuple(shape)
+        nd._stype = "csr"
+        nd._ctx = aux["data"]._ctx
+        nd._tape_node = None
+        nd._tape_index = None
+        nd._grad = None
+        nd._grad_req = "write"
+        return nd
+
+    @property
+    def indptr(self):
+        return self._aux["indptr"]
+
+    @property
+    def indices(self):
+        return self._aux["indices"]
+
+    @property
+    def data(self):
+        return self._aux["data"]
+
+    def _row_ids(self):
+        """nnz-length row id per value via searchsorted on indptr."""
+        jnp = _jnp()
+        nnz = self.data._data.shape[0]
+        return jnp.searchsorted(self.indptr._data.astype("int32"),
+                                jnp.arange(nnz), side="right") - 1
+
+    def _dense(self):
+        jnp = _jnp()
+        out = jnp.zeros(self._shape, dtype=self.data._data.dtype)
+        rows = self._row_ids()
+        cols = self.indices._data.astype("int32")
+        out = out.at[rows, cols].add(self.data._data)
+        return _wrap(out, self.context)
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not isinstance(arg1[0], int):
+        data, indices = arg1
+        d = _dense_array(data, ctx=ctx, dtype=dtype)
+        i = _dense_array(indices, ctx=ctx, dtype="int64")
+        if shape is None:
+            nrows = int(_np.max(_np.asarray(i.asnumpy()), initial=-1)) + 1
+            shape = (nrows,) + d.shape[1:]
+        return RowSparseNDArray._from_aux({"data": d, "indices": i}, shape)
+    if isinstance(arg1, NDArray):
+        return cast_storage(arg1, "row_sparse")
+    dense = _dense_array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        d = _dense_array(data, ctx=ctx, dtype=dtype)
+        i = _dense_array(indices, ctx=ctx, dtype="int64")
+        p = _dense_array(indptr, ctx=ctx, dtype="int64")
+        if shape is None:
+            ncols = int(_np.max(_np.asarray(i.asnumpy()), initial=-1)) + 1
+            shape = (p.shape[0] - 1, ncols)
+        return CSRNDArray._from_aux({"data": d, "indices": i, "indptr": p}, shape)
+    if isinstance(arg1, NDArray):
+        return cast_storage(arg1, "csr")
+    dense = _dense_array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    dtype = _np.dtype(dtype or _np.float32)
+    if stype == "row_sparse":
+        d = _dense_array(_np.zeros((0,) + tuple(shape[1:]), dtype), ctx=ctx, dtype=dtype)
+        i = _dense_array(_np.zeros((0,), "int64"), ctx=ctx, dtype="int64")
+        return RowSparseNDArray._from_aux({"data": d, "indices": i}, shape)
+    if stype == "csr":
+        d = _dense_array(_np.zeros((0,), dtype), ctx=ctx, dtype=dtype)
+        i = _dense_array(_np.zeros((0,), "int64"), ctx=ctx, dtype="int64")
+        p = _dense_array(_np.zeros((shape[0] + 1,), "int64"), ctx=ctx, dtype="int64")
+        return CSRNDArray._from_aux({"data": d, "indices": i, "indptr": p}, shape)
+    raise ValueError("unknown storage type " + stype)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array
+    try:
+        import scipy.sparse as sps
+        if sps.issparse(source_array):
+            csr = source_array.tocsr()
+            return csr_matrix((csr.data, csr.indices, csr.indptr),
+                              shape=csr.shape, ctx=ctx, dtype=dtype)
+    except ImportError:
+        pass
+    raise ValueError("use row_sparse_array/csr_matrix for dense sources")
+
+
+# ---------------------------------------------------------------------------
+# storage casts + sparse-aware kernels (host-side compression for layout,
+# device-side math)
+# ---------------------------------------------------------------------------
+
+def cast_storage(arr, stype):
+    if stype == "default":
+        return arr.tostype("default") if isinstance(arr, BaseSparseNDArray) else arr
+    a = arr.asnumpy()
+    if stype == "row_sparse":
+        nz = _np.where(_np.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+        return row_sparse_array((a[nz], nz.astype("int64")), shape=a.shape,
+                                ctx=arr.context)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise MXNetError("csr requires 2D")
+        indptr = [0]
+        indices = []
+        data = []
+        for r in range(a.shape[0]):
+            cols = _np.where(a[r] != 0)[0]
+            indices.extend(cols.tolist())
+            data.extend(a[r, cols].tolist())
+            indptr.append(len(indices))
+        return csr_matrix((_np.asarray(data, a.dtype),
+                           _np.asarray(indices, "int64"),
+                           _np.asarray(indptr, "int64")), shape=a.shape,
+                          ctx=arr.context)
+    raise ValueError("unknown storage type " + stype)
+
+
+def retain(data, indices):
+    """_sparse_retain: keep only the given rows of a RowSparseNDArray."""
+    jnp = _jnp()
+    if not isinstance(data, RowSparseNDArray):
+        raise MXNetError("retain expects row_sparse input")
+    want = indices._data.astype("int64") if isinstance(indices, NDArray) \
+        else _jnp().asarray(_np.asarray(indices, "int64"))
+    have = data.indices._data
+    # positions of wanted rows in the stored rows (-1 if absent)
+    eq = have[None, :] == want[:, None]
+    pos = jnp.argmax(eq, axis=1)
+    found = jnp.any(eq, axis=1)
+    vals = data.data._data[pos] * found.reshape((-1,) + (1,) * (data.data._data.ndim - 1)).astype(data.data._data.dtype)
+    return RowSparseNDArray._from_aux(
+        {"data": _wrap(vals, data.context),
+         "indices": _wrap(want, data.context)}, data.shape)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot: csr×dense via segment-sum (O(nnz) FLOPs)."""
+    jnp = _jnp()
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        rows = lhs._row_ids()
+        cols = lhs.indices._data.astype("int32")
+        vals = lhs.data._data
+        if transpose_a:
+            # out[c, :] += v * rhs[r, :]
+            contrib = vals[:, None] * rhs._data[rows]
+            out = jnp.zeros((lhs.shape[1], rhs.shape[1]), vals.dtype)
+            out = out.at[cols].add(contrib)
+        else:
+            contrib = vals[:, None] * rhs._data[cols]
+            out = jnp.zeros((lhs.shape[0], rhs.shape[1]), vals.dtype)
+            out = out.at[rows].add(contrib)
+        return _wrap(out, rhs.context)
+    lhs_d = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+    rhs_d = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+    return invoke("dot", [lhs_d, rhs_d], {"transpose_a": transpose_a,
+                                          "transpose_b": transpose_b})

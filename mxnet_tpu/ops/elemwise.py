@@ -1,0 +1,263 @@
+"""Elementwise ops: unary math, binary (elemwise/broadcast/scalar), logic.
+
+Reference: src/operator/tensor/elemwise_unary_op_basic.cc, _trig.cc,
+elemwise_binary_op_basic.cc, elemwise_binary_broadcast_op_{basic,extended,
+logic}.cc, elemwise_binary_scalar_op_{basic,extended,logic}.cc.
+
+The reference registers each family three ways (same-shape elemwise_*,
+broadcasting broadcast_*, and scalar _*_scalar) with hand-written mshadow
+kernels and per-op backward twins.  Here every variant lowers to the same
+jax.numpy primitive (XLA fuses elementwise chains into neighbouring matmuls,
+so per-op kernels would be a pessimization on TPU); gradients come from JAX
+AD, so no _backward_* registrations exist.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register, P
+
+
+# ---------------------------------------------------------------------------
+# Unary math
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "reciprocal": lambda x: 1.0 / x,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,  # round-toward-zero (jnp.fix deprecated in jax 0.9)
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "gammaln": lambda x: jax.scipy.special.gammaln(x),
+    "erf": lambda x: jax.scipy.special.erf(x),
+    "erfinv": lambda x: jax.scipy.special.erfinv(x),
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "negative": jnp.negative,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+for _name, _fn in _UNARY.items():
+    register(_name)(lambda attrs, x, _fn=_fn: _fn(x))
+
+@register("gamma")
+def gamma_fn(attrs, x):
+    # exp(gammaln) gives |Γ(x)|; restore sign for x<0 via the reflection
+    # identity sign(Γ(x)) = sign(sin(πx)) there (Γ(1-x) > 0 for x < 0).
+    mag = jnp.exp(jax.scipy.special.gammaln(x))
+    sign = jnp.where(x > 0, jnp.ones_like(x), jnp.sign(jnp.sin(jnp.pi * x)))
+    return sign * mag
+
+
+@register("_copy", aliases=["identity"])
+def _copy(attrs, x):
+    return x
+
+
+@register("BlockGrad", aliases=["stop_gradient", "block_grad"])
+def block_grad(attrs, x):
+    return jax.lax.stop_gradient(x)
+
+
+@register("make_loss", params={"grad_scale": P(float, 1.0)})
+def make_loss_op(attrs, x):
+    # identity forward; backward seeds ones*grad_scale (handled by executor
+    # treating make_loss outputs as loss heads; the scale folds in here).
+    return x
+
+
+@register("smooth_l1", params={"scalar": P(float, 1.0)})
+def smooth_l1(attrs, x):
+    s2 = attrs["scalar"] ** 2
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * jnp.square(x), absx - 0.5 / s2)
+
+
+@register("Cast", aliases=["cast"], params={"dtype": P(str)})
+def cast(attrs, x):
+    import numpy as np
+    return x.astype(np.dtype(attrs["dtype"]))
+
+
+@register("clip", params={"a_min": P(float), "a_max": P(float)})
+def clip(attrs, x):
+    return jnp.clip(x, attrs["a_min"], attrs["a_max"])
+
+
+# ---------------------------------------------------------------------------
+# Binary: elemwise_* (same shape), broadcast_* — both lower to jnp broadcasting
+# ---------------------------------------------------------------------------
+
+def _floor_div_grad_safe_mod(lhs, rhs):
+    return jnp.where(rhs == 0, jnp.zeros_like(lhs), lhs - jnp.floor(lhs / jnp.where(rhs == 0, 1, rhs)) * rhs)
+
+
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": _floor_div_grad_safe_mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+}
+
+_BINARY_LOGIC = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less,
+    "lesser_equal": jnp.less_equal,
+    "logical_and": lambda a, b: jnp.logical_and(a != 0, b != 0),
+    "logical_or": lambda a, b: jnp.logical_or(a != 0, b != 0),
+    "logical_xor": lambda a, b: jnp.logical_xor(a != 0, b != 0),
+}
+
+_ELEMWISE_NAME = {"add": "elemwise_add", "sub": "elemwise_sub",
+                  "mul": "elemwise_mul", "div": "elemwise_div"}
+_OLD_NAME = {"add": "_plus", "sub": "_minus", "mul": "_mul", "div": "_div",
+             "mod": "_mod", "power": "_power", "maximum": "_maximum",
+             "minimum": "_minimum", "hypot": "_hypot", "equal": "_equal",
+             "not_equal": "_not_equal", "greater": "_greater",
+             "greater_equal": "_greater_equal", "lesser": "_lesser",
+             "lesser_equal": "_lesser_equal"}
+
+for _name, _fn in {**_BINARY, **_BINARY_LOGIC}.items():
+    _logic = _name in _BINARY_LOGIC
+    if _logic:
+        def _impl(attrs, a, b, _fn=_fn):
+            return _fn(a, b).astype(a.dtype)
+    else:
+        def _impl(attrs, a, b, _fn=_fn):
+            return _fn(a, b)
+    primary = "_" + _name if _name in _BINARY else _name
+    aliases = ["broadcast_" + _name]
+    if primary != _name:
+        aliases.append(_name)  # bare name (power, mod, maximum, ...)
+    if _name in _ELEMWISE_NAME:
+        aliases.append(_ELEMWISE_NAME[_name])
+    if _name in _OLD_NAME and _OLD_NAME[_name] != primary:
+        aliases.append(_OLD_NAME[_name])
+    register(primary, aliases=aliases, nin=2,
+             input_names=["lhs", "rhs"])(_impl)
+
+# primary broadcast names referencing the same impls already aliased above;
+# also expose elemwise power alias `_power` handled above.
+
+
+# ---------------------------------------------------------------------------
+# Scalar forms: _plus_scalar etc. (+ reversed)
+# ---------------------------------------------------------------------------
+
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: _floor_div_grad_safe_mod(x, jnp.full_like(x, s)),
+    "_rmod_scalar": lambda x, s: _floor_div_grad_safe_mod(jnp.full_like(x, s), x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpow_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+    "_logical_and_scalar": lambda x, s: (jnp.logical_and(x != 0, s != 0)).astype(x.dtype),
+    "_logical_or_scalar": lambda x, s: (jnp.logical_or(x != 0, s != 0)).astype(x.dtype),
+    "_logical_xor_scalar": lambda x, s: (jnp.logical_xor(x != 0, s != 0)).astype(x.dtype),
+}
+
+for _name, _fn in _SCALAR.items():
+    register(_name, params={"scalar": P(float, 0.0)})(
+        lambda attrs, x, _fn=_fn: _fn(x, attrs["scalar"]))
+
+
+@register("_scatter_plus_scalar", params={"scalar": P(float, 0.0)})
+def _scatter_plus_scalar(attrs, x):
+    return x + attrs["scalar"]
+
+
+@register("_scatter_minus_scalar", params={"scalar": P(float, 0.0)})
+def _scatter_minus_scalar(attrs, x):
+    return x - attrs["scalar"]
+
+
+@register("_scatter_elemwise_div", nin=2, input_names=["lhs", "rhs"])
+def _scatter_elemwise_div(attrs, a, b):
+    return a / b
+
+
+# ---------------------------------------------------------------------------
+# N-ary
+# ---------------------------------------------------------------------------
+
+@register("add_n", aliases=["ElementWiseSum", "element_wise_sum"],
+          variable_inputs=True, key_var_num_args="num_args",
+          params={"num_args": P(int, 0)})
+def add_n(attrs, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register("_identity_with_attr_like_rhs", nin=2, input_names=["lhs", "rhs"])
+def _identity_with_attr_like_rhs(attrs, lhs, rhs):
+    return lhs
+
+
+@register("LeakyReLU", aliases=["leaky_relu"],
+          params={"act_type": P(str, "leaky", choices=["elu", "leaky", "prelu",
+                                                       "rrelu", "selu"]),
+                  "slope": P(float, 0.25),
+                  "lower_bound": P(float, 0.125),
+                  "upper_bound": P(float, 0.334)},
+          nin=1)
+def leaky_relu(attrs, x, gamma=None):
+    t = attrs["act_type"]
+    if t == "leaky":
+        return jnp.where(x > 0, x, attrs["slope"] * x)
+    if t == "elu":
+        return jnp.where(x > 0, x, attrs["slope"] * jnp.expm1(x))
+    if t == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+    if t == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (x.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(x > 0, x, g * x)
+    if t == "rrelu":
+        # eval-mode deterministic slope (mean of bounds); train-mode random
+        # slope handled by Dropout-style rng threading in later revision.
+        slope = (attrs["lower_bound"] + attrs["upper_bound"]) / 2.0
+        return jnp.where(x > 0, x, slope * x)
+    raise ValueError(t)

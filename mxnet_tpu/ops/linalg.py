@@ -1,0 +1,168 @@
+"""Linear-algebra ops: dot/batch_dot + the _linalg_* family.
+
+Reference: src/operator/tensor/dot.cc:31,97 and la_op.cc:36-554 (gemm, gemm2,
+potrf, potri, trmm, trsm, sumlogdiag, syrk, gelqf, syevd backed by
+cuBLAS/LAPACK).  Here they lower to XLA dot_general (→ MXU) and
+jax.lax.linalg decompositions.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, P
+
+
+@register("dot", nin=2, input_names=["lhs", "rhs"],
+          params={"transpose_a": P(bool, False), "transpose_b": P(bool, False),
+                  "forward_stype": P("str_or_none", None)})
+def dot(attrs, a, b):
+    if attrs["transpose_a"]:
+        a = jnp.moveaxis(a, 0, -1) if a.ndim > 2 else a.T
+    if attrs["transpose_b"]:
+        b = jnp.moveaxis(b, -1, 0) if b.ndim > 2 else b.T
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b, preferred_element_type=a.dtype)
+    # MXNet dot contracts last axis of a with first axis of b (tensordot)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0])).astype(a.dtype)
+
+
+@register("batch_dot", nin=2, input_names=["lhs", "rhs"],
+          params={"transpose_a": P(bool, False), "transpose_b": P(bool, False),
+                  "forward_stype": P("str_or_none", None)})
+def batch_dot(attrs, a, b):
+    if attrs["transpose_a"]:
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs["transpose_b"]:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b, preferred_element_type=a.dtype)
+
+
+def _tri_args(attrs):
+    return {"lower": not attrs.get("rightside", False)}
+
+
+_LA = {"transpose": P(bool, False), "rightside": P(bool, False),
+       "alpha": P(float, 1.0), "lower": P(bool, True)}
+
+
+@register("_linalg_gemm", aliases=["linalg_gemm"], nin=3,
+          input_names=["A", "B", "C"],
+          params={"transpose_a": P(bool, False), "transpose_b": P(bool, False),
+                  "alpha": P(float, 1.0), "beta": P(float, 1.0),
+                  "axis": P(int, -2)})
+def linalg_gemm(attrs, a, b, c):
+    if attrs["transpose_a"]:
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs["transpose_b"]:
+        b = jnp.swapaxes(b, -1, -2)
+    return attrs["alpha"] * jnp.matmul(a, b) + attrs["beta"] * c
+
+
+@register("_linalg_gemm2", aliases=["linalg_gemm2"], nin=2,
+          input_names=["A", "B"],
+          params={"transpose_a": P(bool, False), "transpose_b": P(bool, False),
+                  "alpha": P(float, 1.0), "axis": P(int, -2)})
+def linalg_gemm2(attrs, a, b):
+    if attrs["transpose_a"]:
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs["transpose_b"]:
+        b = jnp.swapaxes(b, -1, -2)
+    return attrs["alpha"] * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf", aliases=["linalg_potrf"])
+def linalg_potrf(attrs, a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("_linalg_potri", aliases=["linalg_potri"])
+def linalg_potri(attrs, a):
+    # input is cholesky factor L; A^-1 = (L L^T)^-1
+    n = a.shape[-1]
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=a.dtype), a.shape)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("_linalg_trmm", aliases=["linalg_trmm"], nin=2, input_names=["A", "B"],
+          params=_LA)
+def linalg_trmm(attrs, a, b):
+    tri = jnp.tril(a) if attrs["lower"] else jnp.triu(a)
+    if attrs["transpose"]:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = jnp.matmul(b, tri) if attrs["rightside"] else jnp.matmul(tri, b)
+    return attrs["alpha"] * out
+
+
+@register("_linalg_trsm", aliases=["linalg_trsm"], nin=2, input_names=["A", "B"],
+          params=_LA)
+def linalg_trsm(attrs, a, b):
+    if attrs["rightside"]:
+        # solve X A = alpha B  →  A^T X^T = alpha B^T
+        xt = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(b, -1, -2),
+            lower=not attrs["lower"] if attrs["transpose"] else not attrs["lower"],
+            trans=0)
+        x = jnp.swapaxes(xt, -1, -2)
+    else:
+        x = jax.scipy.linalg.solve_triangular(
+            a, b, lower=attrs["lower"], trans=1 if attrs["transpose"] else 0)
+    return attrs["alpha"] * x
+
+
+@register("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"])
+def linalg_sumlogdiag(attrs, a):
+    d = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("_linalg_syrk", aliases=["linalg_syrk"],
+          params={"transpose": P(bool, False), "alpha": P(float, 1.0)})
+def linalg_syrk(attrs, a):
+    at = jnp.swapaxes(a, -1, -2)
+    out = jnp.matmul(at, a) if attrs["transpose"] else jnp.matmul(a, at)
+    return attrs["alpha"] * out
+
+
+@register("_linalg_gelqf", aliases=["linalg_gelqf"], nout=2)
+def linalg_gelqf(attrs, a):
+    # LQ decomposition: A = L Q with Q orthonormal rows
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode="reduced")
+    l = jnp.swapaxes(r, -1, -2)
+    qout = jnp.swapaxes(q, -1, -2)
+    # sign fix: diagonal of L non-negative
+    d = jnp.sign(jnp.diagonal(l, axis1=-2, axis2=-1))
+    d = jnp.where(d == 0, 1.0, d)
+    l = l * d[..., None, :]
+    qout = qout * d[..., :, None]
+    return l, qout
+
+
+@register("_linalg_syevd", aliases=["linalg_syevd"], nout=2)
+def linalg_syevd(attrs, a):
+    w, v = jnp.linalg.eigh(a)
+    # reference returns (U, lambda) with rows of U the eigenvectors
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_extractdiag", aliases=["linalg_extractdiag"],
+          params={"offset": P(int, 0)})
+def linalg_extractdiag(attrs, a):
+    return jnp.diagonal(a, offset=attrs["offset"], axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", aliases=["linalg_makediag"],
+          params={"offset": P(int, 0)})
+def linalg_makediag(attrs, a):
+    return jax.vmap(jnp.diag)(a.reshape(-1, a.shape[-1])).reshape(
+        a.shape[:-1] + (a.shape[-1], a.shape[-1])) if a.ndim > 1 else jnp.diag(a)
+
+
+@register("khatri_rao", variable_inputs=True, key_var_num_args="num_args",
+          params={"num_args": P(int, 0)})
+def khatri_rao(attrs, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, x).reshape(
+            (-1,) + out.shape[1:])
+    return out

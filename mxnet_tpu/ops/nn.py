@@ -1,0 +1,767 @@
+"""Neural-network layer ops.
+
+Reference: src/operator/nn/ (fully_connected-inl.h:69, convolution-inl.h,
+pooling-inl.h, batch_norm.cc:408, softmax, dropout), src/operator/
+{softmax_output,regression_output,make_loss,l2_normalization,instance_norm,
+lrn,crop,sequence_*}-inl.h, tensor/indexing_op.cc:145 (Embedding).
+
+TPU-native notes:
+- Convolutions lower to ``lax.conv_general_dilated`` → MXU.  The user-facing
+  layout stays the reference's NCHW; XLA's layout assignment re-tiles for the
+  hardware, so no manual NHWC plumbing is needed.
+- BatchNorm / Dropout side effects (moving stats, masks) are functional:
+  extra outputs wired back by the caller (``mutate_aux``), PRNG keys are
+  explicit leading operands.
+- Loss heads (SoftmaxOutput, *RegressionOutput, MakeLoss) use jax.custom_vjp
+  to reproduce the reference semantics where ``backward()`` needs no head
+  gradient (the op defines its own dL/dx, ignoring incoming cotangents —
+  matching OperatorProperty backward that never sees out_grad).
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, P
+from ..base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+
+def _fc_fill(attrs, in_shapes):
+    data, w, b = (in_shapes + [None] * 3)[:3]
+    out = list(in_shapes)
+    if data is not None:
+        nh = attrs["num_hidden"]
+        in_dim = int(np.prod(data[1:])) if attrs.get("flatten", True) else data[-1]
+        if len(out) > 1 and out[1] is None:
+            out[1] = (nh, in_dim)
+        if len(out) > 2 and out[2] is None:
+            out[2] = (nh,)
+    return out
+
+
+@register("FullyConnected", aliases=["fully_connected"],
+          nin=lambda attrs: 2 if (attrs or {}).get("no_bias") else 3,
+          input_names=["data", "weight", "bias"],
+          fill_shapes=_fc_fill,
+          params={"num_hidden": P(int), "no_bias": P(bool, False),
+                  "flatten": P(bool, True)})
+def fully_connected(attrs, data, weight, bias=None):
+    if attrs["flatten"]:
+        x = data.reshape((data.shape[0], -1))
+    else:
+        x = data
+    out = jnp.dot(x, weight.T, preferred_element_type=x.dtype)
+    if bias is not None and not attrs["no_bias"]:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+def _conv_fill(attrs, in_shapes):
+    out = list(in_shapes)
+    data = out[0]
+    if data is not None:
+        k = attrs["kernel"]
+        nf = attrs["num_filter"]
+        ng = attrs.get("num_group", 1)
+        cin = data[1]
+        if len(out) > 1 and out[1] is None:
+            out[1] = (nf, cin // ng) + tuple(k)
+        if len(out) > 2 and out[2] is None:
+            out[2] = (nf,)
+    return out
+
+
+def _deconv_fill(attrs, in_shapes):
+    out = list(in_shapes)
+    data = out[0]
+    if data is not None:
+        k = attrs["kernel"]
+        nf = attrs["num_filter"]
+        ng = attrs.get("num_group", 1)
+        cin = data[1]
+        if len(out) > 1 and out[1] is None:
+            out[1] = (cin, nf // ng) + tuple(k)
+        if len(out) > 2 and out[2] is None:
+            out[2] = (nf,)
+    return out
+
+
+_CONV_PARAMS = {
+    "kernel": P("shape"), "stride": P("shape", ()), "dilate": P("shape", ()),
+    "pad": P("shape", ()), "num_filter": P(int), "num_group": P(int, 1),
+    "workspace": P(int, 1024), "no_bias": P(bool, False),
+    "cudnn_tune": P("str_or_none", None), "cudnn_off": P(bool, False),
+    "layout": P("str_or_none", None),
+}
+
+
+def _conv_dims(attrs, ndim):
+    nd = ndim - 2
+    k = tuple(attrs["kernel"])
+    stride = tuple(attrs["stride"]) or (1,) * nd
+    dilate = tuple(attrs["dilate"]) or (1,) * nd
+    pad = tuple(attrs["pad"]) or (0,) * nd
+    return k, stride, dilate, [(p, p) for p in pad]
+
+
+@register("Convolution", aliases=["convolution"],
+          nin=lambda attrs: 2 if (attrs or {}).get("no_bias") else 3,
+          input_names=["data", "weight", "bias"], fill_shapes=_conv_fill,
+          params=_CONV_PARAMS)
+def convolution(attrs, data, weight, bias=None):
+    _, stride, dilate, pad = _conv_dims(attrs, data.ndim)
+    nd = data.ndim - 2
+    # logical NCHW / NCDHW; lax dimension_numbers spell it explicitly
+    spec = "NC" + "DHW"[3 - nd:]
+    wspec = "OI" + "DHW"[3 - nd:]
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilate, feature_group_count=attrs["num_group"],
+        dimension_numbers=(spec, wspec, spec),
+        preferred_element_type=data.dtype)
+    if bias is not None and not attrs["no_bias"]:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution", aliases=["deconvolution"],
+          nin=lambda attrs: 2 if (attrs or {}).get("no_bias", True) else 3,
+          input_names=["data", "weight", "bias"], fill_shapes=_deconv_fill,
+          params={**_CONV_PARAMS, "adj": P("shape", ()),
+                  "target_shape": P("shape", ()), "no_bias": P(bool, True)})
+def deconvolution(attrs, data, weight, bias=None):
+    k, stride, dilate, pad = _conv_dims(attrs, data.ndim)
+    nd = data.ndim - 2
+    spec = "NC" + "DHW"[3 - nd:]
+    wspec = "IO" + "DHW"[3 - nd:]
+    # transposed conv = lhs-dilated conv (gradient of Convolution)
+    pads = []
+    for i in range(nd):
+        eff_k = (k[i] - 1) * dilate[i] + 1
+        p = pad[i][0]
+        adj = attrs["adj"][i] if attrs["adj"] else 0
+        pads.append((eff_k - 1 - p, eff_k - 1 - p + adj))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilate,
+        feature_group_count=attrs["num_group"],
+        dimension_numbers=(spec, wspec, spec),
+        preferred_element_type=data.dtype)
+    if bias is not None and not attrs["no_bias"]:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+@register("Pooling", aliases=["pooling"],
+          params={"kernel": P("shape", ()), "stride": P("shape", ()),
+                  "pad": P("shape", ()),
+                  "pool_type": P(str, "max", choices=["max", "avg", "sum"]),
+                  "global_pool": P(bool, False),
+                  "pooling_convention": P(str, "valid", choices=["valid", "full"]),
+                  "cudnn_off": P(bool, False)})
+def pooling(attrs, data):
+    nd = data.ndim - 2
+    if attrs["global_pool"]:
+        axes = tuple(range(2, data.ndim))
+        if attrs["pool_type"] == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        if attrs["pool_type"] == "sum":
+            return jnp.sum(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    k = tuple(attrs["kernel"])
+    stride = tuple(attrs["stride"]) or (1,) * nd
+    pad = tuple(attrs["pad"]) or (0,) * nd
+    window = (1, 1) + k
+    strides = (1, 1) + stride
+    pads = [(0, 0), (0, 0)]
+    for i in range(nd):
+        lo = hi = pad[i]
+        if attrs["pooling_convention"] == "full":
+            # ceil mode: add extra high padding so the last partial window counts
+            size = data.shape[2 + i] + 2 * pad[i]
+            rem = (size - k[i]) % stride[i]
+            if rem != 0:
+                hi += stride[i] - rem
+        pads.append((lo, hi))
+    pt = attrs["pool_type"]
+    if pt == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+                                 window, strides, pads)
+    summed = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+                               window, strides, pads)
+    if pt == "sum":
+        return summed
+    # avg: divide by window size counting padding (MXNet counts full window)
+    return summed / float(np.prod(k))
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm — functional with moving-stat writeback
+# ---------------------------------------------------------------------------
+
+_BN_PARAMS = {"eps": P(float, 1e-3), "momentum": P(float, 0.9),
+              "fix_gamma": P(bool, True), "use_global_stats": P(bool, False),
+              "output_mean_var": P(bool, False), "axis": P(int, 1),
+              "cudnn_off": P(bool, False)}
+
+
+def _bn_fill(attrs, in_shapes):
+    out = list(in_shapes)
+    data = out[0]
+    if data is not None:
+        c = data[attrs.get("axis", 1) % len(data)]
+        for i in range(1, 5):
+            if len(out) > i and out[i] is None:
+                out[i] = (c,)
+    return out
+
+
+def _batch_norm_impl(attrs, data, gamma, beta, mov_mean, mov_var):
+    ax = attrs["axis"] % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    training = attrs.get("_training", False) and not attrs["use_global_stats"]
+    if attrs["fix_gamma"]:
+        gamma = jnp.ones_like(gamma)
+    if training:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+        m = attrs["momentum"]
+        new_mean = m * mov_mean + (1 - m) * lax.stop_gradient(mean)
+        new_var = m * mov_var + (1 - m) * lax.stop_gradient(var)
+    else:
+        mean, var = mov_mean, mov_var
+        new_mean, new_var = mov_mean, mov_var
+    inv = lax.rsqrt(var.reshape(bshape) + attrs["eps"])
+    out = (data - mean.reshape(bshape)) * inv * gamma.reshape(bshape) \
+        + beta.reshape(bshape)
+    return out, mean, var, new_mean, new_var
+
+
+# Output-tuple convention (see OpDef): impl returns nout graph outputs first,
+# then one extra entry per mutate_aux target with index >= nout.  BatchNorm:
+# (out, batch_mean, batch_var, new_moving_mean, new_moving_var) — nout=3
+# graph outputs + 2 aux write-backs; imperative callers see `out` only,
+# or all three with output_mean_var=true (batch_norm.cc:408 semantics).
+register("BatchNorm", aliases=["batch_norm", "BatchNorm_v1", "batch_norm_v1"],
+         nin=5, input_names=["data", "gamma", "beta", "moving_mean", "moving_var"],
+         aux_inputs=(3, 4), nout=3,
+         num_visible_outputs=lambda attrs: 3 if (attrs or {}).get("output_mean_var") else 1,
+         mutate_aux={3: 3, 4: 4}, mode_dependent=True,
+         fill_shapes=_bn_fill, params=_BN_PARAMS)(_batch_norm_impl)
+
+
+@register("InstanceNorm", aliases=["instance_norm"],
+          nin=3, input_names=["data", "gamma", "beta"],
+          fill_shapes=lambda attrs, s: [s[0],
+                                        (s[0][1],) if s[0] and len(s) > 1 and s[1] is None else s[1],
+                                        (s[0][1],) if s[0] and len(s) > 2 and s[2] is None else s[2]],
+          params={"eps": P(float, 1e-3)})
+def instance_norm(attrs, data, gamma, beta):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    out = (data - mean) * lax.rsqrt(var + attrs["eps"])
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("LayerNorm", aliases=["layer_norm"],
+          nin=3, input_names=["data", "gamma", "beta"],
+          fill_shapes=lambda attrs, s: [s[0],
+                                        (s[0][attrs.get("axis", -1)],) if s[0] and len(s) > 1 and s[1] is None else s[1],
+                                        (s[0][attrs.get("axis", -1)],) if s[0] and len(s) > 2 and s[2] is None else s[2]],
+          params={"axis": P(int, -1), "eps": P(float, 1e-5),
+                  "output_mean_var": P(bool, False)})
+def layer_norm(attrs, data, gamma, beta):
+    ax = attrs["axis"]
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + attrs["eps"])
+    bshape = [1] * data.ndim
+    bshape[ax] = data.shape[ax]
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization", aliases=["l2_normalization"],
+          params={"eps": P(float, 1e-10),
+                  "mode": P(str, "instance", choices=["instance", "channel", "spatial"])})
+def l2_normalization(attrs, data):
+    mode = attrs["mode"]
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        red = (1,)
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+    n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + attrs["eps"])
+    return data / n
+
+
+@register("LRN", aliases=["lrn"],
+          params={"alpha": P(float, 1e-4), "beta": P(float, 0.75),
+                  "knorm": P(float, 2.0), "nsize": P(int)})
+def lrn(attrs, data):
+    n = attrs["nsize"]
+    sq = jnp.square(data)
+    # sum over channel window of size nsize centred at each channel (NCHW)
+    pad = n // 2
+    sq_pad = jnp.pad(sq, [(0, 0), (pad, pad)] + [(0, 0)] * (data.ndim - 2))
+    windows = sum(sq_pad[:, i:i + data.shape[1]] for i in range(n))
+    norm = jnp.power(attrs["knorm"] + attrs["alpha"] / n * windows, -attrs["beta"])
+    return data * norm
+
+
+# ---------------------------------------------------------------------------
+# Activations / softmax family
+# ---------------------------------------------------------------------------
+
+@register("Activation", aliases=["activation"],
+          params={"act_type": P(str, choices=["relu", "sigmoid", "tanh",
+                                              "softrelu", "softsign"])})
+def activation(attrs, x):
+    t = attrs["act_type"]
+    if t == "relu":
+        return jnp.maximum(x, 0)
+    if t == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if t == "tanh":
+        return jnp.tanh(x)
+    if t == "softrelu":
+        return jax.nn.softplus(x)
+    return jax.nn.soft_sign(x)
+
+
+@register("softmax", params={"axis": P(int, -1),
+                             "temperature": P("float_or_none", None)})
+def softmax_op(attrs, x):
+    t = attrs["temperature"]
+    if t:
+        x = x / t
+    return jax.nn.softmax(x, axis=attrs["axis"])
+
+
+@register("log_softmax", params={"axis": P(int, -1),
+                                 "temperature": P("float_or_none", None)})
+def log_softmax_op(attrs, x):
+    t = attrs["temperature"]
+    if t:
+        x = x / t
+    return jax.nn.log_softmax(x, axis=attrs["axis"])
+
+
+@register("SoftmaxActivation", aliases=["softmax_activation"],
+          params={"mode": P(str, "instance", choices=["instance", "channel"])})
+def softmax_activation(attrs, x):
+    axis = 1 if attrs["mode"] == "channel" else -1
+    if attrs["mode"] == "instance" and x.ndim > 2:
+        shp = x.shape
+        return jax.nn.softmax(x.reshape(shp[0], -1), axis=-1).reshape(shp)
+    return jax.nn.softmax(x, axis=axis)
+
+
+# -- SoftmaxOutput: loss head with implicit gradient ------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _softmax_output_fn(data, label, grad_scale, ignore_label, multi_output,
+                       use_ignore, normalization, smooth_alpha):
+    return _softmax_output_fwd_only(data, multi_output)
+
+
+def _softmax_output_fwd_only(data, multi_output):
+    if multi_output:
+        return jax.nn.softmax(data, axis=1)
+    if data.ndim > 2:
+        shp = data.shape
+        return jax.nn.softmax(data.reshape(shp[0], -1), axis=-1).reshape(shp)
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                        use_ignore, normalization, smooth_alpha):
+    out = _softmax_output_fwd_only(data, multi_output)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, multi_output, use_ignore,
+                        normalization, smooth_alpha, res, g):
+    # reference semantics (softmax_output-inl.h): dL/ddata = p - onehot(label),
+    # regardless of incoming cotangent g (backward() needs no head grad).
+    out, label = res
+    axis = 1 if multi_output else -1
+    k = out.shape[axis]
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, k, axis=axis, dtype=out.dtype)
+    if smooth_alpha:
+        onehot = onehot * (1 - smooth_alpha) + smooth_alpha / (k - 1) * (1 - onehot)
+    grad = out - onehot
+    valid = None
+    if use_ignore:
+        mask = (lab != int(ignore_label)).astype(out.dtype)
+        grad = grad * jnp.expand_dims(mask, axis)
+        valid = jnp.maximum(mask.sum(), 1.0)
+    if normalization == "batch":
+        grad = grad / out.shape[0]
+    elif normalization == "valid":
+        n = valid if valid is not None else float(np.prod(label.shape))
+        grad = grad / n
+    return (grad * grad_scale, jnp.zeros_like(label))
+
+
+_softmax_output_fn.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register("SoftmaxOutput", aliases=["softmax_output", "Softmax"],
+          nin=2, input_names=["data", "label"],
+          fill_shapes=lambda attrs, s: [s[0], (s[0][0],) if s[0] and len(s) > 1 and s[1] is None else s[1]],
+          params={"grad_scale": P(float, 1.0), "ignore_label": P(float, -1.0),
+                  "multi_output": P(bool, False), "use_ignore": P(bool, False),
+                  "preserve_shape": P(bool, False),
+                  "normalization": P(str, "null", choices=["null", "batch", "valid"]),
+                  "out_grad": P(bool, False), "smooth_alpha": P(float, 0.0)})
+def softmax_output(attrs, data, label):
+    return _softmax_output_fn(data, label, attrs["grad_scale"],
+                              attrs["ignore_label"], attrs["multi_output"],
+                              attrs["use_ignore"], attrs["normalization"],
+                              attrs["smooth_alpha"])
+
+
+# -- Regression heads -------------------------------------------------------
+
+def _make_regression_op(name, fwd, grad_fn):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def op(data, label, grad_scale):
+        return fwd(data)
+
+    def op_fwd(data, label, grad_scale):
+        out = fwd(data)
+        return out, (out, label)
+
+    def op_bwd(grad_scale, res, g):
+        out, label = res
+        num = float(np.prod(out.shape)) / out.shape[0]
+        grad = grad_fn(out, label.reshape(out.shape)) * grad_scale / num
+        return (grad, jnp.zeros_like(label))
+
+    op.defvjp(op_fwd, op_bwd)
+
+    @register(name, aliases=[_snake(name)], nin=2, input_names=["data", "label"],
+              fill_shapes=lambda attrs, s: [s[0], s[0] if s[0] and len(s) > 1 and s[1] is None else s[1]],
+              params={"grad_scale": P(float, 1.0)})
+    def impl(attrs, data, label, _op=op):
+        return _op(data, label, attrs["grad_scale"])
+    return impl
+
+
+def _snake(name):
+    out = []
+    for i, c in enumerate(name):
+        if c.isupper() and i > 0:
+            out.append("_")
+        out.append(c.lower())
+    return "".join(out)
+
+
+_make_regression_op("LinearRegressionOutput", lambda x: x,
+                    lambda out, lab: out - lab)
+_make_regression_op("LogisticRegressionOutput", jax.nn.sigmoid,
+                    lambda out, lab: out - lab)
+_make_regression_op("MAERegressionOutput", lambda x: x,
+                    lambda out, lab: jnp.sign(out - lab))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _svm_output_fn(data, label, margin, reg_coef):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg_coef):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg_coef, res, g):
+    data, label = res
+    lab = label.astype(jnp.int32)
+    k = data.shape[-1]
+    onehot = jax.nn.one_hot(lab, k, dtype=data.dtype)
+    correct = jnp.sum(data * onehot, axis=-1, keepdims=True)
+    violate = ((data - correct + margin) > 0).astype(data.dtype) * (1 - onehot)
+    grad = violate - onehot * violate.sum(axis=-1, keepdims=True)
+    return (grad * reg_coef, jnp.zeros_like(label))
+
+
+_svm_output_fn.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register("SVMOutput", aliases=["svm_output"], nin=2,
+          input_names=["data", "label"],
+          fill_shapes=lambda attrs, s: [s[0], (s[0][0],) if s[0] and len(s) > 1 and s[1] is None else s[1]],
+          params={"margin": P(float, 1.0), "regularization_coefficient": P(float, 1.0),
+                  "use_linear": P(bool, False)})
+def svm_output(attrs, data, label):
+    return _svm_output_fn(data, label, attrs["margin"],
+                          attrs["regularization_coefficient"])
+
+
+# -- MakeLoss (legacy layer op: forward data, backward grad_scale) ----------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _make_loss_fn(data, grad_scale, normalization):
+    return data
+
+
+def _make_loss_fwd(data, grad_scale, normalization):
+    return data, data.shape
+
+
+def _make_loss_bwd(grad_scale, normalization, shape, g):
+    scale = grad_scale
+    if normalization == "batch":
+        scale = scale / shape[0]
+    elif normalization == "valid":
+        scale = scale / float(np.prod(shape))
+    return (jnp.full(shape, scale),)
+
+
+_make_loss_fn.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@register("MakeLoss",
+          params={"grad_scale": P(float, 1.0),
+                  "valid_thresh": P(float, 0.0),
+                  "normalization": P(str, "null", choices=["null", "batch", "valid"])})
+def make_loss_layer(attrs, data):
+    return _make_loss_fn(data, attrs["grad_scale"], attrs["normalization"])
+
+
+# ---------------------------------------------------------------------------
+# Dropout — explicit PRNG operand
+# ---------------------------------------------------------------------------
+
+@register("Dropout", aliases=["dropout"], stochastic=True, mode_dependent=True,
+          params={"p": P(float, 0.5),
+                  "mode": P(str, "training", choices=["training", "always"]),
+                  "axes": P("shape", ())})
+def dropout(attrs, rng, x):
+    p = attrs["p"]
+    active = attrs.get("_training", False) or attrs["mode"] == "always"
+    if not active or p <= 0:
+        return x
+    shape = x.shape
+    if attrs["axes"]:
+        shape = tuple(1 if i in attrs["axes"] else s for i, s in enumerate(shape))
+    keep = jax.random.bernoulli(rng, 1.0 - p, shape).astype(x.dtype)
+    return x * keep / (1.0 - p)
+
+
+# ---------------------------------------------------------------------------
+# Embedding
+# ---------------------------------------------------------------------------
+
+@register("Embedding", aliases=["embedding", "_contrib_SparseEmbedding"],
+          nin=2, input_names=["data", "weight"],
+          fill_shapes=lambda attrs, s: [s[0],
+                                        (attrs["input_dim"], attrs["output_dim"]) if len(s) > 1 and s[1] is None else s[1]],
+          params={"input_dim": P(int), "output_dim": P(int),
+                  "dtype": P(str, "float32"), "sparse_grad": P(bool, False)})
+def embedding(attrs, data, weight):
+    idx = jnp.clip(data.astype(jnp.int32), 0, attrs["input_dim"] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# UpSampling / Crop
+# ---------------------------------------------------------------------------
+
+@register("UpSampling", aliases=["up_sampling"], variable_inputs=True,
+          key_var_num_args="num_args",
+          params={"scale": P(int), "num_filter": P(int, 0),
+                  "sample_type": P(str, "nearest", choices=["nearest", "bilinear"]),
+                  "multi_input_mode": P(str, "concat", choices=["concat", "sum"]),
+                  "num_args": P(int, 1), "workspace": P(int, 512)})
+def upsampling(attrs, *xs):
+    s = attrs["scale"]
+    outs = []
+    for x in xs:
+        if attrs["sample_type"] == "nearest":
+            y = jnp.repeat(jnp.repeat(x, s, axis=2), s, axis=3)
+        else:
+            n, c, h, w = x.shape
+            y = jax.image.resize(x, (n, c, h * s, w * s), method="bilinear")
+        outs.append(y)
+    if len(outs) == 1:
+        return outs[0]
+    if attrs["multi_input_mode"] == "sum":
+        return sum(outs)
+    return jnp.concatenate(outs, axis=1)
+
+
+@register("Crop", nin=lambda attrs: int((attrs or {}).get("num_args", 1)),
+          variable_inputs=True, key_var_num_args="num_args",
+          params={"num_args": P(int, 1), "offset": P("shape", (0, 0)),
+                  "h_w": P("shape", (0, 0)), "center_crop": P(bool, False)})
+def crop_layer(attrs, *xs):
+    x = xs[0]
+    if len(xs) == 2:
+        th, tw = xs[1].shape[2], xs[1].shape[3]
+    else:
+        th, tw = attrs["h_w"]
+    if attrs["center_crop"]:
+        oh = (x.shape[2] - th) // 2
+        ow = (x.shape[3] - tw) // 2
+    else:
+        oh, ow = attrs["offset"]
+    return x[:, :, oh:oh + th, ow:ow + tw]
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops (src/operator/sequence_*.cc)
+# ---------------------------------------------------------------------------
+
+def _seq_len_or_full(use_len, seq_len, x):
+    # data layout: (seq_len, batch, ...) per reference
+    if use_len and seq_len is not None:
+        return seq_len.astype(jnp.int32)
+    return jnp.full((x.shape[1],), x.shape[0], dtype=jnp.int32)
+
+
+@register("SequenceMask", aliases=["sequence_mask"],
+          nin=lambda attrs: 2 if (attrs or {}).get("use_sequence_length") else 1,
+          input_names=["data", "sequence_length"],
+          params={"use_sequence_length": P(bool, False), "value": P(float, 0.0),
+                  "axis": P(int, 0)})
+def sequence_mask(attrs, data, seq_len=None):
+    if not attrs["use_sequence_length"]:
+        return data
+    ax = attrs["axis"]  # time axis: 0 or 1
+    T = data.shape[ax]
+    steps = jnp.arange(T)
+    if ax == 0:
+        mask = steps[:, None] < seq_len.astype(jnp.int32)[None, :]
+    else:
+        mask = steps[None, :] < seq_len.astype(jnp.int32)[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(attrs["value"], data.dtype))
+
+
+@register("SequenceLast", aliases=["sequence_last"],
+          nin=lambda attrs: 2 if (attrs or {}).get("use_sequence_length") else 1,
+          input_names=["data", "sequence_length"],
+          params={"use_sequence_length": P(bool, False), "axis": P(int, 0)})
+def sequence_last(attrs, data, seq_len=None):
+    ax = attrs["axis"]
+    if not attrs["use_sequence_length"]:
+        return jnp.take(data, data.shape[ax] - 1, axis=ax)
+    idx = seq_len.astype(jnp.int32) - 1  # (batch,)
+    if ax == 0:
+        return jax.vmap(lambda d, i: d[i], in_axes=(1, 0))(data, idx)
+    return jax.vmap(lambda d, i: d[i])(data, idx)
+
+
+@register("SequenceReverse", aliases=["sequence_reverse"],
+          nin=lambda attrs: 2 if (attrs or {}).get("use_sequence_length") else 1,
+          input_names=["data", "sequence_length"],
+          params={"use_sequence_length": P(bool, False), "axis": P(int, 0)})
+def sequence_reverse(attrs, data, seq_len=None):
+    if not attrs["use_sequence_length"]:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    sl = seq_len.astype(jnp.int32)  # (batch,)
+    t = jnp.arange(T)[:, None]
+    src = jnp.where(t < sl[None, :], sl[None, :] - 1 - t, t)  # (T, batch)
+    return jnp.take_along_axis(
+        data, src.reshape(src.shape + (1,) * (data.ndim - 2)), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Spatial transformer family
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample(data, grid):
+    """data (N,C,H,W); grid (N,2,Ho,Wo) with x,y in [-1,1]."""
+    N, C, H, W = data.shape
+    gx = (grid[:, 0] + 1) * (W - 1) / 2.0
+    gy = (grid[:, 1] + 1) * (H - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yy, xx):
+        yy = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xx = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        flat = data.reshape(N, C, H * W)
+        idx = (yy * W + xx).reshape(N, 1, -1)
+        out = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (N, C, idx.shape[-1])), axis=2)
+        return out.reshape((N, C) + gx.shape[1:])
+
+    in_x = (gx >= 0) & (gx <= W - 1)
+    in_y = (gy >= 0) & (gy <= H - 1)
+    valid = (in_x & in_y).astype(data.dtype)[:, None]
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx = wx[:, None]
+    wy = wy[:, None]
+    out = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+           + v10 * (1 - wx) * wy + v11 * wx * wy)
+    return out * valid
+
+
+@register("BilinearSampler", aliases=["bilinear_sampler"], nin=2,
+          input_names=["data", "grid"])
+def bilinear_sampler(attrs, data, grid):
+    return _bilinear_sample(data, grid)
+
+
+@register("GridGenerator", aliases=["grid_generator"],
+          nin=1, input_names=["data"],
+          params={"transform_type": P(str, "affine", choices=["affine", "warp"]),
+                  "target_shape": P("shape", (0, 0))})
+def grid_generator(attrs, data):
+    if attrs["transform_type"] == "affine":
+        h, w = attrs["target_shape"]
+        theta = data.reshape(-1, 2, 3)
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, h*w)
+        out = jnp.einsum("nij,jk->nik", theta, coords)  # (n,2,h*w)
+        return out.reshape(-1, 2, h, w)
+    # warp: data is (n,2,h,w) flow field
+    n, _, h, w = data.shape
+    ys = jnp.linspace(-1, 1, h)
+    xs = jnp.linspace(-1, 1, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy])[None]
+    norm = jnp.array([2.0 / max(w - 1, 1), 2.0 / max(h - 1, 1)]).reshape(1, 2, 1, 1)
+    return base + data * norm
+
+
+@register("SpatialTransformer", aliases=["spatial_transformer"], nin=2,
+          input_names=["data", "loc"],
+          params={"target_shape": P("shape", (0, 0)),
+                  "transform_type": P(str, "affine"),
+                  "sampler_type": P(str, "bilinear"),
+                  "cudnn_off": P(bool, False)})
+def spatial_transformer(attrs, data, loc):
+    grid = grid_generator({"transform_type": "affine",
+                           "target_shape": attrs["target_shape"]}, loc)
+    return _bilinear_sample(data, grid)

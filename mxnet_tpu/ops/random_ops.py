@@ -1,0 +1,179 @@
+"""Random sampling ops.
+
+Reference: src/operator/random/{sample_op.cc,multisample_op.cc,
+sample_multinomial_op.cc}.  The reference seeds per-device PRNGs through the
+resource manager (src/resource.cc); here randomness is functional — every
+stochastic op takes an explicit leading PRNG-key operand threaded by the
+frontend (eager: a global split counter in mxnet_tpu.random; compiled: the
+executor folds a step counter into its key) so kernels stay pure and
+reproducible under jit.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register, P
+
+_DT = {"dtype": P("str_or_none", None), "ctx": P("str_or_none", None),
+       "shape": P("shape", ())}
+
+
+def _shape_dtype(attrs, default_dtype="float32"):
+    shape = attrs.get("shape") or ()
+    dt = attrs.get("dtype") or default_dtype
+    if dt == "None":
+        dt = default_dtype
+    return tuple(shape), np.dtype(dt)
+
+
+@register("_random_uniform", aliases=["uniform", "random_uniform"], nin=0,
+          stochastic=True, params={"low": P(float, 0.0), "high": P(float, 1.0), **_DT})
+def random_uniform(attrs, rng):
+    shape, dt = _shape_dtype(attrs)
+    return jax.random.uniform(rng, shape, dtype=dt,
+                              minval=attrs["low"], maxval=attrs["high"])
+
+
+@register("_random_normal", aliases=["normal", "random_normal"], nin=0,
+          stochastic=True, params={"loc": P(float, 0.0), "scale": P(float, 1.0), **_DT})
+def random_normal(attrs, rng):
+    shape, dt = _shape_dtype(attrs)
+    return attrs["loc"] + attrs["scale"] * jax.random.normal(rng, shape, dtype=dt)
+
+
+@register("_random_gamma", aliases=["random_gamma"], nin=0, stochastic=True,
+          params={"alpha": P(float, 1.0), "beta": P(float, 1.0), **_DT})
+def random_gamma(attrs, rng):
+    shape, dt = _shape_dtype(attrs)
+    return attrs["beta"] * jax.random.gamma(rng, attrs["alpha"], shape, dtype=dt)
+
+
+@register("_random_exponential", aliases=["random_exponential"], nin=0,
+          stochastic=True, params={"lam": P(float, 1.0), **_DT})
+def random_exponential(attrs, rng):
+    shape, dt = _shape_dtype(attrs)
+    return jax.random.exponential(rng, shape, dtype=dt) / attrs["lam"]
+
+
+@register("_random_poisson", aliases=["random_poisson"], nin=0, stochastic=True,
+          params={"lam": P(float, 1.0), **_DT})
+def random_poisson(attrs, rng):
+    shape, dt = _shape_dtype(attrs)
+    return jax.random.poisson(rng, attrs["lam"], shape).astype(dt)
+
+
+@register("_random_negative_binomial", aliases=["random_negative_binomial"],
+          nin=0, stochastic=True,
+          params={"k": P(int, 1), "p": P(float, 1.0), **_DT})
+def random_negative_binomial(attrs, rng):
+    shape, dt = _shape_dtype(attrs)
+    k1, k2 = jax.random.split(rng)
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    lam = jax.random.gamma(k1, attrs["k"], shape) * (1 - attrs["p"]) / attrs["p"]
+    return jax.random.poisson(k2, lam, shape).astype(dt)
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=["random_generalized_negative_binomial"], nin=0,
+          stochastic=True, params={"mu": P(float, 1.0), "alpha": P(float, 1.0), **_DT})
+def random_gen_negative_binomial(attrs, rng):
+    shape, dt = _shape_dtype(attrs)
+    mu, alpha = attrs["mu"], attrs["alpha"]
+    k1, k2 = jax.random.split(rng)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(k1, r, shape) * (mu * alpha)
+    return jax.random.poisson(k2, lam, shape).astype(dt)
+
+
+@register("_random_randint", aliases=["random_randint"], nin=0, stochastic=True,
+          params={"low": P(int, 0), "high": P(int, 1), **_DT})
+def random_randint(attrs, rng):
+    shape, _ = _shape_dtype(attrs, "int32")
+    return jax.random.randint(rng, shape, attrs["low"], attrs["high"])
+
+
+# -- per-element "sample" variants: params come from input tensors ----------
+
+def _broadcast_sample(sampler):
+    def impl(attrs, rng, *param_arrays):
+        shape = attrs.get("shape") or ()
+        full = param_arrays[0].shape + tuple(shape)
+        return sampler(rng, full, tuple(shape), *param_arrays)
+    return impl
+
+
+@register("_sample_uniform", aliases=["sample_uniform"], nin=2,
+          input_names=["low", "high"], stochastic=True, params=dict(_DT))
+def sample_uniform(attrs, rng, low, high):
+    shape = tuple(attrs.get("shape") or ())
+    full = low.shape + shape
+    ext = low.reshape(low.shape + (1,) * len(shape))
+    exth = high.reshape(high.shape + (1,) * len(shape))
+    u = jax.random.uniform(rng, full, dtype=low.dtype)
+    return ext + u * (exth - ext)
+
+
+@register("_sample_normal", aliases=["sample_normal"], nin=2,
+          input_names=["mu", "sigma"], stochastic=True, params=dict(_DT))
+def sample_normal(attrs, rng, mu, sigma):
+    shape = tuple(attrs.get("shape") or ())
+    full = mu.shape + shape
+    ext = mu.reshape(mu.shape + (1,) * len(shape))
+    exts = sigma.reshape(sigma.shape + (1,) * len(shape))
+    return ext + exts * jax.random.normal(rng, full, dtype=mu.dtype)
+
+
+@register("_sample_gamma", aliases=["sample_gamma"], nin=2,
+          input_names=["alpha", "beta"], stochastic=True, params=dict(_DT))
+def sample_gamma(attrs, rng, alpha, beta):
+    shape = tuple(attrs.get("shape") or ())
+    full = alpha.shape + shape
+    exta = alpha.reshape(alpha.shape + (1,) * len(shape))
+    extb = beta.reshape(beta.shape + (1,) * len(shape))
+    return extb * jax.random.gamma(rng, jnp.broadcast_to(exta, full), full,
+                                   dtype=alpha.dtype)
+
+
+@register("_sample_exponential", aliases=["sample_exponential"], nin=1,
+          input_names=["lam"], stochastic=True, params=dict(_DT))
+def sample_exponential(attrs, rng, lam):
+    shape = tuple(attrs.get("shape") or ())
+    full = lam.shape + shape
+    ext = lam.reshape(lam.shape + (1,) * len(shape))
+    return jax.random.exponential(rng, full, dtype=lam.dtype) / ext
+
+
+@register("_sample_poisson", aliases=["sample_poisson"], nin=1,
+          input_names=["lam"], stochastic=True, params=dict(_DT))
+def sample_poisson(attrs, rng, lam):
+    shape = tuple(attrs.get("shape") or ())
+    full = lam.shape + shape
+    ext = lam.reshape(lam.shape + (1,) * len(shape))
+    return jax.random.poisson(rng, jnp.broadcast_to(ext, full), full).astype(lam.dtype)
+
+
+@register("_sample_multinomial", aliases=["sample_multinomial"], nin=1,
+          input_names=["data"], stochastic=True,
+          nout=lambda attrs: 2 if (attrs or {}).get("get_prob") else 1,
+          params={"shape": P("shape", ()), "get_prob": P(bool, False),
+                  "dtype": P(str, "int32")})
+def sample_multinomial(attrs, rng, data):
+    # data: (..., k) probabilities
+    shape = tuple(attrs.get("shape") or ())
+    n = int(np.prod(shape)) if shape else 1
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    flat = logits.reshape(-1, logits.shape[-1])
+    keys = jax.random.split(rng, flat.shape[0])
+    samples = jax.vmap(lambda k, l: jax.random.categorical(k, l, shape=(n,)))(keys, flat)
+    out = samples.reshape(data.shape[:-1] + shape if shape else data.shape[:-1])
+    out = out.astype(np.dtype(attrs["dtype"]))
+    if attrs["get_prob"]:
+        # `flat` already holds log-probabilities
+        logp = jnp.take_along_axis(flat, samples, axis=1).reshape(out.shape)
+        return out, logp
+    return out
+
+
+@register("_shuffle", aliases=["shuffle"], stochastic=True)
+def shuffle(attrs, rng, data):
+    return jax.random.permutation(rng, data, axis=0)

@@ -1,0 +1,111 @@
+"""Reduction and argmin/argmax-family ops.
+
+Reference: src/operator/tensor/broadcast_reduce_op_{value,index}.cc.
+MXNet reduce semantics: ``axis`` may be int/tuple/None (None = all axes),
+``keepdims`` bool, ``exclude`` inverts the axis set.
+"""
+import jax.numpy as jnp
+
+from .registry import register, P
+
+_AXES = {"axis": P("shape_or_none", None), "keepdims": P(bool, False),
+         "exclude": P(bool, False)}
+
+
+def _norm_axes(attrs, ndim):
+    ax = attrs.get("axis")
+    if ax is None or ax == ():
+        axes = tuple(range(ndim))
+    elif isinstance(ax, int):
+        axes = (ax % ndim,)
+    else:
+        axes = tuple(a % ndim for a in ax)
+    if attrs.get("exclude"):
+        axes = tuple(i for i in range(ndim) if i not in axes)
+    return axes
+
+
+def _reduce(fn):
+    def impl(attrs, x):
+        axes = _norm_axes(attrs, x.ndim)
+        return fn(x, axis=axes, keepdims=attrs["keepdims"])
+    return impl
+
+
+for _name, _fn in {"sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+                   "nansum": jnp.nansum, "nanprod": jnp.nanprod,
+                   "max": jnp.max, "min": jnp.min}.items():
+    register(_name, aliases=["sum_axis"] if _name == "sum" else
+             (["max_axis"] if _name == "max" else
+              (["min_axis"] if _name == "min" else [])),
+             params=dict(_AXES))(_reduce(_fn))
+
+
+@register("norm", params={"ord": P(int, 2), "axis": P("shape_or_none", None),
+                          "keepdims": P(bool, False)})
+def norm(attrs, x):
+    ax = attrs["axis"]
+    if ax is not None and not isinstance(ax, int) and len(ax) == 1:
+        ax = ax[0]
+    if attrs["ord"] == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=attrs["keepdims"])
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=attrs["keepdims"]))
+
+
+def _arg_reduce(fn):
+    def impl(attrs, x):
+        ax = attrs.get("axis")
+        if ax is None:
+            r = fn(x.reshape(-1), axis=0)
+            out = r.astype(x.dtype)
+            return out
+        r = fn(x, axis=ax)
+        if attrs.get("keepdims"):
+            r = jnp.expand_dims(r, ax)
+        return r.astype(x.dtype)
+    return impl
+
+
+register("argmax", params={"axis": P("int_or_none", None),
+                           "keepdims": P(bool, False)})(_arg_reduce(jnp.argmax))
+register("argmin", params={"axis": P("int_or_none", None),
+                           "keepdims": P(bool, False)})(_arg_reduce(jnp.argmin))
+
+
+@register("argmax_channel")
+def argmax_channel(attrs, x):
+    return jnp.argmax(x, axis=1).astype(x.dtype)
+
+
+@register("pick", nin=2, input_names=["data", "index"],
+          params={"axis": P("int_or_none", 1), "keepdims": P(bool, False)})
+def pick(attrs, data, index):
+    ax = attrs["axis"]
+    if ax is None:
+        flat = data.reshape(-1)
+        return flat[index.astype(jnp.int32).reshape(-1)].reshape(index.shape)
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[ax] - 1)
+    idx_exp = jnp.expand_dims(idx, ax)
+    out = jnp.take_along_axis(data, idx_exp, axis=ax)
+    if not attrs["keepdims"]:
+        out = jnp.squeeze(out, axis=ax)
+    return out
+
+
+@register("broadcast_to", params={"shape": P("shape", ())})
+def broadcast_to(attrs, x):
+    tgt = tuple(s if t == 0 else t for s, t in zip(x.shape, attrs["shape"]))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_axis", aliases=["broadcast_axes"],
+          params={"axis": P("shape", ()), "size": P("shape", ())})
+def broadcast_axis(attrs, x):
+    tgt = list(x.shape)
+    ax = attrs["axis"]
+    sz = attrs["size"]
+    if isinstance(ax, int):
+        ax, sz = (ax,), (sz,)
+    for a, s in zip(ax, sz):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))

@@ -1,0 +1,429 @@
+"""Shape/layout manipulation, indexing, ordering, init ops.
+
+Reference: src/operator/tensor/matrix_op.cc (Reshape:43 … stack:631),
+indexing_op.cc, ordering_op.cc, init_op.cc, control_flow_op.cc,
+concat.cc, slice_channel.cc, swapaxis.cc, pad.cc.
+
+These are pure data-movement ops: on TPU they compile to XLA
+reshape/transpose/gather/scatter HLOs which are usually fused away or done
+in-register — no kernels needed.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register, P
+from ..base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# Reshape family — supports the reference's magic codes 0, -1, -2, -3, -4
+# (matrix_op.cc Reshape; python docs in symbol.py)
+# ---------------------------------------------------------------------------
+
+def infer_reshape(target, src_shape):
+    """Resolve MXNet reshape spec (with 0/-1/-2/-3/-4 codes) to a shape."""
+    out = []
+    src = list(src_shape)
+    i = 0  # index into src
+    j = 0
+    target = list(target)
+    while j < len(target):
+        t = target[j]
+        if t == 0:
+            out.append(src[i]); i += 1
+        elif t == -1:
+            out.append(-1); i += 1
+        elif t == -2:
+            out.extend(src[i:]); i = len(src)
+        elif t == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif t == -4:
+            d1, d2 = target[j + 1], target[j + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(t)
+            if i < len(src):
+                i += 1
+        j += 1
+    if out.count(-1) > 1:
+        raise MXNetError("reshape: more than one -1")
+    if -1 in out:
+        known = int(np.prod([d for d in out if d != -1])) or 1
+        total = int(np.prod(src_shape)) if src_shape else 1
+        out[out.index(-1)] = total // known
+    return tuple(out)
+
+
+@register("Reshape", aliases=["reshape"],
+          params={"shape": P("shape", ()), "reverse": P(bool, False),
+                  "target_shape": P("shape", ()), "keep_highest": P(bool, False)})
+def reshape(attrs, x):
+    tgt = attrs["shape"] or attrs["target_shape"]
+    if attrs["reverse"]:
+        new = infer_reshape(tuple(reversed(tgt)), tuple(reversed(x.shape)))
+        return x.reshape(tuple(reversed(new)))
+    return x.reshape(infer_reshape(tgt, x.shape))
+
+
+@register("Flatten", aliases=["flatten"])
+def flatten(attrs, x):
+    return x.reshape((x.shape[0], -1))
+
+
+@register("transpose", params={"axes": P("shape", ())})
+def transpose(attrs, x):
+    axes = attrs["axes"] or None
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims", params={"axis": P(int)})
+def expand_dims(attrs, x):
+    return jnp.expand_dims(x, attrs["axis"])
+
+
+@register("squeeze", params={"axis": P("shape_or_none", None)})
+def squeeze(attrs, x):
+    return jnp.squeeze(x, attrs["axis"])
+
+
+@register("SwapAxis", aliases=["swapaxes", "swap_axis"],
+          params={"dim1": P(int, 0), "dim2": P(int, 0)})
+def swapaxes(attrs, x):
+    return jnp.swapaxes(x, attrs["dim1"], attrs["dim2"])
+
+
+@register("reshape_like", nin=2, input_names=["lhs", "rhs"])
+def reshape_like(attrs, lhs, rhs):
+    return lhs.reshape(rhs.shape)
+
+
+@register("shape_array")
+def shape_array(attrs, x):
+    return jnp.array(x.shape, dtype=jnp.int64)
+
+
+@register("size_array")
+def size_array(attrs, x):
+    return jnp.array([x.size], dtype=jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# Slicing
+# ---------------------------------------------------------------------------
+
+def _norm_slice(begin, end, step, shape):
+    slices = []
+    step = step or (None,) * len(begin)
+    for i, dim in enumerate(shape):
+        if i < len(begin):
+            b = begin[i]
+            e = end[i] if i < len(end) else None
+            s = step[i] if step and i < len(step) else None
+            slices.append(slice(b, e, s))
+        else:
+            slices.append(slice(None))
+    return tuple(slices)
+
+
+@register("slice", aliases=["crop"],
+          params={"begin": P("shape", ()), "end": P("shape", ()),
+                  "step": P("shape", ())})
+def slice_op(attrs, x):
+    b = tuple(None if v is None else v for v in attrs["begin"])
+    e = tuple(attrs["end"])
+    return x[_norm_slice(b, e, attrs["step"], x.shape)]
+
+
+@register("slice_axis",
+          params={"axis": P(int), "begin": P(int, 0), "end": P("int_or_none", None)})
+def slice_axis(attrs, x):
+    ax = attrs["axis"] % x.ndim
+    sl = [slice(None)] * x.ndim
+    sl[ax] = slice(attrs["begin"], attrs["end"])
+    return x[tuple(sl)]
+
+
+@register("_slice_assign", aliases=["_crop_assign"], nin=2,
+          input_names=["lhs", "rhs"],
+          params={"begin": P("shape", ()), "end": P("shape", ()),
+                  "step": P("shape", ())})
+def _slice_assign(attrs, lhs, rhs):
+    sl = _norm_slice(attrs["begin"], attrs["end"], attrs["step"], lhs.shape)
+    return lhs.at[sl].set(rhs)
+
+
+@register("_slice_assign_scalar", aliases=["_crop_assign_scalar"],
+          params={"scalar": P(float, 0.0), "begin": P("shape", ()),
+                  "end": P("shape", ()), "step": P("shape", ())})
+def _slice_assign_scalar(attrs, lhs):
+    sl = _norm_slice(attrs["begin"], attrs["end"], attrs["step"], lhs.shape)
+    return lhs.at[sl].set(attrs["scalar"])
+
+
+@register("slice_like", nin=2, input_names=["data", "shape_like"],
+          params={"axes": P("shape", ())})
+def slice_like(attrs, data, like):
+    axes = attrs["axes"] or tuple(range(min(data.ndim, like.ndim)))
+    sl = [slice(None)] * data.ndim
+    for a in axes:
+        sl[a % data.ndim] = slice(0, like.shape[a % like.ndim])
+    return data[tuple(sl)]
+
+
+# ---------------------------------------------------------------------------
+# Repeat / tile / reverse / stack / concat / split / pad
+# ---------------------------------------------------------------------------
+
+@register("repeat", params={"repeats": P(int), "axis": P("int_or_none", None)})
+def repeat(attrs, x):
+    return jnp.repeat(x, attrs["repeats"], axis=attrs["axis"])
+
+
+@register("tile", params={"reps": P("shape", ())})
+def tile(attrs, x):
+    return jnp.tile(x, attrs["reps"])
+
+
+@register("reverse", aliases=["flip"], params={"axis": P("shape", ())})
+def reverse(attrs, x):
+    ax = attrs["axis"]
+    if isinstance(ax, int):
+        ax = (ax,)
+    return jnp.flip(x, axis=ax)
+
+
+@register("stack", variable_inputs=True, key_var_num_args="num_args",
+          params={"axis": P(int, 0), "num_args": P(int, 0)})
+def stack(attrs, *xs):
+    return jnp.stack(xs, axis=attrs["axis"])
+
+
+@register("Concat", aliases=["concat"], variable_inputs=True,
+          key_var_num_args="num_args",
+          params={"dim": P(int, 1), "num_args": P(int, 0)})
+def concat(attrs, *xs):
+    return jnp.concatenate(xs, axis=attrs["dim"])
+
+
+def _split_nout(attrs):
+    if attrs is None:
+        return 1
+    n = int(attrs.get("num_outputs", 1))
+    return 1 if attrs.get("squeeze_axis") and n == 0 else n
+
+
+@register("SliceChannel", aliases=["split"], nout=_split_nout,
+          params={"num_outputs": P(int), "axis": P(int, 1),
+                  "squeeze_axis": P(bool, False)})
+def split(attrs, x):
+    parts = jnp.split(x, attrs["num_outputs"], axis=attrs["axis"])
+    if attrs["squeeze_axis"]:
+        parts = [jnp.squeeze(p, axis=attrs["axis"]) for p in parts]
+    return tuple(parts)
+
+
+@register("Pad", aliases=["pad"],
+          params={"mode": P(str, "constant", choices=["constant", "edge", "reflect"]),
+                  "pad_width": P("shape", ()), "constant_value": P(float, 0.0)})
+def pad(attrs, x):
+    pw = attrs["pad_width"]
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    mode = attrs["mode"]
+    if mode == "constant":
+        return jnp.pad(x, pairs, mode="constant",
+                       constant_values=attrs["constant_value"])
+    return jnp.pad(x, pairs, mode={"edge": "edge", "reflect": "reflect"}[mode])
+
+
+# ---------------------------------------------------------------------------
+# Indexing: take / Embedding-style gathers / one_hot / gather_nd / scatter_nd
+# ---------------------------------------------------------------------------
+
+@register("take", nin=2, input_names=["a", "indices"],
+          params={"axis": P(int, 0),
+                  "mode": P(str, "clip", choices=["raise", "wrap", "clip"])})
+def take(attrs, a, indices):
+    idx = indices.astype(jnp.int32)
+    n = a.shape[attrs["axis"]]
+    if attrs["mode"] == "wrap":
+        idx = idx % n
+    else:
+        idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(a, idx, axis=attrs["axis"])
+
+
+@register("batch_take", nin=2, input_names=["a", "indices"])
+def batch_take(attrs, a, indices):
+    idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx.reshape(-1, 1), axis=1).reshape(idx.shape)
+
+
+@register("one_hot", nin=2, input_names=["indices"],
+          params={"depth": P(int), "on_value": P(float, 1.0),
+                  "off_value": P(float, 0.0), "dtype": P(str, "float32")})
+def one_hot(attrs, indices):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), attrs["depth"])
+    out = oh * (attrs["on_value"] - attrs["off_value"]) + attrs["off_value"]
+    return out.astype(np.dtype(attrs["dtype"]))
+
+
+@register("gather_nd", nin=2, input_names=["data", "indices"])
+def gather_nd(attrs, data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd", nin=2, input_names=["data", "indices"],
+          params={"shape": P("shape", ())})
+def scatter_nd(attrs, data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(attrs["shape"], dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].add(data)
+
+
+@register("_scatter_set_nd", nin=2, input_names=["data", "indices"],
+          params={"shape": P("shape", ())})
+def _scatter_set_nd(attrs, data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(attrs["shape"], dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("where", nin=3, input_names=["condition", "x", "y"])
+def where(attrs, cond, x, y):
+    if cond.ndim == 1 and x.ndim > 1:
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond != 0, x, y)
+
+
+# ---------------------------------------------------------------------------
+# Ordering (tensor/ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("topk", nout=lambda attrs: 2 if (attrs or {}).get("ret_typ") == "both" else 1,
+          params={"axis": P("int_or_none", -1), "k": P(int, 1),
+                  "ret_typ": P(str, "indices", choices=["value", "indices", "mask", "both"]),
+                  "is_ascend": P(bool, False), "dtype": P(str, "float32")})
+def topk(attrs, x):
+    ax = attrs["axis"]
+    if ax is None:
+        x = x.reshape(-1)
+        ax = 0
+    k = attrs["k"]
+    sign = 1 if attrs["is_ascend"] else -1
+    order = jnp.argsort(sign * x, axis=ax)
+    idx = jnp.take(order, jnp.arange(k), axis=ax)
+    vals = jnp.take_along_axis(x, idx, axis=ax)
+    rt = attrs["ret_typ"]
+    if rt == "value":
+        return vals
+    if rt == "indices":
+        return idx.astype(np.dtype(attrs["dtype"]))
+    if rt == "both":
+        return vals, idx.astype(np.dtype(attrs["dtype"]))
+    # mask
+    mask = jnp.zeros_like(x)
+    mask = jnp.put_along_axis(mask, idx, 1.0, axis=ax, inplace=False) \
+        if hasattr(jnp, "put_along_axis") else _mask_scatter(mask, idx, ax)
+    return mask
+
+
+def _mask_scatter(mask, idx, ax):
+    oh = jax.nn.one_hot(idx, mask.shape[ax], axis=ax, dtype=mask.dtype)
+    return jnp.clip(oh.sum(axis=ax + 1 if ax >= 0 else ax), 0, 1)
+
+
+@register("sort", params={"axis": P("int_or_none", -1), "is_ascend": P(bool, True)})
+def sort(attrs, x):
+    ax = attrs["axis"]
+    if ax is None:
+        x = x.reshape(-1); ax = 0
+    s = jnp.sort(x, axis=ax)
+    return s if attrs["is_ascend"] else jnp.flip(s, axis=ax)
+
+
+@register("argsort", params={"axis": P("int_or_none", -1),
+                             "is_ascend": P(bool, True),
+                             "dtype": P(str, "float32")})
+def argsort(attrs, x):
+    ax = attrs["axis"]
+    if ax is None:
+        x = x.reshape(-1); ax = 0
+    sign = 1 if attrs["is_ascend"] else -1
+    return jnp.argsort(sign * x, axis=ax).astype(np.dtype(attrs["dtype"]))
+
+
+# ---------------------------------------------------------------------------
+# Init ops (tensor/init_op.cc) — zero-input creators
+# ---------------------------------------------------------------------------
+
+_DT = {"dtype": P(str, "float32")}
+
+
+@register("_zeros", nin=0, params={"shape": P("shape", ()), **_DT,
+                                   "ctx": P("str_or_none", None)})
+def _zeros(attrs):
+    return jnp.zeros(attrs["shape"], dtype=np.dtype(attrs["dtype"]))
+
+
+@register("_ones", nin=0, params={"shape": P("shape", ()), **_DT,
+                                  "ctx": P("str_or_none", None)})
+def _ones(attrs):
+    return jnp.ones(attrs["shape"], dtype=np.dtype(attrs["dtype"]))
+
+
+@register("_full", nin=0, params={"shape": P("shape", ()), "value": P(float, 0.0),
+                                  **_DT, "ctx": P("str_or_none", None)})
+def _full(attrs):
+    return jnp.full(attrs["shape"], attrs["value"], dtype=np.dtype(attrs["dtype"]))
+
+
+@register("_arange", nin=0,
+          params={"start": P(float, 0.0), "stop": P("float_or_none", None),
+                  "step": P(float, 1.0), "repeat": P(int, 1),
+                  "infer_range": P(bool, False), **_DT,
+                  "ctx": P("str_or_none", None)})
+def _arange(attrs):
+    start, stop = attrs["start"], attrs["stop"]
+    if stop is None:
+        start, stop = 0.0, start
+    out = np.arange(start, stop, attrs["step"], dtype=np.dtype(attrs["dtype"]))
+    if attrs["repeat"] > 1:
+        out = np.repeat(out, attrs["repeat"])
+    return jnp.asarray(out)
+
+
+@register("zeros_like")
+def zeros_like(attrs, x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def ones_like(attrs, x):
+    return jnp.ones_like(x)
+
+
+@register("_eye", nin=0, params={"N": P(int), "M": P(int, 0), "k": P(int, 0), **_DT})
+def _eye(attrs):
+    m = attrs["M"] or attrs["N"]
+    return jnp.eye(attrs["N"], m, k=attrs["k"], dtype=np.dtype(attrs["dtype"]))
+
+
+# ---------------------------------------------------------------------------
+# Loss-ish / misc control flow
+# ---------------------------------------------------------------------------
+
+@register("softmax_cross_entropy", nin=2, input_names=["data", "label"])
+def softmax_cross_entropy(attrs, data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, lab.reshape(-1, 1), axis=-1)
+    return -jnp.sum(picked)

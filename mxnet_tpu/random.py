@@ -1,0 +1,48 @@
+"""Global PRNG state (reference: python/mxnet/random.py + src/resource.cc
+per-device seeded PRNG pools).
+
+JAX randomness is functional; the imperative frontend needs MXNet's stateful
+`mx.random.seed(...)` semantics.  Bridge: one root key + a monotonically
+increasing draw counter; each eager stochastic op gets `fold_in(root, n)`.
+Compiled paths (Executor, CachedOp) own their own counter folded in per step,
+so eager and compiled never reuse streams.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key", "current_seed"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _get():
+    if not hasattr(_state, "seed"):
+        _state.seed = _DEFAULT_SEED
+        _state.counter = 0
+        _state.key = None
+    return _state
+
+
+def seed(seed_state):
+    """Seed all random streams (mx.random.seed equivalent)."""
+    import jax
+    s = _get()
+    s.seed = int(seed_state)
+    s.counter = 0
+    s.key = jax.random.PRNGKey(s.seed)
+
+
+def current_seed():
+    return _get().seed
+
+
+def next_key():
+    """Draw a fresh PRNG key for one eager stochastic op."""
+    import jax
+    s = _get()
+    if s.key is None:
+        s.key = jax.random.PRNGKey(s.seed)
+    s.counter += 1
+    return jax.random.fold_in(s.key, s.counter)

@@ -1,0 +1,184 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_grad():
+    x = nd.array([[1., 2.], [3., 4.]])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = nd.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(2 * x)
+        z = y.sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * np.exp(2 * x.asnumpy()), rtol=1e-5)
+
+
+def test_head_grad():
+    x = nd.array([1., 2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10., 100.]))
+    assert x.grad.asnumpy().tolist() == [30., 300.]
+
+
+def test_grad_req_add():
+    x = nd.array([1., 1.])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert x.grad.asnumpy().tolist() == [4., 4.]
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_detach():
+    x = nd.array([2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+        z = y.detach() * x
+    z.backward()
+    assert x.grad.asnumpy().tolist() == [6.]
+
+
+def test_multi_output_backward():
+    x = nd.array([[1., 2., 3., 4.]])
+    x.attach_grad()
+    with autograd.record():
+        a, b = nd.split(x, num_outputs=2, axis=1)
+        y = (a * 1 + b * 10).sum()
+    y.backward()
+    assert x.grad.asnumpy().tolist() == [[1., 1., 10., 10.]]
+
+
+def test_autograd_grad_api():
+    x = nd.array([1., 2.])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 2).sum()
+    (gx,) = autograd.grad([y], [x])
+    assert gx.asnumpy().tolist() == [2., 4.]
+    # .grad untouched by grad() API
+    assert x.grad.asnumpy().tolist() == [0., 0.]
+
+
+def test_aliased_mutation_on_tape():
+    z = nd.array([1., 2.])
+    z.attach_grad()
+    with autograd.record():
+        w = z * 3.0
+        w += z
+        s = (w * w).sum()
+    s.backward()
+    assert z.grad.asnumpy().tolist() == [32., 64.]
+
+
+def test_slice_assign_grad():
+    x = nd.ones((4,))
+    v = nd.array([5., 6.])
+    x.attach_grad()
+    v.attach_grad()
+    with autograd.record():
+        x2 = x * 1.0
+        x2[1:3] = v
+        y = (x2 * x2).sum()
+    y.backward()
+    assert v.grad.asnumpy().tolist() == [10., 12.]
+    assert x.grad.asnumpy().tolist() == [2., 0., 0., 2.]
+
+
+def test_mark_variables():
+    x = nd.array([3.])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * x
+    y.backward()
+    assert g.asnumpy().tolist() == [6.]
+
+
+def test_softmax_output_implicit_grad():
+    data = nd.array([[1., 2., 3.], [1., 2., 3.]])
+    label = nd.array([2., 0.])
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = np.exp([1., 2., 3.])
+    p = p / p.sum()
+    expect0 = p - np.array([0., 0., 1.])
+    expect1 = p - np.array([1., 0., 0.])
+    assert np.allclose(data.grad.asnumpy(), [expect0, expect1], atol=1e-5)
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.saved = x
+            return x * x
+
+        def backward(self, dy):
+            return 2 * self.saved * dy
+
+    x = nd.array([2., 3.])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+    y.backward()
+    assert x.grad.asnumpy().tolist() == [4., 6.]
+
+
+def test_training_convergence():
+    """Tiny end-to-end: MLP on a learnable target converges (the reference's
+    tests/python/train pattern — assert metric threshold, not exact values)."""
+    np.random.seed(0)
+    X = nd.array(np.random.randn(64, 10))
+    wt = np.random.randn(10, 1)
+    Y = nd.array(X.asnumpy() @ wt)
+    w1 = nd.random.normal(shape=(16, 10)) * 0.3
+    b1 = nd.zeros((16,))
+    w2 = nd.random.normal(shape=(1, 16)) * 0.3
+    b2 = nd.zeros((1,))
+    params = [w1, b1, w2, b2]
+    for p in params:
+        p.attach_grad()
+    first = None
+    for _ in range(200):
+        with autograd.record():
+            h = nd.relu(nd.FullyConnected(X, w1, b1, num_hidden=16))
+            out = nd.FullyConnected(h, w2, b2, num_hidden=1)
+            loss = ((out - Y) ** 2).mean()
+        loss.backward()
+        for p in params:
+            p._data = p._data - 0.05 * p.grad._data
+        if first is None:
+            first = float(loss.asscalar())
+    last = float(loss.asscalar())
+    assert last < first * 0.05, (first, last)

@@ -1,0 +1,207 @@
+"""NDArray core tests (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_create_and_convert():
+    x = nd.array([[1, 2], [3, 4]])
+    assert x.shape == (2, 2)
+    assert x.dtype == np.float32
+    assert np.array_equal(x.asnumpy(), [[1, 2], [3, 4]])
+    y = nd.array(np.arange(6).reshape(2, 3), dtype="int32")
+    assert y.dtype == np.int32
+    assert x.context.device_type == "cpu"
+
+
+def test_creation_helpers():
+    assert (nd.zeros((2, 3)).asnumpy() == 0).all()
+    assert (nd.ones((2, 3)).asnumpy() == 1).all()
+    assert (nd.full((2,), 7).asnumpy() == 7).all()
+    a = nd.arange(0, 10, 2)
+    assert a.asnumpy().tolist() == [0, 2, 4, 6, 8]
+    e = nd.empty((4, 5))
+    assert e.shape == (4, 5)
+
+
+def test_arith_dunders():
+    x = nd.array([1., 2., 3.])
+    y = nd.array([4., 5., 6.])
+    assert (x + y).asnumpy().tolist() == [5., 7., 9.]
+    assert (y - x).asnumpy().tolist() == [3., 3., 3.]
+    assert (x * y).asnumpy().tolist() == [4., 10., 18.]
+    assert np.allclose((y / x).asnumpy(), [4., 2.5, 2.])
+    assert (x ** 2).asnumpy().tolist() == [1., 4., 9.]
+    assert (2 ** x).asnumpy().tolist() == [2., 4., 8.]
+    assert (1 - x).asnumpy().tolist() == [0., -1., -2.]
+    assert (6 / x).asnumpy().tolist() == [6., 3., 2.]
+    assert (-x).asnumpy().tolist() == [-1., -2., -3.]
+    assert (x % 2).asnumpy().tolist() == [1., 0., 1.]
+    assert abs(nd.array([-1., 2.])).asnumpy().tolist() == [1., 2.]
+
+
+def test_comparisons():
+    x = nd.array([1., 2., 3.])
+    assert (x > 2).asnumpy().tolist() == [0., 0., 1.]
+    assert (x == 2).asnumpy().tolist() == [0., 1., 0.]
+    assert (x <= 2).asnumpy().tolist() == [1., 1., 0.]
+    y = nd.array([3., 2., 1.])
+    assert (x < y).asnumpy().tolist() == [1., 0., 0.]
+
+
+def test_inplace_ops():
+    b = nd.ones((3, 4))
+    b += 2
+    b *= 3
+    assert (b.asnumpy() == 9).all()
+    b /= 9
+    assert (b.asnumpy() == 1).all()
+
+
+def test_indexing():
+    a = nd.arange(0, 12).reshape(3, 4)
+    assert a[1].asnumpy().tolist() == [4., 5., 6., 7.]
+    assert a[1:3].shape == (2, 4)
+    assert float(a[2, 3].asscalar()) == 11.0
+    a[1:3] = 0
+    assert a.asnumpy()[1:].sum() == 0
+    a[0, 1] = 99
+    assert float(a[0, 1].asscalar()) == 99.0
+    idx = nd.array([0, 2], dtype="int32")
+    assert nd.take(a, idx).shape == (2, 4)
+
+
+def test_reshape_magic():
+    x = nd.ones((2, 3, 4))
+    assert x.reshape(-1, 4).shape == (6, 4)
+    assert x.reshape((0, -1)).shape == (2, 12)
+    assert nd.Reshape(x, shape=(-3, 0)).shape == (6, 4)
+    assert nd.Reshape(x, shape=(-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+    assert nd.Flatten(x).shape == (2, 12)
+
+
+def test_reductions():
+    m = nd.array([[1., 2.], [3., 4.]])
+    assert float(m.sum().asscalar()) == 10
+    assert m.sum(1).asnumpy().tolist() == [3., 7.]
+    assert m.sum(axis=0).asnumpy().tolist() == [4., 6.]
+    assert m.mean(0).asnumpy().tolist() == [2., 3.]
+    assert float(m.max().asscalar()) == 4
+    assert float(nd.norm(m).asscalar()) == pytest.approx(np.sqrt(30))
+    assert nd.argmax(m, axis=1).asnumpy().tolist() == [1., 1.]
+    assert nd.sum(m, axis=1, keepdims=True).shape == (2, 1)
+
+
+def test_broadcast():
+    x = nd.array([[1.], [2.]])
+    y = nd.array([[10., 20.]])
+    assert (nd.broadcast_add(x, y)).asnumpy().tolist() == [[11., 21.], [12., 22.]]
+    assert x.broadcast_to((2, 3)).shape == (2, 3)
+    z = nd.ones((2,))
+    assert z.broadcast_to((4, 2)).shape == (4, 2)
+
+
+def test_concat_split_stack():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    assert nd.concatenate([a, b], axis=1).shape == (2, 6)
+
+
+def test_dot():
+    x = nd.array([[1., 2.], [3., 4.]])
+    y = nd.array([[1., 1.], [1., 1.]])
+    assert nd.dot(x, y).asnumpy().tolist() == [[3., 3.], [7., 7.]]
+    assert nd.dot(x, y, transpose_b=True).asnumpy().tolist() == [[3., 3.], [7., 7.]]
+    a = nd.ones((2, 3, 4))
+    b = nd.ones((2, 4, 5))
+    assert nd.batch_dot(a, b).shape == (2, 3, 5)
+
+
+def test_astype_cast():
+    x = nd.array([1.5, 2.5])
+    assert x.astype("int32").dtype == np.int32
+    assert nd.Cast(x, dtype="float16").dtype == np.float16
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "nd.params")
+    d = {"a": nd.ones((2, 2)), "b": nd.arange(0, 4)}
+    nd.save(f, d)
+    back = nd.load(f)
+    assert set(back) == {"a", "b"}
+    assert np.array_equal(back["a"].asnumpy(), d["a"].asnumpy())
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(f, lst)
+    back = nd.load(f)
+    assert isinstance(back, list) and len(back) == 2
+
+
+def test_copy_context():
+    x = nd.ones((2, 2))
+    y = x.copy()
+    y += 1
+    assert (x.asnumpy() == 1).all()
+    z = x.as_in_context(mx.cpu(0))
+    assert z.context.device_type == "cpu"
+    w = nd.zeros((2, 2))
+    x.copyto(w)
+    assert (w.asnumpy() == 1).all()
+
+
+def test_multi_device_cpu():
+    """Multi-device semantics on virtual CPU devices (the reference's
+    test_multi_device_exec.py pattern)."""
+    a = nd.ones((2, 2), ctx=mx.cpu(0))
+    b = nd.ones((2, 2), ctx=mx.cpu(1))
+    assert a.context == mx.cpu(0)
+    assert b.context == mx.cpu(1)
+    c = b.as_in_context(mx.cpu(0)) + a
+    assert c.context == mx.cpu(0)
+    assert (c.asnumpy() == 2).all()
+
+
+def test_out_kwarg():
+    x = nd.array([1., 2.])
+    o = nd.zeros((2,))
+    nd.elemwise_add(x, x, out=o)
+    assert o.asnumpy().tolist() == [2., 4.]
+
+
+def test_scalar_helpers():
+    x = nd.array([1., 2., 3.])
+    assert nd.maximum(x, 2).asnumpy().tolist() == [2., 2., 3.]
+    assert nd.minimum(x, 2).asnumpy().tolist() == [1., 2., 2.]
+    assert nd.power(x, nd.array([2., 2., 2.])).asnumpy().tolist() == [1., 4., 9.]
+
+
+def test_unary_math():
+    x = nd.array([0.5, 1.0])
+    assert np.allclose(nd.exp(x).asnumpy(), np.exp([0.5, 1.0]), rtol=1e-5)
+    assert np.allclose(nd.log(x).asnumpy(), np.log([0.5, 1.0]), rtol=1e-5)
+    assert np.allclose(nd.sigmoid(x).asnumpy(), 1 / (1 + np.exp([-0.5, -1.0])), rtol=1e-5)
+    assert np.allclose(nd.gamma(nd.array([-0.5, 0.5, 3.0])).asnumpy(),
+                       [-3.5449077, 1.7724539, 2.0], atol=1e-4)
+    assert nd.relu(nd.array([-1., 1.])).asnumpy().tolist() == [0., 1.]
+
+
+def test_ordering():
+    x = nd.array([[3., 1., 2.]])
+    assert nd.sort(x).asnumpy().tolist() == [[1., 2., 3.]]
+    assert nd.argsort(x).asnumpy().tolist() == [[1., 2., 0.]]
+    assert nd.topk(x, k=2, ret_typ="value").asnumpy().tolist() == [[3., 2.]]
+
+
+def test_one_hot_embedding():
+    idx = nd.array([0, 2])
+    oh = nd.one_hot(idx, depth=3)
+    assert oh.asnumpy().tolist() == [[1., 0., 0.], [0., 0., 1.]]
+    w = nd.array(np.arange(12).reshape(4, 3))
+    e = nd.Embedding(idx, w, input_dim=4, output_dim=3)
+    assert e.asnumpy().tolist() == [[0., 1., 2.], [6., 7., 8.]]
