@@ -18,3 +18,13 @@ from . import ops
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from .executor import Executor
+from . import cached_op
+from .cached_op import CachedOp
+
+ndarray.CachedOp = CachedOp
+nd.CachedOp = CachedOp

@@ -1,0 +1,381 @@
+"""Executor: compiled symbolic runtime.
+
+Reference: src/executor/graph_executor.cc + include/mxnet/executor.h:53-129.
+The reference compiles a Symbol by appending a gradient subgraph
+(nnvm::pass::Gradient), planning memory, then pushing one engine op per node
+per Forward/Backward call.
+
+TPU-native collapse (SURVEY §7, BASELINE north star): the whole graph —
+forward, backward (via jax.vjp), gradient accumulation (grad_req add/write),
+and aux-state updates — is ONE jit-compiled XLA computation.  There is no
+per-op dispatch, no memory planner (XLA buffer assignment + donated gradient
+buffers replace PlanMemory/inplace detection), and backward-with-recompute
+never happens: forward(is_train=True) is lazy and the fused fwd+bwd program
+runs once per step at backward() time, producing outputs AND gradients.
+
+Multi-device data parallelism does not use N executors like the reference's
+DataParallelExecutorGroup (executor_group.py:128); instead Module binds ONE
+executor whose arrays are sharded over a mesh (see mxnet_tpu.parallel) —
+batch-split + gradient allreduce become sharding annotations + psum compiled
+into this same XLA program.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, current_context
+from . import random as _random
+from .ndarray.ndarray import NDArray, _wrap
+from .symbol.symbol import Symbol, _topo
+
+__all__ = ["Executor", "build_graph_fn"]
+
+
+def build_graph_fn(symbol, arg_names, aux_names):
+    """Compile a Symbol DAG into a pure function
+    ``fn(arg_vals, aux_vals, key, training) -> (outputs, new_aux)``.
+
+    This is the attach_op_execs_pass.cc analog: one interpreter over registry
+    impls, meant to run under jax.jit so the whole graph becomes one XLA
+    computation.  Aux-state mutation (mutate_aux) is threaded functionally:
+    the updated value replaces the aux entry for downstream readers and is
+    returned for write-back by the caller."""
+    topo = _topo(symbol._outputs)
+    var_kind = {}   # node id -> ('arg', name) | ('aux', name)
+    aux_set = set(aux_names)
+    for n in topo:
+        if n.op is None:
+            var_kind[id(n)] = ("aux" if n.name in aux_set else "arg", n.name)
+    sto_index = {}
+    for n in topo:
+        if n.op is not None and n.op.stochastic:
+            sto_index[id(n)] = len(sto_index)
+    heads = symbol._outputs
+
+    def graph_fn(arg_vals, aux_vals, key, training):
+        import jax
+        env = {}
+        aux_env = dict(zip(aux_names, aux_vals))
+        argd = dict(zip(arg_names, arg_vals))
+        for n in topo:
+            if n.op is None:
+                kind, name = var_kind[id(n)]
+                env[(id(n), 0)] = argd[name] if kind == "arg" else aux_env[name]
+                continue
+            ins = [env[(id(i), ix)] for (i, ix) in n.inputs]
+            attrs = {k: v for k, v in n.attrs.items() if not k.startswith("__")}
+            attrs = n.op.normalize(attrs)
+            f = n.op.bound(attrs, training)
+            if n.op.stochastic:
+                k = jax.random.fold_in(key, sto_index[id(n)])
+                outs = f(k, *ins)
+            else:
+                outs = f(*ins)
+            for i, o in enumerate(outs):
+                env[(id(n), i)] = o
+            for in_idx, out_idx in n.op.mutate_aux.items():
+                src, _ = n.inputs[in_idx]
+                if src.op is None and var_kind[id(src)][0] == "aux":
+                    aux_env[var_kind[id(src)][1]] = outs[out_idx]
+        out_vals = tuple(env[(id(n), ix)] for (n, ix) in heads)
+        new_aux = tuple(aux_env[a] for a in aux_names)
+        return out_vals, new_aux
+
+    return graph_fn
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None,
+                 sharding=None):
+        import jax
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else (ctx or current_context())
+        self._sharding = sharding  # optional jax.sharding for params/data
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+
+        self.arg_dict = self._as_dict(args, self.arg_names, "args")
+        self.aux_dict = self._as_dict(aux_states or {}, self.aux_names, "aux")
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self.arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null") for n in self.arg_names}
+
+        if args_grad is None:
+            args_grad = {}
+        self.grad_dict = self._as_dict(args_grad, self.arg_names, "grads",
+                                       allow_missing=True)
+        for n in self.arg_names:
+            if self._grad_req.get(n, "null") != "null" and n not in self.grad_dict:
+                import jax.numpy as jnp
+                self.grad_dict[n] = _wrap(
+                    jnp.zeros_like(self.arg_dict[n]._data), self._ctx)
+
+        self.outputs = []
+        self._monitor = None
+        self._fwd_jit = {}
+        self._fwd_bwd_jit = {}
+        self._base_key = None
+        self._step = 0
+        self._pending_train_fwd = False
+        self._build()
+
+    # ------------------------------------------------------------------
+    def _as_dict(self, values, names, what, allow_missing=False):
+        if isinstance(values, dict):
+            out = {}
+            for n in names:
+                if n in values:
+                    out[n] = values[n]
+                elif not allow_missing:
+                    raise MXNetError("%s: missing %r" % (what, n))
+            return out
+        values = list(values or [])
+        if not allow_missing and len(values) != len(names):
+            raise MXNetError("%s: expected %d entries, got %d"
+                             % (what, len(names), len(values)))
+        return {n: v for n, v in zip(names, values) if v is not None}
+
+    # ------------------------------------------------------------------
+    def _build(self):
+        self._topo = _topo(self._symbol._outputs)
+        self._graph_fn = build_graph_fn(self._symbol, self.arg_names,
+                                        self.aux_names)
+
+    def _key(self):
+        import jax
+        if self._base_key is None:
+            self._base_key = _random.next_key()
+        self._step += 1
+        return jax.random.fold_in(self._base_key, self._step)
+
+    def _get_fwd(self, training):
+        import jax
+        fn = self._fwd_jit.get(training)
+        if fn is None:
+            g = self._graph_fn
+            fn = jax.jit(lambda a, x, k: g(a, x, k, training))
+            self._fwd_jit[training] = fn
+        return fn
+
+    def _get_fwd_bwd(self, with_head_grads):
+        import jax
+        import jax.numpy as jnp
+        fn = self._fwd_bwd_jit.get(with_head_grads)
+        if fn is None:
+            g = self._graph_fn
+            grad_names = [n for n in self.arg_names
+                          if self._grad_req.get(n, "null") != "null"]
+            gidx = [self.arg_names.index(n) for n in grad_names]
+            req_add = [self._grad_req[n] == "add" for n in grad_names]
+            self._grad_names = grad_names
+
+            def fwd_bwd(arg_vals, aux_vals, key, head_grads, old_grads):
+                def f(*wrt):
+                    av = list(arg_vals)
+                    for i, w in zip(gidx, wrt):
+                        av[i] = w
+                    outs, new_aux = g(tuple(av), aux_vals, key, True)
+                    return outs, new_aux
+                wrt_vals = tuple(arg_vals[i] for i in gidx)
+                outs, vjp, new_aux = jax.vjp(f, *wrt_vals, has_aux=True)
+                if head_grads is None:
+                    # backward() with no out_grads: seed ones (loss heads'
+                    # custom vjps ignore the cotangent, reference semantics)
+                    head_grads = tuple(jnp.ones_like(o) for o in outs)
+                grads = vjp(head_grads)
+                new_grads = tuple(og + gr if add else gr for og, gr, add
+                                  in zip(old_grads, grads, req_add))
+                return outs, new_aux, new_grads
+
+            if with_head_grads:
+                fn = jax.jit(fwd_bwd, donate_argnums=(4,))
+            else:
+                fn = jax.jit(
+                    lambda a, x, k, og: fwd_bwd(a, x, k, None, og),
+                    donate_argnums=(3,))
+            self._fwd_bwd_jit[with_head_grads] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    def _arg_vals(self):
+        return tuple(self.arg_dict[n]._data for n in self.arg_names)
+
+    def _aux_vals(self):
+        return tuple(self.aux_dict[n]._data for n in self.aux_names)
+
+    def forward(self, is_train=False, **kwargs):
+        import jax
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("forward: unknown argument %r" % k)
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._data = v._data
+            else:
+                self.arg_dict[k]._data = jax.device_put(
+                    _np.asarray(v), self._ctx.jax_device())
+        if is_train:
+            # lazy: the fused fwd+bwd program at backward() computes outputs
+            # too, so running forward now would execute the graph twice.
+            self._pending_train_fwd = True
+            self._pending_key = self._key()
+            self.outputs = None
+            return _LazyOutputs(self)
+        outs, new_aux = self._get_fwd(False)(self._arg_vals(), self._aux_vals(),
+                                             self._key())
+        self._set_outputs(outs)
+        self._pending_train_fwd = False
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if not self._pending_train_fwd and self.outputs is None:
+            raise MXNetError("backward called without forward(is_train=True)")
+        key = getattr(self, "_pending_key", None)
+        if key is None:
+            key = self._key()
+        fn = self._get_fwd_bwd(out_grads is not None)
+        grad_names = self._grad_names
+        old = tuple(self.grad_dict[n]._data for n in grad_names)
+        if out_grads is None:
+            outs, new_aux, new_grads = fn(self._arg_vals(), self._aux_vals(),
+                                          key, old)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            head = tuple(o._data for o in out_grads)
+            outs, new_aux, new_grads = fn(self._arg_vals(), self._aux_vals(),
+                                          key, head, old)
+        self._set_outputs(outs)
+        for n, a in zip(self.aux_names, new_aux):
+            self.aux_dict[n]._data = a
+        for n, gv in zip(grad_names, new_grads):
+            self.grad_dict[n]._data = gv
+        self._pending_train_fwd = False
+        self._pending_key = None
+
+    def _materialize_pending(self):
+        if self._pending_train_fwd and self.outputs is None:
+            outs, new_aux = self._get_fwd(True)(self._arg_vals(),
+                                                self._aux_vals(),
+                                                self._pending_key)
+            self._set_outputs(outs)
+            for n, a in zip(self.aux_names, new_aux):
+                self.aux_dict[n]._data = a
+
+    def _set_outputs(self, outs):
+        self.outputs = [_wrap(o, self._ctx) for o in outs]
+        if self._monitor is not None:
+            for name, o in zip(self.output_names, self.outputs):
+                self._monitor(name, o)
+
+    # ------------------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self.aux_names]
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor = callback
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v.astype(self.arg_dict[k].dtype)._data
+            elif not allow_extra_params:
+                raise MXNetError("unknown arg %r" % k)
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._data = v.astype(self.aux_dict[k].dtype)._data
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux %r" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor for new input shapes.  XLA jit re-traces per
+        shape signature automatically (the CachedOp/bucketing trick), so this
+        only re-allocates arg arrays."""
+        shapes = {n: self.arg_dict[n].shape for n in self.arg_names}
+        shapes.update({k: tuple(v) for k, v in kwargs.items()})
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shapes)
+        import jax.numpy as jnp
+        new_args = {}
+        for n, s in zip(self.arg_names, arg_shapes):
+            old = self.arg_dict[n]
+            if old.shape == tuple(s):
+                new_args[n] = old
+            else:
+                new_args[n] = _wrap(jnp.zeros(s, old.dtype), self._ctx)
+        new_aux = {}
+        for n, s in zip(self.aux_names, aux_shapes):
+            old = self.aux_dict[n]
+            new_aux[n] = old if old.shape == tuple(s) else \
+                _wrap(jnp.zeros(s, old.dtype), self._ctx)
+        grad_req = dict(self._grad_req)
+        return Executor(self._symbol, self._ctx, new_args, None, grad_req,
+                        new_aux, sharding=self._sharding)
+
+    def debug_str(self):
+        lines = ["Symbol outputs: %s" % ", ".join(self.output_names)]
+        for n in self._topo:
+            if n.op is not None:
+                lines.append("  %s(%s)" % (n.op.name, n.name))
+        lines.append("Total args: %d, aux: %d" % (len(self.arg_names),
+                                                  len(self.aux_names)))
+        return "\n".join(lines)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req, type_dict, shapes,
+                     shared_exec=None):
+        import jax.numpy as jnp
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
+        type_kwargs = {k: v for k, v in (type_dict or {}).items()}
+        arg_types, _, aux_types = symbol.infer_type(**type_kwargs)
+        ctx = ctx or current_context()
+        args = {}
+        with ctx:
+            for n, s, t in zip(symbol.list_arguments(), arg_shapes, arg_types):
+                args[n] = _wrap(jnp.zeros(s, t), ctx)
+            aux = {}
+            for n, s, t in zip(symbol.list_auxiliary_states(), aux_shapes,
+                               aux_types):
+                aux[n] = _wrap(jnp.zeros(s, t), ctx)
+        return Executor(symbol, ctx, args, None, grad_req, aux)
+
+
+class _LazyOutputs(list):
+    """forward(is_train=True) returns this; touching it materializes."""
+
+    def __init__(self, executor):
+        super().__init__()
+        self._ex = executor
+
+    def _force(self):
+        self._ex._materialize_pending()
+        if not len(self) and self._ex.outputs:
+            self.extend(self._ex.outputs)
+
+    def __getitem__(self, i):
+        self._force()
+        return list.__getitem__(self, i)
+
+    def __iter__(self):
+        self._force()
+        return list.__iter__(self)
+
+    def __len__(self):
+        self._force()
+        return list.__len__(self)

@@ -1,0 +1,41 @@
+"""mxnet_tpu.symbol — declarative graph API (reference python/mxnet/symbol)."""
+import sys as _sys
+
+from .symbol import (Symbol, var, Variable, Group, load, load_json, zeros,
+                     ones)
+from . import register as _register
+
+_register.attach_methods()
+_ns = _register.build_namespace()
+
+
+class _OpModule:
+    def __init__(self, entries):
+        self.__dict__.update(entries)
+
+
+op = _OpModule({k: v for k, v in _ns.items() if not k.startswith("_")})
+_internal = _OpModule({k: v for k, v in _ns.items() if k.startswith("_")})
+
+_mod = _sys.modules[__name__]
+for _name, _fn in _ns.items():
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _fn)
+
+
+def _scalar_aware(tensor_op, scalar_op, rscalar_op=None):
+    def fn(lhs, rhs):
+        if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+            return _ns[tensor_op](lhs, rhs)
+        if isinstance(lhs, Symbol):
+            return _ns[scalar_op](lhs, scalar=float(rhs))
+        if isinstance(rhs, Symbol):
+            return _ns[rscalar_op or scalar_op](rhs, scalar=float(lhs))
+        raise TypeError("at least one operand must be a Symbol")
+    return fn
+
+
+maximum = _scalar_aware("_maximum", "_maximum_scalar")
+minimum = _scalar_aware("_minimum", "_minimum_scalar")
+pow = _scalar_aware("_power", "_power_scalar", "_rpow_scalar")
+power = pow

@@ -1,0 +1,566 @@
+"""Symbol: declarative graph construction.
+
+Reference: python/mxnet/symbol/symbol.py (Symbol:53, infer_shape:929,
+simple_bind:1275, bind:1539) over the NNVM graph IR (empty submodule; its
+interface is visible through src/executor and src/c_api/c_api_symbolic.cc).
+
+TPU-native redesign: a Symbol is a lightweight DAG of (op, attrs, inputs)
+nodes — *no* separate graph IR or pass pipeline.  Compilation IS tracing the
+registry impls into one XLA program (see mxnet_tpu.executor); NNVM passes map
+as: Gradient ≡ jax.vjp, PlanMemory ≡ XLA buffer assignment + donation,
+InferShape/Type ≡ the fixed-point loop here (with fill_shapes for parameter
+inference), PlaceDevice/group2ctx ≡ sharding annotations (mxnet_tpu.parallel).
+
+JSON (de)serialization keeps the reference's node-list layout
+(op/"null", name, attrs-as-strings, inputs as [node_id, out_idx, version])
+so checkpoints remain structurally familiar.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+from ..base import MXNetError, NameManager, AttrScope, attrs_to_strings
+from ..ops import get_op
+from ..ops.registry import OpDef
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json", "zeros",
+           "ones"]
+
+
+class SymNode:
+    """One graph node (op node or variable)."""
+    __slots__ = ("op", "name", "attrs", "inputs", "_meta")
+
+    def __init__(self, op, name, attrs=None, inputs=None):
+        self.op = op          # OpDef or None for variable
+        self.name = name
+        self.attrs = dict(attrs or {})   # python-typed values
+        self.inputs = list(inputs or []) # list of (SymNode, out_index)
+        self._meta = {}
+
+    def num_outputs(self):
+        if self.op is None:
+            return 1
+        n = self.op.nout
+        return n(self.attrs) if callable(n) else n
+
+    def __repr__(self):
+        return "<SymNode %s %s>" % (self.op.name if self.op else "var", self.name)
+
+
+def _topo(heads):
+    """Topological order of nodes reachable from head entries."""
+    seen = {}
+    order = []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen[id(node)] = True
+        for (inp, _) in node.inputs:
+            visit(inp)
+        order.append(node)
+
+    for (n, _) in heads:
+        visit(n)
+    return order
+
+
+class Symbol:
+    """A list of output entries over a shared DAG."""
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # list of (SymNode, out_idx)
+
+    # -- identity / composition --------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or "group[%d]" % len(self._outputs))
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("output %r not found in %s" % (index, names))
+            index = names.index(index)
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        return Symbol([self._outputs[index]])
+
+    def get_internals(self):
+        """Symbol exposing every internal output (symbol.py get_internals)."""
+        outs = []
+        for node in _topo(self._outputs):
+            for i in range(node.num_outputs()):
+                outs.append((node, i))
+        return Symbol(outs)
+
+    def get_children(self):
+        if len(self._outputs) != 1:
+            return None
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # -- listing -----------------------------------------------------------
+    def list_outputs(self):
+        names = []
+        for (node, idx) in self._outputs:
+            if node.op is None:
+                names.append(node.name)
+            elif node.num_outputs() == 1:
+                names.append(node.name + "_output")
+            else:
+                names.append("%s_output%d" % (node.name, idx))
+        return names
+
+    def _aux_nodes(self):
+        aux = set()
+        for node in _topo(self._outputs):
+            if node.op is None:
+                continue
+            for ai in node.op.aux_inputs:
+                if ai < len(node.inputs):
+                    inp, _ = node.inputs[ai]
+                    if inp.op is None:
+                        aux.add(id(inp))
+        return aux
+
+    def list_arguments(self):
+        aux = self._aux_nodes()
+        return [n.name for n in _topo(self._outputs)
+                if n.op is None and id(n) not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_nodes()
+        return [n.name for n in _topo(self._outputs)
+                if n.op is None and id(n) in aux]
+
+    def list_inputs(self):
+        return [n.name for n in _topo(self._outputs) if n.op is None]
+
+    # -- attributes ---------------------------------------------------------
+    def attr(self, key):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].attrs.get(key)
+        return None
+
+    def attr_dict(self):
+        out = {}
+        for node in _topo(self._outputs):
+            a = {k: v for k, v in node.attrs.items()}
+            if a:
+                out[node.name] = attrs_to_strings(a)
+        return out
+
+    def _set_attr(self, **kwargs):
+        for (node, _) in self._outputs:
+            node.attrs.update(kwargs)
+
+    # -- shape/type inference ----------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    known[n] = tuple(s)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+        shapes, dtypes = _infer_graph(self._outputs, known, {}, partial=partial)
+        aux = set(self.list_auxiliary_states())
+        topo = _topo(self._outputs)
+        arg_shapes = [shapes.get((id(n), 0)) for n in topo
+                      if n.op is None and n.name not in aux]
+        aux_shapes = [shapes.get((id(n), 0)) for n in topo
+                      if n.op is None and n.name in aux]
+        out_shapes = [shapes.get((id(n), i)) for (n, i) in self._outputs]
+        if not partial and any(s is None for s in arg_shapes + out_shapes):
+            missing = [nm for nm, s in zip(self.list_arguments(), arg_shapes)
+                       if s is None]
+            raise MXNetError("infer_shape: incomplete; unknown args %s" % missing)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known_t = {}
+        if args:
+            for n, t in zip(arg_names, args):
+                if t is not None:
+                    known_t[n] = _np.dtype(t)
+        known_t.update({k: _np.dtype(v) for k, v in kwargs.items() if v is not None})
+        # types ride the same fixed-point machinery with a default f32 fill
+        shapes = {}
+        try:
+            shapes, dtypes = _infer_graph(self._outputs, {}, known_t, partial=True)
+        except MXNetError:
+            dtypes = {}
+        aux = set(self.list_auxiliary_states())
+        topo = _topo(self._outputs)
+        f32 = _np.dtype(_np.float32)
+        arg_types = [dtypes.get((id(n), 0), known_t.get(n.name, f32)) for n in topo
+                     if n.op is None and n.name not in aux]
+        aux_types = [dtypes.get((id(n), 0), f32) for n in topo
+                     if n.op is None and n.name in aux]
+        out_types = [dtypes.get((id(n), i), f32) for (n, i) in self._outputs]
+        return arg_types, out_types, aux_types
+
+    # -- arithmetic composition --------------------------------------------
+    def _binary(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _create(op, [a, b], {})
+        if isinstance(other, (int, float, _np.number, bool)):
+            return _create(scalar_op, [self], {"scalar": float(other)})
+        raise TypeError("cannot combine Symbol with %s" % type(other))
+
+    def __add__(self, o):
+        return self._binary(o, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        if isinstance(o, (int, float, _np.number, bool)):
+            return _create("_rminus_scalar", [self], {"scalar": float(o)})
+        return self._binary(o, "elemwise_sub", "_minus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elemwise_div", "_div_scalar")
+
+    __div__ = __truediv__
+
+    def __rtruediv__(self, o):
+        if isinstance(o, (int, float, _np.number, bool)):
+            return _create("_rdiv_scalar", [self], {"scalar": float(o)})
+        return self._binary(o, "elemwise_div", "_div_scalar", reverse=True)
+
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, o):
+        return self._binary(o, "_power", "_power_scalar")
+
+    def __neg__(self):
+        return _create("negative", [self], {})
+
+    def __mod__(self, o):
+        return self._binary(o, "_mod", "_mod_scalar")
+
+    def __eq__(self, o):
+        return self._binary(o, "equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        return self._binary(o, "not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self):
+        topo = _topo(self._outputs)
+        nid = {id(n): i for i, n in enumerate(topo)}
+        nodes = []
+        for n in topo:
+            nodes.append({
+                "op": "null" if n.op is None else n.op.name,
+                "name": n.name,
+                "attrs": attrs_to_strings(
+                    {k: v for k, v in n.attrs.items()}),
+                "inputs": [[nid[id(i)], ix, 0] for (i, ix) in n.inputs],
+            })
+        arg_nodes = [i for i, n in enumerate(topo) if n.op is None]
+        heads = [[nid[id(n)], ix, 0] for (n, ix) in self._outputs]
+        return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": list(range(len(nodes) + 1)),
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 1200]}},
+                          indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding ------------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from ..executor import Executor
+        return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs,
+                                     shared_exec=shared_exec)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    def gradient(self, wrt):
+        raise NotImplementedError(
+            "explicit gradient graphs are not materialized; Executor.backward "
+            "computes them via jax.vjp (symbol.py:1697 parity at executor level)")
+
+    # -- functional helpers used by module/gluon ---------------------------
+    def _compose_inputs(self):
+        return [n for n in _topo(self._outputs) if n.op is None]
+
+    def __call__(self, *args, **kwargs):
+        """Compose: replace this symbol's variables with given symbols."""
+        s = load_json(self.tojson())  # deep copy
+        name = kwargs.pop("name", None)
+        variables = s._compose_inputs()
+        mapping = {}
+        if args:
+            for v, a in zip(variables, args):
+                mapping[v.name] = a
+        mapping.update(kwargs)
+        for node in _topo(s._outputs):
+            new_inputs = []
+            for (inp, ix) in node.inputs:
+                if inp.op is None and inp.name in mapping:
+                    repl = mapping[inp.name]
+                    new_inputs.append(repl._outputs[0])
+                else:
+                    new_inputs.append((inp, ix))
+            node.inputs = new_inputs
+        return s
+
+
+# ---------------------------------------------------------------------------
+# inference engine (infer_graph_attr_pass.cc:64 analog — forward fixed point)
+# ---------------------------------------------------------------------------
+
+def _infer_graph(heads, known_shapes, known_dtypes, partial=False):
+    import jax
+    topo = _topo(heads)
+    shapes = {}
+    dtypes = {}
+    f32 = _np.dtype(_np.float32)
+    for n in topo:
+        if n.op is None:
+            if n.name in known_shapes:
+                shapes[(id(n), 0)] = tuple(known_shapes[n.name])
+            elif "__shape__" in n.attrs:
+                shapes[(id(n), 0)] = tuple(n.attrs["__shape__"])
+            if n.name in known_dtypes:
+                dtypes[(id(n), 0)] = known_dtypes[n.name]
+            elif "__dtype__" in n.attrs:
+                dtypes[(id(n), 0)] = _np.dtype(n.attrs["__dtype__"])
+
+    for _ in range(3):  # fixed point (params fill in on later passes)
+        progressed = False
+        for n in topo:
+            if n.op is None:
+                continue
+            if all((id(n), i) in shapes for i in range(n.num_outputs())):
+                continue
+            attrs = n.op.normalize(n.attrs)
+            in_keys = [(id(i), ix) for (i, ix) in n.inputs]
+            in_shapes = [shapes.get(k) for k in in_keys]
+            in_dtypes = [dtypes.get(k, f32) for k in in_keys]
+            if n.op.fill_shapes is not None:
+                filled = list(n.op.fill_shapes(attrs, list(in_shapes)))
+                for k, s_old, s_new in zip(in_keys, in_shapes, filled):
+                    if s_old is None and s_new is not None:
+                        shapes[k] = tuple(s_new)
+                        progressed = True
+                in_shapes = [shapes.get(k) for k in in_keys]
+            if any(s is None for s in in_shapes):
+                continue
+            try:
+                extra = {}
+                if n.op.stochastic:
+                    key_struct = jax.ShapeDtypeStruct((2,), _np.uint32)
+                structs = [jax.ShapeDtypeStruct(tuple(s), d)
+                           for s, d in zip(in_shapes, in_dtypes)]
+                if n.op.stochastic:
+                    out = jax.eval_shape(
+                        lambda k, *ins: n.op.bound(attrs, True)(
+                            jax.random.wrap_key_data(k), *ins),
+                        key_struct, *structs)
+                else:
+                    out = jax.eval_shape(n.op.bound(attrs, True), *structs)
+            except Exception as e:
+                if partial:
+                    continue
+                raise MXNetError("shape inference failed at %s(%s): %s"
+                                 % (n.op.name, n.name, e))
+            for i, o in enumerate(out):
+                shapes[(id(n), i)] = tuple(o.shape)
+                dtypes[(id(n), i)] = _np.dtype(o.dtype)
+            progressed = True
+        if not progressed:
+            break
+    return shapes, dtypes
+
+
+# ---------------------------------------------------------------------------
+# construction
+# ---------------------------------------------------------------------------
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    attrs = AttrScope.current().get(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = _np.dtype(dtype).name
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = float(lr_mult)
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = float(wd_mult)
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else init.dumps()
+    attrs.update(kwargs)
+    node = SymNode(None, name, attrs, [])
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def _create(op_name, input_syms, attrs, name=None):
+    """Create an op node from symbols (the MXSymbolCreateAtomicSymbol path)."""
+    opdef = op_name if isinstance(op_name, OpDef) else get_op(op_name)
+    hint = opdef.name.lower().replace("_", "")
+    name = NameManager.current().get(name, hint)
+    scope_attrs = {k: v for k, v in AttrScope.current().get({}).items()}
+    entries = []
+    for s in input_syms:
+        if len(s._outputs) != 1:
+            # multi-output symbol used as a single input: compose through the
+            # primary visible output (NNVM FNumVisibleOutputs semantics —
+            # e.g. BatchNorm(out, mean, var) feeds downstream via `out`)
+            node0 = s._outputs[0][0]
+            nvis = node0.op.num_visible_outputs if node0.op else 1
+            if callable(nvis):
+                nvis = nvis(node0.attrs)
+            if all(n is node0 for (n, _) in s._outputs) and nvis == 1:
+                entries.append(s._outputs[0])
+                continue
+            raise MXNetError("op %s: cannot take multi-output symbol as one "
+                             "input" % opdef.name)
+        entries.append(s._outputs[0])
+    a = dict(attrs)
+    if opdef.variable_inputs and opdef.key_var_num_args:
+        a.setdefault(opdef.key_var_num_args, len(entries))
+    norm = opdef.normalize(a)
+    # auto-create missing parameter/aux variables (reference behaviour:
+    # sym.Convolution(data=x) invents convX_weight / convX_bias vars)
+    expected = opdef.input_names(norm, num_inputs=len(entries))
+    if not opdef.variable_inputs and len(entries) < len(expected):
+        for miss in expected[len(entries):]:
+            v = var("%s_%s" % (name, miss))
+            entries.append(v._outputs[0])
+    keep = {k: v for k, v in norm.items()}
+    keep.update({k: v for k, v in scope_attrs.items() if k.startswith("__")})
+    node = SymNode(opdef, name, keep, entries)
+    nout = node.num_outputs()
+    return Symbol([(node, i) for i in range(nout)])
+
+
+# -- creation ops over symbols ------------------------------------------------
+
+def zeros(shape, dtype=None, **kwargs):
+    return _create("_zeros", [], {"shape": tuple(shape) if not isinstance(shape, int) else (shape,),
+                                  "dtype": _np.dtype(dtype or _np.float32).name})
+
+
+def ones(shape, dtype=None, **kwargs):
+    return _create("_ones", [], {"shape": tuple(shape) if not isinstance(shape, int) else (shape,),
+                                 "dtype": _np.dtype(dtype or _np.float32).name})
+
+
+# ---------------------------------------------------------------------------
+# deserialization
+# ---------------------------------------------------------------------------
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes_js = data["nodes"]
+    built = []
+    for nj in nodes_js:
+        raw_attrs = nj.get("attrs", nj.get("param", {})) or {}
+        if nj["op"] == "null":
+            node = SymNode(None, nj["name"], _parse_var_attrs(raw_attrs), [])
+        else:
+            opdef = get_op(nj["op"])
+            inputs = [(built[i], ix) for (i, ix, *_) in nj["inputs"]]
+            meta = {k: v for k, v in raw_attrs.items() if k.startswith("__")}
+            core = {k: v for k, v in raw_attrs.items() if not k.startswith("__")}
+            attrs = opdef.normalize(core)
+            attrs.update(meta)
+            node = SymNode(opdef, nj["name"], attrs, inputs)
+        built.append(node)
+    heads = [(built[i], ix) for (i, ix, *_) in data["heads"]]
+    return Symbol(heads)
+
+
+def _parse_var_attrs(raw):
+    from ..base import _parse_tuple
+    out = dict(raw)
+    if "__shape__" in out and isinstance(out["__shape__"], str):
+        out["__shape__"] = _parse_tuple(out["__shape__"], int)
+    return out
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())

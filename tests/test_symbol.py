@@ -1,0 +1,166 @@
+"""Symbol/Executor/CachedOp tests (reference: tests/python/unittest/
+test_symbol.py, test_executor.py, test_infer_shape.py)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+
+
+def _mlp():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    act1 = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act1, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.var("softmax_label"), name="softmax")
+
+
+def test_compose_and_listing():
+    out = _mlp()
+    assert out.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias", "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+    assert out.list_auxiliary_states() == []
+
+
+def test_infer_shape():
+    out = _mlp()
+    a, o, x = out.infer_shape(data=(32, 100), softmax_label=(32,))
+    assert a == [(32, 100), (32, 100), (32,), (10, 32), (10,), (32,)]
+    assert o == [(32, 10)]
+    arg_t, out_t, _ = out.infer_type()
+    assert all(t == np.float32 for t in arg_t)
+
+
+def test_infer_shape_conv():
+    data = sym.var("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1), name="c")
+    b = sym.BatchNorm(c, name="bn")
+    p = sym.Pooling(b, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f = sym.FullyConnected(sym.Flatten(p), num_hidden=10, name="fc")
+    a, o, x = f.infer_shape(data=(2, 3, 8, 8))
+    assert o == [(2, 10)]
+    d = dict(zip(f.list_arguments(), a))
+    assert d["c_weight"] == (8, 3, 3, 3)
+    assert d["bn_gamma"] == (8,)
+    aux = dict(zip(f.list_auxiliary_states(), x))
+    assert aux["bn_moving_mean"] == (8,)
+
+
+def test_json_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    back = sym.load_json(js)
+    assert back.list_arguments() == out.list_arguments()
+    a, o, _ = back.infer_shape(data=(4, 10), softmax_label=(4,))
+    assert o == [(4, 10)]
+
+
+def test_symbol_arithmetic():
+    x = sym.var("x")
+    y = sym.var("y")
+    z = (x + y) * 2 - x / y
+    ex = z.bind(mx.cpu(), {"x": nd.array([2.0]), "y": nd.array([1.0])})
+    out = ex.forward()
+    assert float(out[0].asscalar()) == pytest.approx(4.0)
+
+
+def test_group_and_internals():
+    x = sym.var("x")
+    a = x * 2
+    b = x + 1
+    g = sym.Group([a, b])
+    assert len(g.list_outputs()) == 2
+    out = _mlp()
+    internals = out.get_internals()
+    assert "relu1_output" in internals.list_outputs()
+    sub = internals["relu1_output"]
+    a2, o2, _ = sub.infer_shape(data=(4, 20))
+    assert o2 == [(4, 32)]
+
+
+def test_executor_backward():
+    x = sym.var("x")
+    y = (x * x).sum()  # wait: sum over what; use sym ops
+    ex = y.bind(mx.cpu(), {"x": nd.array([1.0, 2.0, 3.0])},
+                args_grad={"x": nd.zeros((3,))})
+    ex.forward(is_train=True)
+    ex.backward()
+    assert np.allclose(ex.grad_dict["x"].asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_executor_grad_req_add():
+    x = sym.var("x")
+    y = x * 3
+    gx = nd.zeros((2,))
+    ex = y.bind(mx.cpu(), {"x": nd.array([1.0, 1.0])}, args_grad={"x": gx},
+                grad_req="add")
+    for _ in range(3):
+        ex.forward(is_train=True)
+        ex.backward(nd.ones((2,)))
+    assert ex.grad_dict["x"].asnumpy().tolist() == [9.0, 9.0]
+
+
+def test_executor_training_e2e():
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(32, 50), softmax_label=(32,))
+    rng = np.random.RandomState(0)
+    for name in ["fc1_weight", "fc2_weight"]:
+        ex.arg_dict[name]._data = jnp.asarray(
+            rng.randn(*ex.arg_dict[name].shape).astype("float32") * 0.1)
+    X = rng.randn(32, 50).astype("float32")
+    Y = rng.randint(0, 10, (32,)).astype("float32")
+    lr = 0.5 / 32
+    for _ in range(60):
+        ex.forward(is_train=True, data=X, softmax_label=Y)
+        ex.backward()
+        for n in out.list_arguments():
+            if n in ("data", "softmax_label"):
+                continue
+            ex.arg_dict[n]._data = ex.arg_dict[n]._data - lr * ex.grad_dict[n]._data
+    acc = (ex.outputs[0].asnumpy().argmax(1) == Y).mean()
+    assert acc > 0.9
+
+
+def test_executor_aux_update():
+    data = sym.var("data")
+    bn = sym.BatchNorm(data, name="bn")
+    ex = bn.simple_bind(mx.cpu(), data=(8, 4))
+    before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True,
+               data=np.random.RandomState(0).randn(8, 4).astype("float32") * 5)
+    ex.backward()
+    after = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_cached_op():
+    out = _mlp()
+    op = mx.CachedOp(out)
+    rng = np.random.RandomState(0)
+    vals = {
+        "data": nd.array(rng.randn(4, 20).astype("float32")),
+        "fc1_weight": nd.array(rng.randn(32, 20).astype("float32") * 0.1),
+        "fc1_bias": nd.zeros((32,)),
+        "fc2_weight": nd.array(rng.randn(10, 32).astype("float32") * 0.1),
+        "fc2_bias": nd.zeros((10,)),
+        "softmax_label": nd.array(rng.randint(0, 10, (4,)).astype("float32")),
+    }
+    inputs = [vals[n] for n in op.input_names]
+    o1 = op(*inputs)
+    assert o1.shape == (4, 10)
+    # gradient through CachedOp as one tape node
+    vals["fc1_weight"].attach_grad()
+    with mx.autograd.record():
+        o2 = op(*inputs)
+    o2.backward()
+    g = vals["fc1_weight"].grad.asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_simple_bind_var_shape_attr():
+    x = sym.var("x", shape=(2, 2))
+    y = x * 2
+    a, o, _ = y.infer_shape()
+    assert o == [(2, 2)]
