@@ -1,0 +1,415 @@
+"""Weight initializers.
+
+Reference: python/mxnet/initializer.py — registry + magic-name dispatch
+(InitDesc carries the param name; `_weight` → weight init, `_bias` → zeros,
+`_gamma` → ones, ... ), Uniform/Normal/Xavier/MSRAPrelu/Orthogonal/Bilinear/
+LSTMBias/One/Zero/Constant/Load/Mixed.
+
+Initialization runs host-side with numpy then lands on device — init is a
+one-time cost, and numpy keeps the reference's exact RNG-free semantics for
+deterministic inits (Bilinear, LSTMBias) while random inits use the global
+numpy seed exactly like the reference.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+
+import numpy as np
+
+from .base import MXNetError, string_types
+from .ndarray import NDArray, array, load
+
+__all__ = ["InitDesc", "Initializer", "Uniform", "Normal", "Xavier",
+           "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias", "One", "Zero",
+           "Constant", "Load", "Mixed", "FusedRNN", "register", "create"]
+
+_INIT_REGISTRY = {}
+
+
+class InitDesc(str):
+    """Name + attrs descriptor passed to initializers (initializer.py:37)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+def register(klass):
+    """Register an initializer under its lowercase class name."""
+    name = klass.__name__.lower()
+    if name in _INIT_REGISTRY:
+        logging.warning("New initializer %s is overriding existing "
+                        "initializer %s", klass.__name__, name)
+    _INIT_REGISTRY[name] = klass
+    klass._init_name = name
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    if callable(name):
+        return name
+    name = name.lower()
+    if name not in _INIT_REGISTRY:
+        raise MXNetError("unknown initializer %r" % name)
+    return _INIT_REGISTRY[name](**kwargs)
+
+
+class Initializer(object):
+    """Base initializer with magic-name dispatch (initializer.py:68)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        if print_func is None:
+            def asum_stat(x):
+                return str((np.abs(x.asnumpy()).mean(),))
+            print_func = asum_stat
+        self._print_func = print_func
+        return self
+
+    def _verbose_print(self, desc, init, arr):
+        if self._verbose and self._print_func:
+            logging.info("Initialized %s as %s: %s", desc, init,
+                         self._print_func(arr))
+
+    def dumps(self):
+        """JSON [name, kwargs] — the reference's serialization for sending
+        the initializer to kvstore servers."""
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, string_types):
+            raise TypeError("desc must be an InitDesc or string")
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(desc)
+
+        if desc.global_init is None:
+            desc.global_init = self
+        init = desc.attrs.get("__init__", "")
+
+        if init:
+            create(init)._init_weight(desc, arr)
+            self._verbose_print(desc, init, arr)
+            return
+        # magic-name dispatch
+        if desc.endswith("weight"):
+            self._init_weight(desc, arr)
+            self._verbose_print(desc, "weight", arr)
+        elif desc.endswith("bias"):
+            self._init_bias(desc, arr)
+            self._verbose_print(desc, "bias", arr)
+        elif desc.endswith("gamma"):
+            self._init_gamma(desc, arr)
+            self._verbose_print(desc, "gamma", arr)
+        elif desc.endswith("beta"):
+            self._init_beta(desc, arr)
+            self._verbose_print(desc, "beta", arr)
+        elif desc.endswith("min"):
+            self._init_zero(desc, arr)
+            self._verbose_print(desc, "min", arr)
+        elif desc.endswith("max"):
+            self._init_one(desc, arr)
+            self._verbose_print(desc, "max", arr)
+        elif desc.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif desc.endswith("moving_var"):
+            self._init_one(desc, arr)
+        elif desc.endswith("moving_inv_var"):
+            self._init_zero(desc, arr)
+        elif desc.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def _set(self, arr, value):
+        arr[:] = value
+
+    def _init_bias(self, _, arr):
+        self._set(arr, 0.0)
+
+    def _init_gamma(self, _, arr):
+        self._set(arr, 1.0)
+
+    def _init_beta(self, _, arr):
+        self._set(arr, 0.0)
+
+    def _init_zero(self, _, arr):
+        self._set(arr, 0.0)
+
+    def _init_one(self, _, arr):
+        self._set(arr, 1.0)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("Must override it")
+
+    def _init_default(self, name, _):
+        raise ValueError(
+            "Unknown initialization pattern for %s. Default initialization "
+            "is now limited to \"weight\", \"bias\", \"gamma\" (1.0), and "
+            "\"beta\" (0.0). Please use mx.sym.Variable(init=mx.init.*) to "
+            "set initialization pattern" % name)
+
+
+@register
+class Zero(Initializer):
+    def __init__(self):
+        super().__init__()
+
+    def _init_weight(self, _, arr):
+        self._set(arr, 0.0)
+
+
+@register
+class One(Initializer):
+    def __init__(self):
+        super().__init__()
+
+    def _init_weight(self, _, arr):
+        self._set(arr, 1.0)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        self._set(arr, self.value)
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (initializer.py Uniform)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        arr[:] = np.random.uniform(-self.scale, self.scale,
+                                   arr.shape).astype(arr.dtype)
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) (initializer.py Normal)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        arr[:] = np.random.normal(0, self.sigma, arr.shape).astype(arr.dtype)
+
+
+@register
+class Load(object):
+    """Init from a dict/file of arrays, falling back to default_init."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            param = load(param)
+        self.param = {}
+        for name, arr in param.items():
+            if name.startswith("arg:") or name.startswith("aux:"):
+                self.param[name[4:]] = arr
+            else:
+                self.param[name] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            if arr.shape != self.param[name].shape:
+                raise ValueError(
+                    "Parameter %s cannot be initialized from loading. "
+                    "Shape mismatch, target %s vs loaded %s"
+                    % (name, str(arr.shape), str(self.param[name].shape)))
+            arr[:] = self.param[name].asnumpy()
+            if self.verbose:
+                logging.info("Initialized %s by loading", name)
+        else:
+            if self.default_init is None:
+                raise ValueError(
+                    "Cannot Initialize parameter %s. Not found in loaded "
+                    "param and no default initialization is provided." % name)
+            self.default_init(name, arr)
+            if self.verbose:
+                logging.info("Initialized %s by default", name)
+
+
+@register
+class Mixed(object):
+    """Regex-pattern dispatch to multiple initializers."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must have the same length")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError(
+            "Parameter name %s did not match any pattern. Consider adding a "
+            "\".*\" pattern at the and with default Initializer." % name)
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (initializer.py Xavier): scale by fan-in/out."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.
+        if len(shape) < 2:
+            raise ValueError("Xavier initializer cannot be applied to vector "
+                             "%s. It requires at least 2D." % name)
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = np.random.uniform(-scale, scale, shape).astype(arr.dtype)
+        elif self.rnd_type == "gaussian":
+            arr[:] = np.random.normal(0, scale, shape).astype(arr.dtype)
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He/MSRA init adjusted for PReLU (initializer.py MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2. / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    """Orthogonal matrix init via SVD of a random gaussian."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = np.linalg.svd(tmp, full_matrices=False)
+        res = u if u.shape == tmp.shape else v
+        res = self.scale * res.reshape(arr.shape)
+        arr[:] = res.astype(arr.dtype)
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (deterministic; initializer.py Bilinear)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def _init_weight(self, _, arr):
+        weight = np.zeros(np.prod(arr.shape), dtype="float32")
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.)
+        c = (2 * f - 1 - f % 2) / (2. * f)
+        for i in range(np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape).astype(arr.dtype)
+
+
+@register
+class LSTMBias(Initializer):
+    """Set the forget-gate bias to a constant, others 0 (initializer.py)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = np.zeros(arr.shape, dtype=arr.dtype)
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = b
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize the packed parameter blob of a fused RNN cell by
+    initializing each logical piece then packing (initializer.py FusedRNN)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode, bidirectional=False,
+                 forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = _INIT_REGISTRY[klass.lower()](**kwargs)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .rnn import rnn_cell
+        cell = rnn_cell.FusedRNNCell(self._num_hidden, self._num_layers,
+                                     self._mode, self._bidirectional,
+                                     forget_bias=self._forget_bias,
+                                     prefix="")
+        args = cell.unpack_weights({cell._parameter.name: NDArray(arr.asnumpy())
+                                    if not isinstance(arr, NDArray) else arr})
+        for name in args:
+            arg_desc = InitDesc(name, global_init=desc.global_init)
+            # for lstm bias, we use a custom initializer which adds a bias to
+            # the forget gate (reference FusedRNN._init_weight)
+            if self._mode == "lstm" and name.endswith("_f_bias"):
+                args[name][:] = self._forget_bias
+            elif self._init is None:
+                desc.global_init(arg_desc, args[name])
+            else:
+                self._init(arg_desc, args[name])
+        arr[:] = cell.pack_weights(args)[cell._parameter.name].asnumpy()

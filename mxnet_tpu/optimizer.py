@@ -1,0 +1,646 @@
+"""Optimizer registry + 12 optimizers + Updater.
+
+Reference: python/mxnet/optimizer.py — base `Optimizer:35` with registry,
+SGD:433, DCASGD:534, NAG:590, SGLD:626, Adam:661, AdaGrad:738, RMSProp:806,
+AdaDelta:882, Ftrl:932, Adamax:1008, Nadam:1057, and `Updater:1142` (the
+client-side per-key state store, serializable so distributed servers can run
+the same update — kvstore.py:460).
+
+TPU-native redesign: the hot optimizers (SGD/Adam/RMSProp/Ftrl/SignSGD) call
+the fused update *ops* (mxnet_tpu/ops/optimizer_ops.py), so every update is a
+single XLA computation on-device, and the Module/Trainer fast path can inline
+these same impls into the jitted train step (the `update_on_kvstore` collapse).
+The long-tail optimizers are jnp math through the same invoke path.  All
+hyper-params (lr, wd) stay Python scalars passed per call — jit caches one
+program per op config, not per lr value.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+
+import numpy
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros, ones, full, invoke
+from .ndarray import sgd_update, sgd_mom_update, mp_sgd_update, \
+    mp_sgd_mom_update, adam_update, rmsprop_update, rmspropalex_update, \
+    ftrl_update, signsgd_update, signum_update
+
+__all__ = ["Optimizer", "SGD", "DCASGD", "NAG", "SGLD", "ccSGD", "Adam",
+           "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam",
+           "Signum", "SignSGD", "Test", "Updater", "get_updater", "create",
+           "register"]
+
+
+class Optimizer(object):
+    """Base optimizer; mirrors python/mxnet/optimizer.py:35 API."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        assert isinstance(klass, type)
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            logging.warning("WARNING: New optimizer %s.%s is overriding "
+                            "existing optimizer %s.%s", klass.__module__,
+                            klass.__name__,
+                            Optimizer.opt_registry[name].__module__,
+                            Optimizer.opt_registry[name].__name__)
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    def create_state(self, index, weight):
+        """Create per-weight auxiliary state (momentum etc.)."""
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def set_lr_mult(self, args_lr_mult):
+        """Per-param lr multipliers, seeded from symbol __lr_mult__ attrs."""
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """Per-param wd multipliers; bias/gamma/beta default to wd 0 like the
+        reference (optimizer.py set_wd_mult)."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight")
+            if not (is_weight or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common_attrs(self, index):
+        a = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+             "rescale_grad": self.rescale_grad}
+        if self.clip_gradient:
+            a["clip_gradient"] = self.clip_gradient
+        return a
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("sym", None)
+        return d
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.sym = None
+
+
+register = Optimizer.register  # pylint: disable=invalid-name
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional fp16 multi-precision master weights.
+
+    Reference: optimizer.py:433 + fused ops src/operator/optimizer_op.cc:39-128.
+    """
+
+    def __init__(self, momentum=0.0, multi_precision=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.multi_precision = multi_precision
+
+    def create_state(self, index, weight):
+        momentum = None
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == numpy.float16:
+            weight_master_copy = weight.astype(numpy.float32)
+            if self.momentum != 0.0:
+                momentum = zeros(weight.shape, weight.context,
+                                 dtype=numpy.float32)
+            return (momentum, weight_master_copy)
+        if weight.dtype == numpy.float16 and not self.multi_precision:
+            logging.warning("Accumulating with float16 in optimizer can lead "
+                            "to poor accuracy or slow convergence. Consider "
+                            "using multi_precision=True option of the SGD "
+                            "optimizer")
+        if self.momentum != 0.0:
+            momentum = zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return momentum
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kwargs = self._common_attrs(index)
+        if self.momentum > 0:
+            kwargs["momentum"] = self.momentum
+        use_mp = isinstance(state, (list, tuple))
+        if not use_mp:
+            if state is not None:
+                sgd_mom_update(weight, grad, state, out=weight, **kwargs)
+            else:
+                sgd_update(weight, grad, out=weight, **kwargs)
+        else:
+            if state[0] is not None:
+                mp_sgd_mom_update(weight, grad, state[0], state[1],
+                                  out=weight, **kwargs)
+            else:
+                mp_sgd_update(weight, grad, state[1], out=weight, **kwargs)
+
+
+@register
+class SignSGD(Optimizer):
+    """Takes the sign of the gradient (optimizer_op.cc signsgd_update)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        signsgd_update(weight, grad, out=weight, **self._common_attrs(index))
+
+
+@register
+class Signum(Optimizer):
+    """Signum: sign of momentum (optimizer_op.cc signum_update)."""
+
+    def __init__(self, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kwargs = self._common_attrs(index)
+        if self.wd_lh:
+            kwargs["wd_lh"] = self.wd_lh
+        if state is not None:
+            kwargs["momentum"] = self.momentum
+            signum_update(weight, grad, state, out=weight, **kwargs)
+        else:
+            signsgd_update(weight, grad, out=weight, **kwargs)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (optimizer.py:534)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = invoke("clip", [grad], {"a_min": -self.clip_gradient,
+                                           "a_max": self.clip_gradient})
+        mom, previous_weight = state
+        delta = -lr * (grad + wd * weight
+                       + self.lamda * grad * grad * (weight - previous_weight))
+        if mom is not None:
+            mom *= self.momentum
+            mom += delta
+            d = mom
+        else:
+            d = delta
+        previous_weight._data = weight._data
+        weight += d
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (optimizer.py:590)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = invoke("clip", [grad], {"a_min": -self.clip_gradient,
+                                           "a_max": self.clip_gradient})
+        if state is not None:
+            mom = state
+            mom *= self.momentum
+            grad = grad + wd * weight
+            mom += grad
+            grad = grad + self.momentum * mom
+            weight += -lr * grad
+        else:
+            weight += -lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (optimizer.py:626)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        from . import ndarray as nd
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = invoke("clip", [grad], {"a_min": -self.clip_gradient,
+                                           "a_max": self.clip_gradient})
+        noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 ctx=weight.context, dtype=weight.dtype)
+        weight += -lr / 2 * (grad + wd * weight) + noise
+
+
+@register  # noqa: F811
+class ccSGD(SGD):
+    """Back-compat alias of SGD (optimizer.py ccSGD)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+
+@register
+class Adam(Optimizer):
+    """Adam (optimizer.py:661, fused op optimizer_op.cc:146)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # mean
+                zeros(weight.shape, weight.context, dtype=weight.dtype))  # var
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kwargs = self._common_attrs(index)
+        kwargs.update({"beta1": self.beta1, "beta2": self.beta2,
+                       "epsilon": self.epsilon})
+        # bias correction folded into lr, as the reference does
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        kwargs["lr"] *= math.sqrt(coef2) / coef1
+        mean, var = state
+        adam_update(weight, grad, mean, var, out=weight, **kwargs)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (optimizer.py:738)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)  # history
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = invoke("clip", [grad], {"a_min": -self.clip_gradient,
+                                           "a_max": self.clip_gradient})
+        history = state
+        history += grad * grad
+        div = grad / invoke("sqrt", [history + self.float_stable_eps], {})
+        weight += (div + weight * wd) * -lr
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, centered (Graves) or plain (Tieleman); optimizer.py:806,
+    fused ops optimizer_op.cc:195/245."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, weight.context),  # n
+                    zeros(weight.shape, weight.context),  # g
+                    zeros(weight.shape, weight.context))  # delta
+        return zeros(weight.shape, weight.context)  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kwargs = self._common_attrs(index)
+        kwargs.update({"gamma1": self.gamma1, "epsilon": self.epsilon})
+        if self.centered:
+            kwargs["gamma2"] = self.gamma2
+        if self.clip_weights:
+            kwargs["clip_weights"] = self.clip_weights
+        if not self.centered:
+            n = state
+            rmsprop_update(weight, grad, n, out=weight, **kwargs)
+        else:
+            n, g, delta = state
+            rmspropalex_update(weight, grad, n, g, delta, out=weight, **kwargs)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (optimizer.py:882)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),  # accumulated g
+                zeros(weight.shape, weight.context))  # accumulated delta
+
+    def update(self, index, weight, grad, state):
+        from . import ndarray as nd
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = invoke("clip", [grad], {"a_min": -self.clip_gradient,
+                                           "a_max": self.clip_gradient})
+        acc_g, acc_delta = state
+        acc_g._data = (self.rho * acc_g + (1. - self.rho) * grad * grad)._data
+        current_delta = (nd.sqrt(acc_delta + self.epsilon)
+                         / nd.sqrt(acc_g + self.epsilon)) * grad
+        acc_delta._data = (self.rho * acc_delta
+                           + (1. - self.rho) * current_delta * current_delta)._data
+        weight -= current_delta + wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (optimizer.py:932, fused op optimizer_op.cc:286)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context),  # z
+                zeros(weight.shape, weight.context))  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kwargs = self._common_attrs(index)
+        kwargs.update({"lamda1": self.lamda1, "beta": self.beta})
+        z, n = state
+        ftrl_update(weight, grad, z, n, out=weight, **kwargs)
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax, the infinity-norm Adam variant (optimizer.py:1008)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # mean
+                zeros(weight.shape, weight.context, dtype=weight.dtype))  # variance
+
+    def update(self, index, weight, grad, state):
+        from . import ndarray as nd
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1. - self.beta1 ** t)
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = invoke("clip", [grad], {"a_min": -self.clip_gradient,
+                                           "a_max": self.clip_gradient})
+        m_t, u_t = state
+        m_t._data = (self.beta1 * m_t + (1. - self.beta1) * grad)._data
+        u_t._data = nd.maximum(self.beta2 * u_t, nd.abs(grad))._data
+        weight -= lr * m_t / (u_t + 1e-8)
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (optimizer.py:1057)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # mean
+                zeros(weight.shape, weight.context, dtype=weight.dtype))  # variance
+
+    def update(self, index, weight, grad, state):
+        from . import ndarray as nd
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = invoke("clip", [grad], {"a_min": -self.clip_gradient,
+                                           "a_max": self.clip_gradient})
+        momentum_t = self.beta1 * (1. - 0.5 * (pow(0.96, t * self.schedule_decay)))
+        momentum_t_1 = self.beta1 * (1. - 0.5 * (pow(0.96, (t + 1) * self.schedule_decay)))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t._data = (self.beta1 * m_t + (1. - self.beta1) * grad)._data
+        v_t._data = (self.beta2 * v_t + (1. - self.beta2) * grad * grad)._data
+        grad_prime = grad / (1. - self.m_schedule)
+        m_t_prime = m_t / (1. - m_schedule_next)
+        v_t_prime = v_t / (1. - pow(self.beta2, t))
+        m_t_bar = (1. - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        weight -= lr * m_t_bar / (nd.sqrt(v_t_prime) + self.epsilon)
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer used by the reference's tests (optimizer.py Test)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state._data = weight._data
+
+
+create = Optimizer.create_optimizer  # pylint: disable=invalid-name
+
+
+class Updater(object):
+    """Per-key state store applying an optimizer; serializable for dist
+    servers (reference optimizer.py:1142 + kvstore.py:460)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+            self.states_synced[index] = True
+        elif not self.states_synced[index]:
+            self.states[index] = self.sync_state_context(self.states[index],
+                                                         weight.context)
+            self.states_synced[index] = True
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def sync_state_context(self, state, context):
+        if isinstance(state, NDArray):
+            return state.as_in_context(context)
+        if isinstance(state, (tuple, list)):
+            synced_state = (self.sync_state_context(i, context) for i in state)
+            if isinstance(state, tuple):
+                return tuple(synced_state)
+            return list(synced_state)
+        return state
+
+    def set_states(self, states):
+        """Load serialized states (numpy-backed pickle)."""
+        states = pickle.loads(states)
+
+        def to_nd(v):
+            if isinstance(v, numpy.ndarray):
+                return NDArray(v)
+            if isinstance(v, (tuple, list)):
+                return type(v)(to_nd(x) for x in v)
+            return v
+        self.states = {k: to_nd(v) for k, v in states.items()}
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        """Serialize states (+optionally the optimizer itself)."""
+        def to_np(v):
+            if isinstance(v, NDArray):
+                return v.asnumpy()
+            if isinstance(v, (tuple, list)):
+                return type(v)(to_np(x) for x in v)
+            return v
+        states = {k: to_np(v) for k, v in self.states.items()}
+        return pickle.dumps((states, self.optimizer) if dump_optimizer
+                            else states)
+
+
+def get_updater(optimizer):
+    """Returns a closure-style updater (reference optimizer.py get_updater)."""
+    return Updater(optimizer)

@@ -1,0 +1,123 @@
+"""Metric + initializer tests (reference test_metric.py / test_init.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric, initializer
+
+
+def test_accuracy():
+    m = metric.create("acc")
+    pred = mx.nd.array([[0.3, 0.7], [0.8, 0.2], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_top_k():
+    m = metric.create("top_k_accuracy", top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1]])
+    label = mx.nd.array([2, 1])
+    m.update([label], [pred])
+    _, acc = m.get()
+    assert abs(acc - 1.0) < 1e-6  # both labels in top-2
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([1.0, 2.0, 3.0])
+    label = mx.nd.array([1.5, 2.0, 2.5])
+    for name, expect in [("mse", (0.25 + 0 + 0.25) / 3),
+                         ("mae", (0.5 + 0 + 0.5) / 3)]:
+        m = metric.create(name)
+        m.update([label], [pred])
+        assert abs(m.get()[1] - expect) < 1e-6
+    m = metric.create("rmse")
+    m.update([label], [pred])
+    assert abs(m.get()[1] - np.sqrt(0.5 / 3)) < 1e-6
+
+
+def test_perplexity():
+    m = metric.create("perplexity", ignore_label=None)
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    m.update([label], [pred])
+    _, ppl = m.get()
+    expect = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(ppl - expect) < 1e-5
+
+
+def test_composite_and_custom():
+    m = metric.create(["acc", "mse"])
+    assert isinstance(m, metric.CompositeEvalMetric)
+
+    def my_metric(label, pred):
+        return float(np.sum(label == pred.argmax(axis=1))), label.shape[0]
+    c = metric.np(my_metric)
+    pred = mx.nd.array([[0.3, 0.7], [0.8, 0.2]])
+    label = mx.nd.array([1, 0])
+    c.update([label], [pred])
+    assert c.get()[1] == 1.0
+
+
+def test_initializers_shapes_and_stats():
+    np.random.seed(0)
+    for name, kwargs in [("uniform", {"scale": 0.1}),
+                         ("normal", {"sigma": 0.01}),
+                         ("xavier", {}),
+                         ("msraprelu", {}),
+                         ("orthogonal", {})]:
+        init = initializer.create(name, **kwargs)
+        arr = mx.nd.zeros((16, 8))
+        init(initializer.InitDesc("fc1_weight"), arr)
+        a = arr.asnumpy()
+        assert a.shape == (16, 8)
+        assert np.abs(a).sum() > 0
+
+    # orthogonality
+    o = mx.nd.zeros((8, 8))
+    initializer.Orthogonal(scale=1.0)(initializer.InitDesc("q_weight"), o)
+    q = o.asnumpy()
+    np.testing.assert_allclose(q @ q.T, np.eye(8), atol=1e-5)
+
+
+def test_magic_name_dispatch():
+    init = initializer.Uniform(1.0)
+    bias = mx.nd.ones((4,))
+    init(initializer.InitDesc("fc1_bias"), bias)
+    assert np.all(bias.asnumpy() == 0)
+    gamma = mx.nd.zeros((4,))
+    init(initializer.InitDesc("bn_gamma"), gamma)
+    assert np.all(gamma.asnumpy() == 1)
+    mv = mx.nd.ones((4,))
+    init(initializer.InitDesc("bn_moving_mean"), mv)
+    assert np.all(mv.asnumpy() == 0)
+
+
+def test_attr_init_override():
+    init = initializer.Zero()
+    arr = mx.nd.zeros((4, 4))
+    desc = initializer.InitDesc("custom", attrs={"__init__": initializer.One().dumps()[2:5]})
+    # __init__ attr carries a registered name; use "one"
+    desc = initializer.InitDesc("custom", attrs={"__init__": "one"})
+    init(desc, arr)
+    assert np.all(arr.asnumpy() == 1)
+
+
+def test_mixed_and_constant():
+    init = initializer.Mixed([".*fc2.*", ".*"],
+                             [initializer.Constant(3.0), initializer.Uniform(0.1)])
+    w = mx.nd.zeros((4, 4))
+    init("fc2_weight", w)
+    assert np.all(w.asnumpy() == 3.0)
+    # magic-name dispatch still applies inside Mixed (reference semantics)
+    b = mx.nd.ones((4,))
+    init("fc1_bias", b)
+    assert np.all(b.asnumpy() == 0.0)
+
+
+def test_bilinear():
+    arr = mx.nd.zeros((1, 1, 4, 4))
+    initializer.Bilinear()(initializer.InitDesc("up_weight"), arr)
+    a = arr.asnumpy()[0, 0]
+    assert a.max() <= 1.0 and a[1, 1] > a[0, 0]

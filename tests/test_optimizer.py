@@ -1,0 +1,135 @@
+"""Optimizer tests — numpy-oracle comparisons per the reference's
+tests/python/unittest/test_optimizer.py pattern (compare against a plain
+numpy re-implementation for a few steps)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _run_steps(optimizer, w0, grads):
+    w = mx.nd.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for g in grads:
+        optimizer.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(4, 3).astype(np.float32)
+    grads = [rng.randn(4, 3).astype(np.float32) for _ in range(5)]
+    lr, wd, mom = 0.1, 0.01, 0.9
+
+    got = _run_steps(opt.create("sgd", learning_rate=lr, wd=wd, momentum=mom),
+                     w0, grads)
+
+    w = w0.copy()
+    m = np.zeros_like(w)
+    for g in grads:
+        m = mom * m - lr * (g + wd * w)
+        w = w + m
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_no_momentum_clip():
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(10).astype(np.float32)
+    grads = [10 * rng.randn(10).astype(np.float32) for _ in range(3)]
+    lr, clip = 0.05, 0.5
+    got = _run_steps(opt.create("sgd", learning_rate=lr, clip_gradient=clip),
+                     w0, grads)
+    w = w0.copy()
+    for g in grads:
+        w = w - lr * np.clip(g, -clip, clip)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(6).astype(np.float32)
+    grads = [rng.randn(6).astype(np.float32) for _ in range(4)]
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    got = _run_steps(opt.create("adam", learning_rate=lr), w0, grads)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["rmsprop", "adagrad", "adadelta", "ftrl",
+                                  "adamax", "nadam", "nag", "signum",
+                                  "signsgd", "dcasgd", "sgld"])
+def test_optimizers_reduce_quadratic_loss(name):
+    """Every optimizer should descend on f(w) = 0.5*||w||^2 (grad = w)."""
+    w = mx.nd.array(np.full(8, 5.0, dtype=np.float32))
+    o = opt.create(name, learning_rate=0.05)
+    state = o.create_state(0, w)
+    start = float((w * w).sum().asscalar())
+    for _ in range(20):
+        grad = w.copy()
+        o.update(0, w, grad, state)
+    end = float((w * w).sum().asscalar())
+    assert end < start, "%s did not descend: %f -> %f" % (name, start, end)
+
+
+def test_multi_precision_sgd():
+    rng = np.random.RandomState(3)
+    w0 = rng.randn(4).astype(np.float16)
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                   multi_precision=True)
+    w = mx.nd.array(w0, dtype=np.float16)
+    state = o.create_state(0, w)
+    assert state[1].dtype == np.float32
+    o.update(0, w, mx.nd.array(rng.randn(4).astype(np.float16), dtype=np.float16), state)
+    assert w.dtype == np.float16
+
+
+def test_lr_scheduler_factor():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler, \
+        PolyScheduler
+    s = FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+
+    m = MultiFactorScheduler(step=[5, 15], factor=0.1)
+    m.base_lr = 1.0
+    assert m(next(iter([3]))) == 1.0
+    assert abs(m(6) - 0.1) < 1e-12
+    assert abs(m(16) - 0.01) < 1e-12
+
+    p = PolyScheduler(max_update=100, base_lr=1.0, pwr=2)
+    assert p(0) == 1.0
+    assert abs(p(50) - 0.25) < 1e-12
+    assert p(100) == 0.0
+
+
+def test_updater_serialization():
+    o = opt.create("adam", learning_rate=0.01)
+    u = opt.get_updater(o)
+    w = mx.nd.ones((3,))
+    u(0, mx.nd.ones((3,)), w)
+    blob = u.get_states()
+    u2 = opt.get_updater(opt.create("adam", learning_rate=0.01))
+    u2.set_states(blob)
+    w2 = mx.nd.ones((3,))
+    u2(0, mx.nd.ones((3,)), w2)
+
+
+def test_lr_wd_mult():
+    o = opt.create("sgd", learning_rate=1.0, wd=0.1,
+                   param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    o.set_lr_mult({"fc_weight": 0.5})
+    assert o._get_lr(0) == 0.5
+    assert o._get_lr(1) == 1.0
+    # bias wd defaults to 0 (reference set_wd_mult semantics)
+    assert o._get_wd(1) == 0.0
+    assert abs(o._get_wd(0) - 0.1) < 1e-12
