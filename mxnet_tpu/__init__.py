@@ -28,3 +28,19 @@ from .cached_op import CachedOp
 
 ndarray.CachedOp = CachedOp
 nd.CachedOp = CachedOp
+
+from . import lr_scheduler
+from . import optimizer
+from . import optimizer as opt
+from . import initializer
+from . import initializer as init
+from . import metric
+from . import io
+from . import recordio
+from . import kvstore as kv
+from . import kvstore
+from . import model
+from . import callback
+from . import module
+from . import module as mod
+from .module import Module

@@ -13,13 +13,15 @@ from __future__ import annotations
 import threading
 import warnings
 
+import numpy as _np
+
 __all__ = [
     "MXNetError", "ParamError", "string_types", "numeric_types",
     "AttrScope", "NameManager", "classproperty",
 ]
 
 string_types = (str,)
-numeric_types = (float, int)
+numeric_types = (float, int, _np.generic)
 
 
 class MXNetError(Exception):

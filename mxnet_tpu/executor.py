@@ -225,8 +225,9 @@ class Executor:
             # too, so running forward now would execute the graph twice.
             self._pending_train_fwd = True
             self._pending_key = self._key()
-            self.outputs = None
-            return _LazyOutputs(self)
+            self._materialized = False
+            self.outputs = _LazyOutputs(self)
+            return self.outputs
         outs, new_aux = self._get_fwd(False)(self._arg_vals(), self._aux_vals(),
                                              self._key())
         self._set_outputs(outs)
@@ -234,7 +235,7 @@ class Executor:
         return self.outputs
 
     def backward(self, out_grads=None):
-        if not self._pending_train_fwd and self.outputs is None:
+        if not self._pending_train_fwd and not self.outputs:
             raise MXNetError("backward called without forward(is_train=True)")
         key = getattr(self, "_pending_key", None)
         if key is None:
@@ -260,7 +261,8 @@ class Executor:
         self._pending_key = None
 
     def _materialize_pending(self):
-        if self._pending_train_fwd and self.outputs is None:
+        if self._pending_train_fwd and not getattr(self, "_materialized", True):
+            self._materialized = True
             outs, new_aux = self._get_fwd(True)(self._arg_vals(),
                                                 self._aux_vals(),
                                                 self._pending_key)
@@ -365,7 +367,8 @@ class _LazyOutputs(list):
 
     def _force(self):
         self._ex._materialize_pending()
-        if not len(self) and self._ex.outputs:
+        if not list.__len__(self) and self._ex.outputs is not self \
+                and self._ex.outputs:
             self.extend(self._ex.outputs)
 
     def __getitem__(self, i):

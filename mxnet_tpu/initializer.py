@@ -54,6 +54,11 @@ def create(name, **kwargs):
         return name
     if callable(name):
         return name
+    if name.startswith("["):
+        # JSON produced by Initializer.dumps() (stored in the __init__ attr
+        # by sym.Variable(init=...))
+        klass, kw = json.loads(name)
+        return _INIT_REGISTRY[klass.lower()](**kw)
     name = name.lower()
     if name not in _INIT_REGISTRY:
         raise MXNetError("unknown initializer %r" % name)

@@ -1,0 +1,586 @@
+"""Data iterators.
+
+Reference: python/mxnet/io.py (DataIter/DataBatch/DataDesc, NDArrayIter,
+ResizeIter, PrefetchingIter) + src/io/ C++ iterators registered via
+MXNET_REGISTER_IO_ITER (iter_mnist.cc:260, iter_image_recordio_2.cc:724,
+iter_csv.cc, iter_libsvm.cc).
+
+TPU-native redesign: iterators produce host numpy batches; device transfer
+happens once per batch (NDArray ctor → device_put), and the PrefetchingIter
+double-buffers with a background thread so host decode overlaps device
+compute — the dmlc::ThreadedIter collapse (iter_prefetcher.h:142).  Batches
+are fixed-shape (pad/discard semantics preserved) so the compiled train step
+never re-traces.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import gzip
+import threading
+from collections import namedtuple, OrderedDict
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+from .ndarray.sparse import CSRNDArray, csr_matrix
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
+           "PrefetchingIter", "NDArrayIter", "MNISTIter", "CSVIter",
+           "LibSVMIter", "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Name/shape/dtype/layout descriptor (io.py DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+    @staticmethod
+    def get_list(shapes, types):
+        if types is not None:
+            type_dict = dict(types)
+            return [DataDesc(x[0], x[1], type_dict[x[0]]) for x in shapes]
+        return [DataDesc(x[0], x[1]) for x in shapes]
+
+
+class DataBatch(object):
+    """One batch: data/label lists + pad/index bookkeeping (io.py DataBatch)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None:
+            assert isinstance(data, (list, tuple)), "Data must be list of NDArrays"
+        if label is not None:
+            assert isinstance(label, (list, tuple)), "Label must be list of NDArrays"
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        label_shapes = [l.shape for l in self.label] if self.label else None
+        return "{}: data shapes: {} label shapes: {}".format(
+            self.__class__.__name__, data_shapes, label_shapes)
+
+
+class DataIter(object):
+    """Base iterator (io.py DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to `size` batches per epoch (io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over one or more iterators — the
+    dmlc::ThreadedIter double-buffer (iter_prefetcher.h:142) in Python."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0].shape[0]
+        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
+        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
+        for e in self.data_taken:
+            e.set()
+        self.started = True
+        self.current_batch = [None for _ in range(self.n_iter)]
+        self.next_batch = [None for _ in range(self.n_iter)]
+
+        def prefetch_func(self, i):
+            while True:
+                self.data_taken[i].wait()
+                if not self.started:
+                    break
+                try:
+                    self.next_batch[i] = self.iters[i].next()
+                except StopIteration:
+                    self.next_batch[i] = None
+                self.data_taken[i].clear()
+                self.data_ready[i].set()
+        self.prefetch_threads = [
+            threading.Thread(target=prefetch_func, args=[self, i])
+            for i in range(self.n_iter)]
+        for thread in self.prefetch_threads:
+            thread.daemon = True
+            thread.start()
+
+    def __del__(self):
+        self.started = False
+        for e in self.data_taken:
+            e.set()
+        for thread in self.prefetch_threads:
+            thread.join(timeout=1.0)
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(*x)
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(*x)
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        for e in self.data_ready:
+            e.wait()
+        for i in self.iters:
+            i.reset()
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    def iter_next(self):
+        for e in self.data_ready:
+            e.wait()
+        if self.next_batch[0] is None:
+            for i in self.next_batch:
+                assert i is None, "Number of entry mismatches between iterators"
+            return False
+        for batch in self.next_batch:
+            assert batch.pad == self.next_batch[0].pad, \
+                "Number of entry mismatches between iterators"
+        self.current_batch = DataBatch(
+            sum([batch.data for batch in self.next_batch], []),
+            sum([batch.label for batch in self.next_batch], []),
+            self.next_batch[0].pad, self.next_batch[0].index,
+            provide_data=self.provide_data, provide_label=self.provide_label)
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize data into an OrderedDict of name->NDArray (io.py:549)."""
+    assert (data is not None) or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = OrderedDict([(default_name, data[0])])
+        else:
+            data = OrderedDict([("_%d_%s" % (i, default_name), d)
+                                for i, d in enumerate(data)])
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of "
+                        "them or dict with them as values")
+    for k, v in data.items():
+        if not isinstance(v, (NDArray, CSRNDArray)):
+            try:
+                data[k] = array(np.asarray(v))
+            except Exception:
+                raise TypeError("Invalid type '%s' for %s, should be NDArray "
+                                "or numpy.ndarray" % (type(v), k))
+    return list(data.items())
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays with shuffle + pad/discard/roll-over
+    last-batch handling (io.py NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+
+        if ((isinstance(data, CSRNDArray) or isinstance(label, CSRNDArray))
+                and (last_batch_handle != "discard")):
+            raise NotImplementedError(
+                "`NDArrayIter` only supports CSRNDArray with "
+                "`last_batch_handle` set to `discard`.")
+
+        self.idx = np.arange(self.data[0][1].shape[0])
+        if shuffle and any(isinstance(v, CSRNDArray)
+                           for _, v in self.data + self.label):
+            raise NotImplementedError(
+                "shuffle is not supported for CSRNDArray inputs")
+        if shuffle:
+            np.random.shuffle(self.idx)
+            self.data = [(k, v.asnumpy()[self.idx] if not isinstance(v, CSRNDArray) else v)
+                         for k, v in self.data]
+            self.label = [(k, v.asnumpy()[self.idx] if not isinstance(v, CSRNDArray) else v)
+                          for k, v in self.label]
+            self.data = [(k, array(v) if isinstance(v, np.ndarray) else v)
+                         for k, v in self.data]
+            self.label = [(k, array(v) if isinstance(v, np.ndarray) else v)
+                          for k, v in self.label]
+
+        if last_batch_handle == "discard":
+            new_n = self.data[0][1].shape[0] - self.data[0][1].shape[0] % batch_size
+            self.idx = self.idx[:new_n]
+
+        self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
+        self.num_source = len(self.data_list)
+        self.num_data = self.idx.shape[0]
+        assert self.num_data >= batch_size, \
+            "batch_size needs to be smaller than data size."
+        self.cursor = -batch_size
+        self.batch_size = batch_size
+        self.last_batch_handle = last_batch_handle
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype)
+                for k, v in self.label]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=None)
+        raise StopIteration
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter needs reset."
+        if self.cursor + self.batch_size <= self.num_data:
+            return [x[1][self.cursor:self.cursor + self.batch_size]
+                    for x in data_source]
+        pad = self.batch_size - self.num_data + self.cursor
+        from .ndarray import concatenate as nd_concat
+        return [nd_concat([x[1][self.cursor:], x[1][:pad]])
+                if not isinstance(x[1], CSRNDArray) else None
+                for x in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format reader (iter_mnist.cc:80), with shuffle + flat."""
+
+    def __init__(self, image="train-images-idx3-ubyte",
+                 label="train-labels-idx1-ubyte", batch_size=128, shuffle=True,
+                 flat=False, silent=False, seed=0,
+                 part_index=0, num_parts=1, input_shape=None, **kwargs):
+        super().__init__(batch_size)
+        self._images = self._read_images(image)
+        self._labels = self._read_labels(label)
+        assert self._images.shape[0] == self._labels.shape[0]
+        if num_parts > 1:
+            n = self._images.shape[0] // num_parts
+            s = part_index * n
+            self._images = self._images[s:s + n]
+            self._labels = self._labels[s:s + n]
+        if shuffle:
+            rng = np.random.RandomState(seed)
+            order = rng.permutation(self._images.shape[0])
+            self._images = self._images[order]
+            self._labels = self._labels[order]
+        self._images = self._images.astype(np.float32) / 255.0
+        if flat:
+            self._images = self._images.reshape(self._images.shape[0], -1)
+        else:
+            self._images = self._images.reshape(
+                self._images.shape[0], 1, 28, 28)
+        if input_shape is not None:
+            self._images = self._images.reshape(
+                (self._images.shape[0],) + tuple(input_shape))
+        self._inner = NDArrayIter(self._images, self._labels, batch_size,
+                                  shuffle=False, last_batch_handle="discard")
+
+    @staticmethod
+    def _open(path):
+        if path.endswith(".gz"):
+            return gzip.open(path, "rb")
+        return open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, "bad MNIST image magic in %s" % path
+            return np.frombuffer(f.read(num * rows * cols),
+                                 dtype=np.uint8).reshape(num, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, num = struct.unpack(">II", f.read(8))
+            assert magic == 2049, "bad MNIST label magic in %s" % path
+            return np.frombuffer(f.read(num), dtype=np.uint8).astype(np.float32)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class CSVIter(DataIter):
+    """CSV reader (iter_csv.cc): data_csv/label_csv with fixed shapes."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label_shape == (1,):
+                label = label.reshape(-1)
+        else:
+            label = np.zeros(data.shape[0], dtype=np.float32)
+        self._inner = NDArrayIter(
+            data, label, batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM sparse reader (iter_libsvm.cc): returns CSR data batches."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, **kwargs):
+        super().__init__(batch_size)
+        self._data_shape = tuple(data_shape)
+        indptr = [0]
+        indices = []
+        values = []
+        labels = []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for kv in parts[1:]:
+                    k, v = kv.split(":")
+                    indices.append(int(k))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        self._num = len(labels)
+        self._indptr = np.array(indptr, dtype=np.int64)
+        self._indices = np.array(indices, dtype=np.int64)
+        self._values = np.array(values, dtype=np.float32)
+        self._labels = np.array(labels, dtype=np.float32)
+        self._cursor = -batch_size
+        self._nbatch = self._num // batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = -self.batch_size
+
+    def iter_next(self):
+        self._cursor += self.batch_size
+        return self._cursor + self.batch_size <= self._num
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        s, e = self._cursor, self._cursor + self.batch_size
+        sub_indptr = self._indptr[s:e + 1] - self._indptr[s]
+        lo, hi = self._indptr[s], self._indptr[e]
+        data = csr_matrix((self._values[lo:hi], self._indices[lo:hi],
+                           sub_indptr),
+                          shape=(self.batch_size,) + self._data_shape)
+        label = array(self._labels[s:e])
+        return DataBatch(data=[data], label=[label], pad=0)
+
+
+def ImageRecordIter(**kwargs):
+    """Record-file image pipeline (iter_image_recordio_2.cc:660); implemented
+    in mxnet_tpu.image on top of recordio + host augmentation."""
+    from .image.image import ImageRecordIterImpl
+    return ImageRecordIterImpl(**kwargs)

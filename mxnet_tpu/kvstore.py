@@ -1,0 +1,223 @@
+"""KVStore: the data-parallel communication/update layer.
+
+Reference: include/mxnet/kvstore.h:47-413 + src/kvstore/ (kvstore_local.h,
+comm.h CommCPU/CommDevice, kvstore_nccl.h, kvstore_dist.h) and the Python
+client python/mxnet/kvstore.py.
+
+TPU-native redesign (SURVEY §2.3, §5 "Distributed communication backend"):
+- `local` / `device`: single-process stores.  The reference reduces explicit
+  per-device gradient copies (CommCPU pinned-host tree / CommDevice GPU P2P);
+  here data parallelism is expressed as sharded arrays on a jax Mesh, so
+  cross-device reduction is a `psum` *compiled into the train step* (see
+  mxnet_tpu.parallel) and what reaches the kvstore is already globally
+  summed.  Push/pull therefore degenerate to merge (for multi-value pushes)
+  + optimizer apply — the `update_on_kvstore` path — with zero extra
+  device↔device traffic.
+- `dist_sync` / `dist_device_sync` / `dist_async`: multi-host data
+  parallelism over jax.distributed: every host runs the same program; pushes
+  allreduce over DCN/ICI via a tiny jitted psum program on a host-spanning
+  mesh (see mxnet_tpu.kvstore_dist).  There are no parameter-server
+  processes to schedule: `launch.py` starts N identical workers and
+  coordination is XLA collectives (the ps-lite scheduler/server roles
+  collapse into the collective topology).
+- Gradient compression: 2-bit quantization with error-feedback residual
+  (reference src/kvstore/gradient_compression.cc) implemented as jitted
+  quantize/dequantize around the allreduce.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError, string_types
+from .ndarray import NDArray, zeros
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_value(keys, vals):
+    """Normalize (keys, vals) into parallel lists (kvstore.py _ctype_key_value
+    analog): single key + single/multi vals, or list of keys."""
+    if isinstance(keys, (str, int)):
+        if isinstance(vals, NDArray):
+            return [keys], [[vals]]
+        for v in vals:
+            assert isinstance(v, NDArray)
+        return [keys], [list(vals)]
+    assert len(keys) == len(vals)
+    out_keys, out_vals = [], []
+    for k, v in zip(keys, vals):
+        ks, vs = _key_value(k, v)
+        out_keys.extend(ks)
+        out_vals.extend(vs)
+    return out_keys, out_vals
+
+
+class _TwoBitCompressor:
+    """2-bit gradient quantization with error feedback
+    (gradient_compression.cc:111 Quantize / :121 Dequantize semantics:
+    values >= threshold -> +threshold, <= -threshold -> -threshold, else 0;
+    the quantization error is kept as residual and added next round)."""
+
+    def __init__(self, threshold=0.5):
+        import jax
+        import jax.numpy as jnp
+        self.threshold = float(threshold)
+        self._residual = {}
+        t = self.threshold
+
+        @jax.jit
+        def qd(g, r):
+            acc = g + r
+            q = jnp.where(acc >= t, t, jnp.where(acc <= -t, -t, 0.0))
+            return q, acc - q
+        self._qd = qd
+
+    def __call__(self, key, grad):
+        import jax.numpy as jnp
+        res = self._residual.get(key)
+        if res is None:
+            res = jnp.zeros_like(grad._data)
+        q, new_res = self._qd(grad._data, res)
+        self._residual[key] = new_res
+        out = NDArray.__new__(NDArray)
+        out._data = q
+        out._ctx = grad._ctx
+        out._tape_node = None
+        out._tape_index = None
+        out._grad = None
+        out._grad_req = "write"
+        return out
+
+
+class KVStore(object):
+    """Single-process store ('local'/'device'); see module docstring."""
+
+    def __init__(self, name="local"):
+        self._name = name
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compressor = None
+        self._str_keys = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self):
+        return self._name
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # -- core API ----------------------------------------------------------
+    def init(self, key, value):
+        keys, vals = _key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % (k,))
+            assert len(vlist) == 1, "init expects a single value per key"
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        keys, vals = _key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            merged = vlist[0]
+            if len(vlist) > 1:
+                # multi-device push: engine-reduce ≡ one fused add_n
+                from .ndarray import add_n
+                merged = add_n(*[v.as_in_context(vlist[0].context)
+                                 for v in vlist])
+            if self._compressor is not None:
+                merged = self._compressor(k, merged)
+            if self._updater is not None:
+                self._updater(k if isinstance(k, int) else str(k), merged,
+                              self._store[k])
+            else:
+                self._store[k]._data = merged._data
+
+    def pull(self, key, out=None, priority=0, row_ids=None):
+        assert out is not None
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            src = self._store[k]
+            for o in olist:
+                o._data = src.as_in_context(o.context)._data
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only selected rows of a row_sparse value."""
+        assert out is not None and row_ids is not None
+        import jax.numpy as jnp
+        keys, outs = _key_value(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(keys)
+        for k, olist, rid in zip(keys, outs, row_ids):
+            src = self._store[k]
+            dense = src.tostype("default") if src.stype != "default" else src
+            # keep only the requested rows (sparse_retain semantics)
+            ids = rid._data.astype("int32")
+            for o in olist:
+                if getattr(o, "stype", "default") == "row_sparse":
+                    o._aux["indices"]._data = ids
+                    o._aux["data"]._data = dense._data[ids]
+                    o._shape = dense.shape
+                else:
+                    mask = jnp.zeros((dense.shape[0],), dtype=bool).at[ids].set(True)
+                    o._data = jnp.where(mask[:, None], dense._data, 0)
+
+    # -- config ------------------------------------------------------------
+    def set_gradient_compression(self, compression_params):
+        ctype = compression_params.get("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError("unsupported compression type %r" % ctype)
+        self._compressor = _TwoBitCompressor(
+            compression_params.get("threshold", 0.5))
+
+    def set_optimizer(self, optimizer):
+        """Install an optimizer to run inside the store (update_on_kvstore)."""
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    _send_command_to_servers = lambda self, head, body: None  # noqa: E731
+
+    # -- sync (trivial single-process) --------------------------------------
+    def barrier(self):
+        from .ndarray import waitall
+        waitall()
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+def create(name="local"):
+    """Factory (kvstore.cc:38-76): local | device | nccl | dist_sync |
+    dist_device_sync | dist_async.  On TPU, device==local (sharded-mesh
+    reduction happens inside the compiled step), nccl==device, and dist_*
+    map to the multi-host collective store."""
+    if not isinstance(name, string_types):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device", "nccl"):
+        return KVStore(name)
+    if name.startswith("dist"):
+        from .kvstore_dist import KVStoreDist
+        return KVStoreDist(name)
+    raise MXNetError("unknown kvstore type %r" % name)

@@ -230,7 +230,7 @@ class TopKAccuracy(EvalMetric):
         for label, pred_label in zip(labels, preds):
             assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
             pred_label = numpy.argsort(_as_numpy(pred_label).astype("float32"),
-                                       axis=1)
+                                       axis=-1)
             label = _as_numpy(label).astype("int32")
             check_label_shapes(label, pred_label)
             num_samples = pred_label.shape[0]
@@ -316,13 +316,13 @@ class Perplexity(EvalMetric):
                 probs = probs * (1 - ignore) + ignore
             loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
             num += label.size
-        self.sum_metric += numpy.exp(loss / num) * num
+        self.sum_metric += loss
         self.num_inst += num
 
     def get(self):
         if self.num_inst == 0:
             return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
 
 
 @register

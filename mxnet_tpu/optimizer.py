@@ -245,9 +245,9 @@ class Signum(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         kwargs = self._common_attrs(index)
-        if self.wd_lh:
-            kwargs["wd_lh"] = self.wd_lh
         if state is not None:
+            if self.wd_lh:
+                kwargs["wd_lh"] = self.wd_lh
             kwargs["momentum"] = self.momentum
             signum_update(weight, grad, state, out=weight, **kwargs)
         else:

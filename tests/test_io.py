@@ -1,0 +1,168 @@
+"""IO tests (reference tests/python/unittest/test_io.py pattern)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu import recordio
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(100).reshape(25, 4).astype(np.float32)
+    label = np.arange(25).astype(np.float32)
+    it = mio.NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (5, 4)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5])
+    np.testing.assert_allclose(batches[2].label[0].asnumpy(), label[10:15])
+    # reset and re-iterate
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_ndarray_iter_pad_discard():
+    data = np.arange(23 * 2).reshape(23, 2).astype(np.float32)
+    it = mio.NDArrayIter(data, batch_size=5, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[-1].pad == 2
+    # padded part wraps to the beginning
+    np.testing.assert_allclose(batches[-1].data[0].asnumpy()[-2:], data[:2])
+
+    it = mio.NDArrayIter(data, batch_size=5, last_batch_handle="discard")
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_shuffle_and_dict():
+    np.random.seed(0)
+    data = {"a": np.random.rand(12, 3).astype(np.float32),
+            "b": np.random.rand(12, 2).astype(np.float32)}
+    it = mio.NDArrayIter(data, batch_size=4, shuffle=True)
+    descs = {d.name: d.shape for d in it.provide_data}
+    assert descs == {"a": (4, 3), "b": (4, 2)}
+    assert len(list(it)) == 3
+
+
+def test_resize_iter():
+    data = np.arange(20).reshape(10, 2).astype(np.float32)
+    base = mio.NDArrayIter(data, batch_size=5)
+    it = mio.ResizeIter(base, 7)
+    assert len(list(it)) == 7
+
+
+def test_prefetching_iter():
+    data = np.arange(40).reshape(20, 2).astype(np.float32)
+    base = mio.NDArrayIter(data, batch_size=5)
+    it = mio.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"x" * 37, b"", b"abc\x00def"]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    assert got == payloads
+
+
+def test_indexed_recordio(tmp_path):
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(10):
+        w.write_idx(i, ("record%d" % i).encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"record7"
+    assert r.read_idx(2) == b"record2"
+
+
+def test_pack_unpack_header():
+    h = recordio.IRHeader(0, 3.0, 42, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert h2.label == 3.0 and h2.id == 42
+    # vector label
+    h = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0], dtype=np.float32), 7, 0)
+    s = recordio.pack(h, b"img")
+    h2, payload = recordio.unpack(s)
+    assert h2.flag == 3
+    np.testing.assert_allclose(h2.label, [1.0, 2.0, 3.0])
+    assert payload == b"img"
+
+
+def _write_mnist(tmp_path, n=64):
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 255, (n, 28, 28)).astype(np.uint8)
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    img_path = str(tmp_path / "images-idx3-ubyte")
+    lbl_path = str(tmp_path / "labels-idx1-ubyte")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(images.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return img_path, lbl_path, images, labels
+
+
+def test_mnist_iter(tmp_path):
+    img, lbl, images, labels = _write_mnist(tmp_path)
+    it = mio.MNISTIter(image=img, label=lbl, batch_size=16, shuffle=False,
+                       flat=False)
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (16, 1, 28, 28)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(),
+                               images[:16].reshape(16, 1, 28, 28) / 255.0,
+                               rtol=1e-6)
+    flat = mio.MNISTIter(image=img, label=lbl, batch_size=16, shuffle=False,
+                         flat=True)
+    assert next(iter(flat)).data[0].shape == (16, 784)
+
+
+def test_csv_iter(tmp_path):
+    data = np.arange(30).reshape(10, 3).astype(np.float32)
+    labels = np.arange(10).astype(np.float32)
+    dpath = str(tmp_path / "d.csv")
+    lpath = str(tmp_path / "l.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, labels, delimiter=",")
+    it = mio.CSVIter(data_csv=dpath, data_shape=(3,), label_csv=lpath,
+                     batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5])
+
+
+def test_libsvm_iter(tmp_path):
+    path = str(tmp_path / "d.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:3.0\n")
+        f.write("1 2:4.5 3:1.0\n")
+        f.write("0 0:2.0\n")
+    it = mio.LibSVMIter(data_libsvm=path, data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    dense = batches[0].data[0].asnumpy()
+    np.testing.assert_allclose(dense, [[1.5, 0, 0, 2.0], [0, 3.0, 0, 0]])
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), [1, 0])

@@ -1,0 +1,187 @@
+"""Module API tests, incl. the end-to-end training slice (SURVEY §7 stage 4;
+reference tests/python/unittest/test_module.py + tests/python/train/)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+
+
+def _mlp_symbol(num_hidden=32, num_classes=4):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    act1 = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act1, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_classification(n=256, d=16, k=4, seed=0):
+    """Linearly separable-ish blobs."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d) * 3
+    X = np.zeros((n, d), dtype=np.float32)
+    y = np.zeros(n, dtype=np.float32)
+    for i in range(n):
+        c = i % k
+        X[i] = centers[c] + rng.randn(d) * 0.5
+        y[i] = c
+    return X, y
+
+
+def test_module_bind_and_forward():
+    sym = _mlp_symbol()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    batch = mio.DataBatch(data=[mx.nd.ones((8, 16))],
+                          label=[mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (8, 4)
+    p = outs[0].asnumpy()
+    np.testing.assert_allclose(p.sum(axis=1), np.ones(8), rtol=1e-5)
+
+
+def test_module_fit_converges():
+    """End-to-end convergence: the reference's tests/python/train pattern."""
+    X, y = _toy_classification()
+    train = mio.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    val = mio.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            num_epoch=10, eval_metric="acc",
+            initializer=mx.init.Xavier())
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, "did not converge: %s" % score
+
+
+def test_module_input_grads():
+    sym = _mlp_symbol()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 16))],
+             label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = mio.DataBatch(data=[mx.nd.ones((4, 16))],
+                          label=[mx.nd.array([0, 1, 2, 3])])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    igrads = mod.get_input_grads()
+    assert igrads[0].shape == (4, 16)
+    assert np.abs(igrads[0].asnumpy()).sum() > 0
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "model")
+    X, y = _toy_classification(n=64)
+    train = mio.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer_params={"learning_rate": 0.1},
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0002.params")
+
+    mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (16, 16))],
+              label_shapes=[("softmax_label", (16,))], for_training=False)
+    # predictions must match
+    batch = mio.DataBatch(data=[mx.nd.array(X[:16])], label=None)
+    mod.forward(batch, is_train=False)
+    out1 = mod.get_outputs()[0].asnumpy()
+    mod2.forward(batch, is_train=False)
+    out2 = mod2.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-5, atol=1e-6)
+
+
+def test_module_predict_and_score():
+    X, y = _toy_classification(n=64)
+    it = mio.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (64, 4)
+    res = mod.score(it, ["acc", "ce"])
+    names = [n for n, v in res]
+    assert "accuracy" in names and "cross-entropy" in names
+
+
+def test_module_update_on_kvstore_matches_local():
+    """kvstore-updater path must equal the local-updater path numerically."""
+    X, y = _toy_classification(n=64, seed=1)
+
+    def train_with(kvstore):
+        np.random.seed(42)
+        mx.random.seed(42)
+        it = mio.NDArrayIter(X, y, batch_size=16)
+        mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(kvstore=kvstore, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "rescale_grad": 1.0 / 16})
+        for _ in range(3):
+            it.reset()
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    p_none = train_with(None)
+    p_kv = train_with(mx.kv.create("device"))
+    for k in p_none:
+        np.testing.assert_allclose(p_none[k], p_kv[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_bucketing_module():
+    """Variable-length buckets share params (test_module.py pattern)."""
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+        out = mx.sym.SoftmaxOutput(fc, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(kvstore=None, optimizer="sgd")
+
+    for key in [8, 8, 8]:
+        batch = mio.DataBatch(
+            data=[mx.nd.ones((4, key))], label=[mx.nd.zeros((4,))],
+            bucket_key=key,
+            provide_data=[mio.DataDesc("data", (4, key))],
+            provide_label=[mio.DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert mod.get_outputs()[0].shape == (4, 4)
+
+
+def test_sequential_module():
+    net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                 name="fc1")
+    net2 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("fc1_output"), num_hidden=4,
+                              name="fc2"), name="softmax")
+    mod1 = mx.mod.Module(net1, label_names=None, context=mx.cpu())
+    mod2 = mx.mod.Module(net2, data_names=("fc1_output",), context=mx.cpu())
+    seq = mx.mod.SequentialModule()
+    seq.add(mod1).add(mod2, take_labels=True, auto_wiring=True)
+    seq.bind(data_shapes=[("data", (4, 16))],
+             label_shapes=[("softmax_label", (4,))])
+    seq.init_params()
+    seq.init_optimizer(kvstore=None)
+    batch = mio.DataBatch(data=[mx.nd.ones((4, 16))],
+                          label=[mx.nd.zeros((4,))])
+    seq.forward(batch, is_train=True)
+    seq.backward()
+    seq.update()
+    assert seq.get_outputs()[0].shape == (4, 4)
