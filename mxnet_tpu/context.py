@@ -76,17 +76,23 @@ class Context:
 
     # -- jax mapping -------------------------------------------------------
     def jax_device(self):
-        """Resolve to the concrete jax.Device this context names."""
+        """Resolve to the concrete jax.Device this context names.
+
+        Uses process-LOCAL devices: under jax.distributed each process only
+        addresses its own chips (global devices exist but are not
+        addressable), matching the reference's per-worker device numbering.
+        """
         jax = _jax()
         dt = self.device_type
         if dt in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            devs = (jax.local_devices(backend="cpu") if _has_platform("cpu")
+                    else jax.local_devices())
         elif dt == "tpu":
-            devs = jax.devices("tpu")
+            devs = jax.local_devices(backend="tpu")
         else:  # 'gpu' → any accelerator (tpu preferred), else cpu
             devs = _accelerators()
             if not devs:
-                devs = jax.devices()
+                devs = jax.local_devices()
         if self.device_id >= len(devs):
             raise ValueError("%s: device_id out of range (%d available)"
                              % (self, len(devs)))
@@ -114,7 +120,7 @@ def _accelerators():
     jax = _jax()
     for plat in ("tpu", "gpu", "cuda", "rocm"):
         try:
-            devs = jax.devices(plat)
+            devs = jax.local_devices(backend=plat)
             if devs:
                 return devs
         except RuntimeError:

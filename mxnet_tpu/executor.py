@@ -92,7 +92,13 @@ class Executor:
         import jax
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else (ctx or current_context())
-        self._sharding = sharding  # optional jax.sharding for params/data
+        # optional {arg_or_aux_name: jax.sharding.Sharding} placement map
+        # (built by Module from a parallel.ShardingPlan).  Computation follows
+        # data under jit: batch-sharded data + replicated params = data
+        # parallelism with the gradient psum compiled in; param_rules give
+        # tensor parallelism.  Gradients are pinned to their param's sharding
+        # via with_sharding_constraint (forcing the cross-replica reduce).
+        self._sharding = dict(sharding) if sharding else None
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.output_names = symbol.list_outputs()
@@ -124,6 +130,8 @@ class Executor:
         self._base_key = None
         self._step = 0
         self._pending_train_fwd = False
+        if self._sharding:
+            self._apply_sharding()
         self._build()
 
     # ------------------------------------------------------------------
@@ -141,6 +149,13 @@ class Executor:
             raise MXNetError("%s: expected %d entries, got %d"
                              % (what, len(names), len(values)))
         return {n: v for n, v in zip(names, values) if v is not None}
+
+    def _apply_sharding(self):
+        import jax
+        for name, sh in self._sharding.items():
+            for d in (self.arg_dict, self.aux_dict, self.grad_dict):
+                if name in d:
+                    d[name]._data = jax.device_put(d[name]._data, sh)
 
     # ------------------------------------------------------------------
     def _build(self):
@@ -175,6 +190,8 @@ class Executor:
             gidx = [self.arg_names.index(n) for n in grad_names]
             req_add = [self._grad_req[n] == "add" for n in grad_names]
             self._grad_names = grad_names
+            grad_shards = [self._sharding.get(n) if self._sharding else None
+                           for n in grad_names]
 
             def fwd_bwd(arg_vals, aux_vals, key, head_grads, old_grads):
                 def f(*wrt):
@@ -192,6 +209,13 @@ class Executor:
                 grads = vjp(head_grads)
                 new_grads = tuple(og + gr if add else gr for og, gr, add
                                   in zip(old_grads, grads, req_add))
+                if any(s is not None for s in grad_shards):
+                    # pin grads to their param's sharding: for replicated
+                    # params under a dp mesh this compiles the allreduce in
+                    new_grads = tuple(
+                        jax.lax.with_sharding_constraint(g, s)
+                        if s is not None else g
+                        for g, s in zip(new_grads, grad_shards))
                 return outs, new_aux, new_grads
 
             if with_head_grads:
@@ -215,11 +239,15 @@ class Executor:
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError("forward: unknown argument %r" % k)
+            sh = self._sharding.get(k) if self._sharding else None
             if isinstance(v, NDArray):
-                self.arg_dict[k]._data = v._data
+                v = v._data
+                self.arg_dict[k]._data = v if sh is None \
+                    else jax.device_put(v, sh)
             else:
                 self.arg_dict[k]._data = jax.device_put(
-                    _np.asarray(v), self._ctx.jax_device())
+                    _np.asarray(v), sh if sh is not None
+                    else self._ctx.jax_device())
         if is_train:
             # lazy: the fused fwd+bwd program at backward() computes outputs
             # too, so running forward now would execute the graph twice.
@@ -296,12 +324,12 @@ class Executor:
                          allow_extra_params=False):
         for k, v in (arg_params or {}).items():
             if k in self.arg_dict:
-                self.arg_dict[k]._data = v.astype(self.arg_dict[k].dtype)._data
+                v.astype(self.arg_dict[k].dtype).copyto(self.arg_dict[k])
             elif not allow_extra_params:
                 raise MXNetError("unknown arg %r" % k)
         for k, v in (aux_params or {}).items():
             if k in self.aux_dict:
-                self.aux_dict[k]._data = v.astype(self.aux_dict[k].dtype)._data
+                v.astype(self.aux_dict[k].dtype).copyto(self.aux_dict[k])
             elif not allow_extra_params:
                 raise MXNetError("unknown aux %r" % k)
 

@@ -522,7 +522,9 @@ class CSVIter(DataIter):
 
 
 class LibSVMIter(DataIter):
-    """LibSVM sparse reader (iter_libsvm.cc): returns CSR data batches."""
+    """LibSVM sparse reader (iter_libsvm.cc): returns CSR data batches.
+    With `label_libsvm` set, labels come from that separate file (one value —
+    or vector of `label_shape` values — per line), reference semantics."""
 
     def __init__(self, data_libsvm, data_shape, label_libsvm=None,
                  label_shape=None, batch_size=1, **kwargs):
@@ -543,6 +545,15 @@ class LibSVMIter(DataIter):
                     indices.append(int(k))
                     values.append(float(v))
                 indptr.append(len(indices))
+        if label_libsvm is not None:
+            labels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    parts = line.strip().split()
+                    if not parts:
+                        continue
+                    vals = [float(p.split(":")[-1]) for p in parts]
+                    labels.append(vals[0] if len(vals) == 1 else vals)
         self._num = len(labels)
         self._indptr = np.array(indptr, dtype=np.int64)
         self._indices = np.array(indices, dtype=np.int64)
@@ -582,5 +593,9 @@ class LibSVMIter(DataIter):
 def ImageRecordIter(**kwargs):
     """Record-file image pipeline (iter_image_recordio_2.cc:660); implemented
     in mxnet_tpu.image on top of recordio + host augmentation."""
-    from .image.image import ImageRecordIterImpl
+    try:
+        from .image.image import ImageRecordIterImpl
+    except ImportError as e:
+        raise MXNetError("ImageRecordIter requires the mxnet_tpu.image "
+                         "package: %s" % e)
     return ImageRecordIterImpl(**kwargs)

@@ -150,7 +150,7 @@ class KVStore(object):
                 raise MXNetError("key %r not initialized" % (k,))
             src = self._store[k]
             for o in olist:
-                o._data = src.as_in_context(o.context)._data
+                src.copyto(o)  # preserves o's (possibly sharded) placement
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only selected rows of a row_sparse value."""

@@ -1,0 +1,142 @@
+"""Distributed KVStore: multi-host data parallelism over jax.distributed.
+
+Reference: src/kvstore/kvstore_dist.h:49 (worker: ZPush/ZPull to key-sharded
+ps-lite servers), kvstore_dist_server.h:113 (sync/async server with
+server-side optimizer), launched by tools/launch.py with
+DMLC_ROLE/DMLC_PS_ROOT_URI env vars.
+
+TPU-native redesign (SURVEY §5): there are no server processes.  N identical
+workers join one jax.distributed job (coordinator = the reference's
+scheduler role, but only for bring-up); `push` allreduces gradients across
+processes with collectives over DCN/ICI, `pull` reads the locally-updated
+replica.  sync semantics come from the collective itself (every worker
+blocks in the same allreduce — the reference's sync-mode barrier,
+kvstore_dist_server.h:427, is implicit).  `dist_async` maps to sync
+collectives too (straggler tolerance via PS has no collective analog; see
+SURVEY §7 hard part (d)).
+
+Env contract (launch.py sets these; DMLC_* names kept for CLI compat):
+  DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT -> coordinator address
+  DMLC_NUM_WORKER                      -> process count
+  DMLC_WORKER_ID                       -> process id
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+from .kvstore import KVStore
+from .ndarray import NDArray
+
+__all__ = ["KVStoreDist", "init_distributed"]
+
+_initialized = False
+
+
+def init_distributed():
+    """Join the jax.distributed job described by the env (idempotent)."""
+    global _initialized
+    if _initialized:
+        return True
+    import jax
+    uri = os.environ.get("DMLC_PS_ROOT_URI")
+    n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if uri is None or n <= 1:
+        return False
+    port = os.environ.get("DMLC_PS_ROOT_PORT", "9000")
+    pid = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    jax.distributed.initialize(coordinator_address="%s:%s" % (uri, port),
+                               num_processes=n, process_id=pid)
+    _initialized = True
+    return True
+
+
+class KVStoreDist(KVStore):
+    """Multi-process synchronous data-parallel store."""
+
+    def __init__(self, name="dist_sync"):
+        super().__init__(name)
+        self._multi = init_distributed()
+        import jax
+        self._rank = jax.process_index() if self._multi else 0
+        self._size = jax.process_count() if self._multi else 1
+        self._psum_cache = {}
+        self._mesh = None
+        if self._multi:
+            import numpy as np
+            from jax.sharding import Mesh
+            devs = np.array(jax.devices())
+            self._mesh = Mesh(devs.reshape(self._size, -1), ("proc", "local"))
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._size
+
+    def _allreduce(self, jax_array):
+        """Cross-process sum as ONE compiled collective: each process
+        contributes its local gradient as a shard on the 'proc' mesh axis and
+        a jitted sum-over-proc with replicated output runs the allreduce
+        on-device (DCN between hosts, ICI within) — no host gather."""
+        if not self._multi:
+            return jax_array
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        local = np.asarray(jax_array)
+        key = (local.shape, str(local.dtype))
+        fn = self._psum_cache.get(key)
+        if fn is None:
+            fn = jax.jit(lambda x: x.sum(axis=0),
+                         out_shardings=NamedSharding(self._mesh, P()))
+            self._psum_cache[key] = fn
+        global_shape = (self._size,) + local.shape
+        stacked = jax.make_array_from_process_local_data(
+            NamedSharding(self._mesh, P("proc")), local[None], global_shape)
+        summed = fn(stacked)
+        # fully-replicated output: every process holds the complete value
+        return summed.addressable_shards[0].data
+
+    def push(self, key, value, priority=0):
+        from .kvstore import _key_value
+        keys, vals = _key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            merged = vlist[0]
+            if len(vlist) > 1:
+                from .ndarray import add_n
+                merged = add_n(*vlist)
+            if self._compressor is not None:
+                merged = self._compressor(k, merged)
+            if self._multi:
+                summed = self._allreduce(merged._data)
+                from .ndarray import array as nd_array
+                merged = nd_array(summed)
+            if self._updater is not None:
+                self._updater(k if isinstance(k, int) else str(k), merged,
+                              self._store[k])
+            else:
+                self._store[k]._data = merged._data
+
+    def init(self, key, value):
+        super().init(key, value)
+        # rank0's initial weights win, as in the reference (workers pull the
+        # server-held init): broadcast by averaging identical inits is wrong
+        # when seeds differ, so ship rank0's values
+        if self._multi:
+            from jax.experimental import multihost_utils
+            for k in (key if isinstance(key, (list, tuple)) else [key]):
+                v = self._store[k]
+                v._data = multihost_utils.broadcast_one_to_all(v._data)
+
+    def barrier(self):
+        if self._multi:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+        else:
+            super().barrier()

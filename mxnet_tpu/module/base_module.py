@@ -17,6 +17,7 @@ from .. import ndarray
 from ..context import cpu
 from ..initializer import Uniform
 from ..io import DataDesc
+from ..model import BatchEndParam
 
 
 def _as_list(obj):
@@ -352,13 +353,3 @@ class BaseModule(object):
     @property
     def symbol(self):
         return self._symbol
-
-
-class BatchEndParam(object):
-    """Callback payload (the reference uses a namedtuple in model.py:44)."""
-
-    def __init__(self, epoch, nbatch, eval_metric, locals=None):
-        self.epoch = epoch
-        self.nbatch = nbatch
-        self.eval_metric = eval_metric
-        self.locals = locals

@@ -197,14 +197,18 @@ class BucketingModule(BaseModule):
         self.optimizer_initialized = True
 
     def prepare(self, data_batch):
+        """Pre-create the next batch's bucket module, then switch back so the
+        current batch's outputs/metrics stay addressable (reference
+        bucketing_module.py prepare restores the original bucket)."""
         assert self.binded and self.params_initialized
-        # propagate params to the target bucket before switching
         bucket_key = data_batch.bucket_key
         original_bucket_key = self._curr_bucket_key
         data_shapes = data_batch.provide_data
         label_shapes = data_batch.provide_label
         self.switch_bucket(bucket_key, data_shapes, label_shapes)
-        self._curr_bucket_key = bucket_key
+        # switch back — the request was just to prepare the module
+        self._curr_module = self._buckets[original_bucket_key]
+        self._curr_bucket_key = original_bucket_key
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized

@@ -79,7 +79,35 @@ class Module(BaseModule):
         self._exec = None
         self._data_shapes = None
         self._label_shapes = None
-        self._sharding = None  # set by mxnet_tpu.parallel helpers
+        self._plan = None  # parallel.ShardingPlan (set_sharding_plan)
+
+    def set_sharding_plan(self, plan):
+        """Attach a parallel.ShardingPlan; bind() will place data batch-
+        sharded and params per plan.param_rules over the plan's mesh.  The
+        replacement for DataParallelExecutorGroup/group2ctx: same Module
+        code drives 1 chip or a pod slice."""
+        assert not self.binded, "set_sharding_plan must precede bind"
+        self._plan = plan
+
+    def _build_sharding_map(self):
+        if self._plan is None:
+            return None
+        plan = self._plan
+        shardings = {}
+        for d in self._data_shapes:
+            shardings[d.name] = plan.data_sharding(d.shape)
+        for l in (self._label_shapes or []):
+            shardings[l.name] = plan.data_sharding(l.shape)
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(
+            **{d.name: d.shape for d in self._data_shapes},
+            **({l.name: l.shape for l in self._label_shapes}
+               if self._label_shapes else {}))
+        for name, s in zip(self._symbol.list_arguments(), arg_shapes):
+            if name not in shardings:
+                shardings[name] = plan.param_sharding(name, tuple(s))
+        for name, s in zip(self._aux_names, aux_shapes):
+            shardings[name] = plan.param_sharding(name, tuple(s))
+        return shardings
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -258,11 +286,13 @@ class Module(BaseModule):
                 aux[name] = _wrap(jnp.zeros(tuple(s), t), ctx)
 
         self._exec = Executor(self._symbol, ctx, args, None, req, aux,
-                              sharding=self._sharding)
+                              sharding=self._build_sharding_map())
         self.binded = True
 
         if shared_module is not None and shared_module.params_initialized:
             self.set_params(*shared_module.get_params())
+            if shared_module.optimizer_initialized:
+                self.borrow_optimizer(shared_module)
         elif self._arg_params is not None:
             # params preloaded (e.g. Module.load)
             self.params_initialized = True

@@ -261,7 +261,17 @@ class NDArray:
     def copyto(self, other):
         import jax
         if isinstance(other, NDArray):
-            other._data = jax.device_put(self._data, other.context.jax_device())
+            # preserve the destination's (possibly multi-device) sharding —
+            # params placed over a mesh must stay sharded through the
+            # get_params/set_params round-trips of Module.fit
+            dst = other.context.jax_device()
+            try:
+                sh = other._data.sharding
+                if len(sh.device_set) > 1:
+                    dst = sh
+            except AttributeError:
+                pass
+            other._data = jax.device_put(self._data, dst)
             return other
         if isinstance(other, Context):
             return _wrap(jax.device_put(self._data, other.jax_device()), other)

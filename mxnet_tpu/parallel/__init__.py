@@ -1,0 +1,19 @@
+"""mxnet_tpu.parallel — mesh-based parallelism (the TPU-native replacement
+for the reference's executor_group + kvstore comm stack).
+
+Reference mapping (SURVEY §2.3):
+- DataParallelExecutorGroup batch-split + kvstore reduce
+  (python/mxnet/module/executor_group.py:266, src/kvstore/comm.h)
+  → `ShardingPlan(data_parallel=...)`: batch axis sharded over the mesh,
+  gradient psum compiled into the train step by XLA's SPMD partitioner.
+- group2ctx manual model parallelism (include/mxnet/executor.h:120)
+  → `param_rules` regex → PartitionSpec tensor parallelism.
+- absent-in-reference SP/CP → ring attention (ring_attention.py).
+- absent-in-reference PP → microbatched pipeline (pipeline.py).
+"""
+from .mesh import make_mesh, ShardingPlan, data_parallel_plan
+from .ring_attention import ring_attention, blockwise_attention
+from .pipeline import pipeline_shard_map
+
+__all__ = ["make_mesh", "ShardingPlan", "data_parallel_plan",
+           "ring_attention", "blockwise_attention", "pipeline_shard_map"]

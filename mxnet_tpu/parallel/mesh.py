@@ -1,0 +1,120 @@
+"""Mesh construction + sharding plans.
+
+The scaling-book recipe: pick a mesh with named axes (dp/tp/pp/sp/ep),
+annotate array shardings with PartitionSpecs, let XLA insert the collectives
+(psum over dp for grads rides ICI), profile, iterate.  This module is the
+annotation layer; the executor/Module consume a :class:`ShardingPlan` and
+place arrays accordingly — computation then follows data under jit.
+"""
+from __future__ import annotations
+
+import re
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "ShardingPlan", "data_parallel_plan"]
+
+_AXIS_ORDER = ("dp", "pp", "tp", "sp", "ep")
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a jax.sharding.Mesh from {axis_name: size}.
+
+    `axes` sizes must multiply to the device count (a -1 size is inferred).
+    Axis order follows dp, pp, tp, sp, ep then custom names — keeping dp
+    outermost so batch shards map to the slowest-varying (DCN-adjacent)
+    dimension and tp/sp ride ICI neighbours, per the scaling-book layout.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axes is None:
+        axes = {"dp": n}
+    names = [a for a in _AXIS_ORDER if a in axes] + \
+            [a for a in axes if a not in _AXIS_ORDER]
+    sizes = [axes[a] for a in names]
+    if -1 in sizes:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        sizes[sizes.index(-1)] = n // known
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != n:
+        raise MXNetError("mesh axes %s multiply to %d but %d devices present"
+                         % (dict(zip(names, sizes)), total, n))
+    dev_array = np.array(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+class ShardingPlan:
+    """Placement rules for a compiled step over a Mesh.
+
+    - `data_axes`: {axis_index_of_batch: mesh_axis} for data/label inputs;
+      default shards dim 0 over 'dp' (and 'sp' shards dim 1 if present for
+      sequence inputs via `seq_axis`).
+    - `param_rules`: [(regex, PartitionSpec-like tuple)] matched against
+      parameter names, first hit wins; unmatched params are replicated.
+      This generalizes the reference's group2ctx attr to named-axis specs.
+    """
+
+    def __init__(self, mesh, batch_axis="dp", seq_axis=None, param_rules=None):
+        self.mesh = mesh
+        self.batch_axis = batch_axis if batch_axis in mesh.axis_names else None
+        self.seq_axis = seq_axis if (seq_axis and seq_axis in mesh.axis_names) \
+            else None
+        self.param_rules = [(re.compile(p), tuple(spec))
+                            for p, spec in (param_rules or [])]
+
+    # ------------------------------------------------------------------
+    def _named(self, spec):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self):
+        return self._named(())
+
+    def data_sharding(self, shape):
+        """Batch inputs: dim0 over dp (+ dim1 over sp when configured)."""
+        spec = [None] * len(shape)
+        if len(shape) >= 1 and self.batch_axis:
+            if shape[0] % self.mesh.shape[self.batch_axis] == 0:
+                spec[0] = self.batch_axis
+        if len(shape) >= 2 and self.seq_axis:
+            if shape[1] % self.mesh.shape[self.seq_axis] == 0:
+                spec[1] = self.seq_axis
+        while spec and spec[-1] is None:
+            spec.pop()
+        return self._named(tuple(spec))
+
+    def param_sharding(self, name, shape):
+        for rx, spec in self.param_rules:
+            if rx.search(name):
+                spec = tuple(spec[:len(shape)])
+                # drop axes that don't divide evenly (falls back to replicate
+                # on that dim, like XLA would reject otherwise)
+                cleaned = []
+                for dim, ax in zip(shape, spec):
+                    if ax is not None and dim % self.mesh.shape[ax] != 0:
+                        ax = None
+                    cleaned.append(ax)
+                while cleaned and cleaned[-1] is None:
+                    cleaned.pop()
+                return self._named(tuple(cleaned))
+        return self.replicated()
+
+    def place(self, jax_array, sharding):
+        import jax
+        return jax.device_put(jax_array, sharding)
+
+
+def data_parallel_plan(mesh=None, devices=None):
+    """The `kvstore=device` collapse: pure data parallelism over all devices."""
+    if mesh is None:
+        mesh = make_mesh({"dp": -1}, devices)
+    return ShardingPlan(mesh, batch_axis="dp")

@@ -1,0 +1,100 @@
+"""Pipeline parallelism: GPipe-style microbatched stage pipeline over the
+'pp' mesh axis.
+
+Absent in the reference (SURVEY §2.3: only PartialForward stepping exists,
+include/mxnet/executor.h:70); built TPU-natively: every device holds one
+stage's params; activations hop stage→stage with `ppermute` inside a
+`lax.scan` over ticks, so the whole pipeline — bubbles and all — is one XLA
+program.  With M microbatches and P stages the scan runs M+P-1 ticks.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["pipeline_shard_map", "pipeline_stage_fn"]
+
+
+def pipeline_stage_fn(stage_fn, axis_name="pp"):
+    """Wrap `stage_fn(params, x) -> y` into a per-device pipeline body to run
+    inside shard_map: microbatches enter stage 0, exit stage P-1.
+
+    Inputs inside shard_map (per device):
+      params: this device's stage params (any pytree)
+      x:      (M, mb, ...) all microbatches (only stage 0 reads them)
+    Returns (M, mb, ...) outputs (only valid on the last stage; shard_map
+    gathers the 'pp'-collected output of the last stage via psum masking).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def body(params, x):
+        n_stage = lax.psum(1, axis_name)
+        stage = lax.axis_index(axis_name)
+        m = x.shape[0]
+        n_ticks = m + n_stage - 1
+        perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+        y0 = jnp.zeros_like(stage_fn(params, x[0]))
+        outputs = jnp.zeros((m,) + y0.shape, y0.dtype)
+        state = jnp.zeros_like(x[0])
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (if still in range)
+            inject = x[jnp.minimum(t, m - 1)]
+            state = jnp.where(stage == 0, inject, state)
+            y = stage_fn(params, state)
+            # last stage collects microbatch (t - n_stage + 1)
+            out_idx = t - (n_stage - 1)
+            valid = (stage == n_stage - 1) & (out_idx >= 0)
+            outputs = lax.cond(
+                valid,
+                lambda o: o.at[jnp.maximum(out_idx, 0)].set(y),
+                lambda o: o, outputs)
+            # rotate activations to the next stage
+            state = lax.ppermute(y, axis_name, perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = lax.scan(tick, (state, outputs),
+                                       jnp.arange(n_ticks))
+        # broadcast the last stage's collected outputs to every stage so the
+        # shard_map out_spec can be replicated-over-pp
+        outputs = lax.psum(
+            jnp.where(stage == n_stage - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+        return outputs
+
+    return body
+
+
+def pipeline_shard_map(stage_fn, mesh, stage_params, x, n_microbatch,
+                       axis_name="pp"):
+    """Run a full pipeline: split x into microbatches, stages over `mesh`.
+
+    stage_params: pytree whose leaves have a leading stage axis of size P
+    (device i gets slice i — its stage's params).
+    x: (batch, ...) global input; batch must divide n_microbatch.
+    Returns (batch, ...) outputs from the final stage.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    b = x.shape[0]
+    assert b % n_microbatch == 0, "batch must divide n_microbatch"
+    mb = b // n_microbatch
+    xm = x.reshape((n_microbatch, mb) + x.shape[1:])
+
+    body = pipeline_stage_fn(stage_fn, axis_name)
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
+    fn = shard_map(
+        lambda p, xx: body(jax.tree_util.tree_map(
+            lambda l: l[0], p), xx),          # strip the stage axis
+        mesh=mesh,
+        in_specs=(param_spec, P()),
+        out_specs=P(),
+        check_vma=False)
+    out = fn(stage_params, xm)
+    return out.reshape((b,) + out.shape[2:])

@@ -1,0 +1,157 @@
+"""Ring attention: sequence/context parallelism for long sequences.
+
+Green-field for the reference (SURVEY §5 "Long-context: absent — predates
+it"); design follows the public ring-attention recipe (PAPERS.md): shard the
+sequence over the 'sp' mesh axis, keep Q resident, rotate K/V blocks around
+the ring with `ppermute` (ICI neighbour hops), and accumulate attention with
+numerically-stable running log-sum-exp (flash/blockwise softmax) so no
+device ever materializes the full S×S score matrix.
+
+Layouts: q/k/v are (batch, seq, heads, head_dim) — seq is the sharded dim.
+`blockwise_attention` is the single-device memory-efficient kernel (lax.scan
+over KV blocks); `ring_attention` wraps it in shard_map over the ring.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["blockwise_attention", "ring_attention", "ring_attention_sharded"]
+
+_NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    import jax.numpy as jnp
+    # (b, s_q, h, d) x (b, s_k, h, d) -> (b, h, s_q, s_k)
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+
+
+def _stable_update(o, m, l, scores, v):
+    """One blockwise-softmax accumulation step.
+
+    o: (b, s_q, h, d) running weighted values (unnormalized)
+    m: (b, h, s_q) running max;  l: (b, h, s_q) running denominator
+    """
+    import jax.numpy as jnp
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])          # (b,h,q,k)
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def blockwise_attention(q, k, v, block_size=512, causal=False, scale=None,
+                        q_offset=0, kv_offset=0):
+    """Memory-efficient attention: lax.scan over KV blocks.
+
+    Never materializes more than (s_q × block_size) scores; the XLA fusion of
+    this scan is the TPU analog of flash attention's HBM-frugal schedule.
+    q_offset/kv_offset give the absolute positions of the local q/kv shards
+    for causal masking inside ring steps.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    block_size = min(block_size, s_k)
+    n_blocks = (s_k + block_size - 1) // block_size
+    pad = n_blocks * block_size - s_k
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = k.reshape(b, n_blocks, block_size, h, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, n_blocks, block_size, h, d).transpose(1, 0, 2, 3, 4)
+
+    q_pos = q_offset + jnp.arange(s_q)
+
+    def step(carry, blk):
+        o, m, l = carry
+        kblk, vblk, kv_start = blk
+        scores = _block_scores(q, kblk, scale)
+        kv_pos = kv_start + jnp.arange(block_size)
+        pad_mask = kv_pos < (kv_offset + s_k)   # mask padding keys
+        mask = pad_mask[None, None, None, :]
+        if causal:
+            cmask = q_pos[:, None] >= kv_pos[None, :]
+            mask = mask & cmask[None, None, :, :]
+        scores = jnp.where(mask, scores, _NEG_INF)
+        o, m, l = _stable_update(o, m, l, scores, vblk)
+        return (o, m, l), None
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full((b, h, s_q), _NEG_INF, q.dtype)
+    l0 = jnp.zeros((b, h, s_q), q.dtype)
+    starts = kv_offset + jnp.arange(n_blocks) * block_size
+    (o, m, l), _ = jax.lax.scan(step, (o0, m0, l0), (kb, vb, starts))
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+def _ring_body(q, k, v, axis_name, causal, scale, block_size):
+    """Per-device ring loop (runs inside shard_map)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    n_dev = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    q_pos = my_idx * s_q + jnp.arange(s_q)
+
+    def step(carry, t):
+        o, m, l, kc, vc = carry
+        # the kv block currently held started life on device (my_idx - t)
+        src = (my_idx - t) % n_dev
+        kv_pos = src * s_k + jnp.arange(s_k)
+        scores = _block_scores(q, kc, scale)
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
+        o, m, l = _stable_update(o, m, l, scores, vc)
+        # rotate kv to the next device on the ring (ICI neighbour hop);
+        # overlapped with the next step's compute by XLA latency hiding
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o, m, l, kc, vc), None
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full((b, h, s_q), _NEG_INF, q.dtype)
+    l0 = jnp.zeros((b, h, s_q), q.dtype)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
+                                  jnp.arange(n_dev))
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+def ring_attention_sharded(q, k, v, axis_name="sp", causal=False, scale=None,
+                           block_size=512):
+    """Ring attention body for use *inside* an existing shard_map/pjit
+    context where q/k/v are already sequence-sharded."""
+    return _ring_body(q, k, v, axis_name, causal, scale, block_size)
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
+                   block_size=512):
+    """Full entry: shard q/k/v over `axis_name` of `mesh` and run the ring.
+
+    Global result equals dense softmax attention (up to fp error); wall-time
+    scales as S/n_dev per device with K/V rotating over ICI.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    spec = P(None, axis_name, None, None)
+    body = functools.partial(_ring_body, axis_name=axis_name, causal=causal,
+                             scale=scale, block_size=block_size)
+    fn = shard_map(lambda q_, k_, v_: body(q_, k_, v_),
+                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                   check_vma=False)
+    return fn(q, k, v)

@@ -6,10 +6,19 @@ reference's trick of testing multi-device semantics on CPU contexts
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the session env pre-sets JAX_PLATFORMS=axon (the TPU
+# tunnel): unit tests follow the reference's CPU-only strategy; TPU execution
+# is exercised by bench.py / __graft_entry__.py
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the axon TPU-tunnel plugin re-selects itself over the JAX_PLATFORMS env
+# var, so pin the platform through the config API too
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

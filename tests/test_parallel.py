@@ -1,0 +1,204 @@
+"""Parallelism tests on the 8-device virtual CPU mesh (conftest forces
+xla_force_host_platform_device_count=8 — the reference's trick of testing
+multi-device semantics on CPU, tests/python/unittest/test_multi_device_exec.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu.parallel import (make_mesh, ShardingPlan, data_parallel_plan,
+                                ring_attention, blockwise_attention,
+                                pipeline_shard_map)
+
+
+def _mlp_symbol():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    act1 = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy(n=256, d=16, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d) * 3
+    X = np.stack([centers[i % k] + rng.randn(d) * .5 for i in range(n)]
+                 ).astype(np.float32)
+    y = np.array([i % k for i in range(n)], dtype=np.float32)
+    return X, y
+
+
+def test_make_mesh():
+    import jax
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    mesh = make_mesh({"dp": -1, "tp": 2})
+    assert mesh.shape["dp"] == len(jax.devices()) // 2
+
+
+def _train(plan, seed=7, steps=6):
+    X, y = _toy()
+    np.random.seed(seed)
+    it = mio.NDArrayIter(X, y, batch_size=64)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    if plan is not None:
+        mod.set_sharding_plan(plan)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "rescale_grad": 1. / 64})
+    done = 0
+    while done < steps:
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            done += 1
+            if done >= steps:
+                break
+    return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+
+def test_data_parallel_matches_single_device():
+    """dp-sharded training must be numerically identical to unsharded —
+    the psum compiled in by XLA replaces kvstore reduce exactly."""
+    ref = _train(None)
+    dp = _train(data_parallel_plan())
+    for k in ref:
+        np.testing.assert_allclose(ref[k], dp[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_tensor_parallel_matches():
+    """fc weights sharded over tp: same numbers, sharded memory."""
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    plan = ShardingPlan(mesh, batch_axis="dp",
+                        param_rules=[(r"fc\d_weight", ("tp", None))])
+    tp = _train(plan)
+    ref = _train(None)
+    for k in ref:
+        np.testing.assert_allclose(ref[k], tp[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_sharded_param_placement():
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    plan = ShardingPlan(mesh, batch_axis="dp",
+                        param_rules=[("fc1_weight", ("tp", None))])
+    X, y = _toy(n=64)
+    it = mio.NDArrayIter(X, y, batch_size=64)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.set_sharding_plan(plan)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    w = mod._executor.arg_dict["fc1_weight"]._data
+    assert len(w.sharding.device_set) == 8
+    # sharded on dim 0 over tp=2: each device holds a (16, 16) shard
+    shard_shape = w.sharding.shard_shape(w.shape)
+    assert shard_shape == (16, 16)
+
+
+def test_dp_fit_multi_epoch():
+    """Regression: the epoch-boundary get_params/set_params round-trip in
+    fit() must not strip the mesh sharding from params (copyto preserves
+    destination placement)."""
+    X, y = _toy()
+    it = mio.NDArrayIter(X, y, batch_size=64, shuffle=True)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.set_sharding_plan(data_parallel_plan())
+    mod.fit(it, num_epoch=3, optimizer_params={"learning_rate": 0.5})
+    acc = mod.score(mio.NDArrayIter(X, y, batch_size=64), "acc")[0][1]
+    assert acc > 0.9, acc
+    w = mod._executor.arg_dict["fc1_weight"]._data
+    assert len(w.sharding.device_set) == 8
+
+
+# ---------------------------------------------------------------------------
+# ring attention
+# ---------------------------------------------------------------------------
+
+def _dense_attention(q, k, v, causal=False):
+    d = q.shape[-1]
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = np.tril(np.ones((sq, sk), bool))
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_attention(causal):
+    rng = np.random.RandomState(0)
+    q = rng.randn(2, 16, 2, 8).astype(np.float32)
+    k = rng.randn(2, 16, 2, 8).astype(np.float32)
+    v = rng.randn(2, 16, 2, 8).astype(np.float32)
+    out = np.asarray(blockwise_attention(q, k, v, block_size=4, causal=causal))
+    ref = _dense_attention(q, k, v, causal)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention(causal):
+    import jax
+    mesh = make_mesh({"sp": 8})
+    rng = np.random.RandomState(1)
+    q = rng.randn(2, 32, 2, 8).astype(np.float32)
+    k = rng.randn(2, 32, 2, 8).astype(np.float32)
+    v = rng.randn(2, 32, 2, 8).astype(np.float32)
+    out = np.asarray(ring_attention(q, k, v, mesh, axis_name="sp",
+                                    causal=causal))
+    ref = _dense_attention(q, k, v, causal)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# pipeline
+# ---------------------------------------------------------------------------
+
+def test_pipeline_matches_sequential():
+    import jax.numpy as jnp
+    mesh = make_mesh({"pp": 8})
+    rng = np.random.RandomState(2)
+    # 8 stages, each y = tanh(x @ w_i)
+    Ws = rng.randn(8, 16, 16).astype(np.float32) * 0.5
+    x = rng.randn(32, 16).astype(np.float32)
+
+    def stage(w, xx):
+        return jnp.tanh(xx @ w)
+
+    out = np.asarray(pipeline_shard_map(stage, mesh, Ws, x, n_microbatch=4))
+    ref = x
+    for i in range(8):
+        ref = np.tanh(ref @ Ws[i])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# gradient compression
+# ---------------------------------------------------------------------------
+
+def test_two_bit_compression_error_feedback():
+    """compute_expected_2bit_quantization math from the reference's
+    test_kvstore.py: quantize to {-t, 0, +t} with residual feedback."""
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", mx.nd.zeros((4,)))
+    g = mx.nd.array([0.7, -0.6, 0.2, 0.0])
+    kv.push("w", g)
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+    # residual [0.2, -0.1, 0.2, 0.0] feeds forward: push 0.4 -> 0.2+0.4 >= t
+    kv2 = mx.kv.create("device")
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv2.init("w", mx.nd.zeros((4,)))
+    kv2.push("w", g)
+    kv2.push("w", mx.nd.array([0.4, 0.0, 0.4, 0.0]))
+    kv2.pull("w", out=out)
+    # second push quantizes residual+g2 = [0.6, -0.1, 0.6, 0] -> [0.5,0,0.5,0]
+    # store overwrites (no updater): holds the last quantized push
+    np.testing.assert_allclose(out.asnumpy(), [0.5, 0.0, 0.5, 0.0])

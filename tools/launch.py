@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Distributed job launcher.
+
+Reference: tools/launch.py (dmlc_tracker ssh/mpi/yarn/sge + local).  The
+TPU-native job has no scheduler/server roles — this launcher spawns N
+identical worker processes (local or via ssh) with the env contract consumed
+by mxnet_tpu.kvstore_dist (DMLC_* names kept for CLI compatibility):
+
+  python tools/launch.py -n 4 --launcher local python train.py ...
+
+Local mode is the test harness for multi-host logic on one machine
+(reference tests/nightly pattern: N processes over loopback).
+"""
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(num_workers, command, env_extra=None):
+    port = _free_port()
+    procs = []
+    for rank in range(num_workers):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env.update({
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(num_workers),
+            "DMLC_WORKER_ID": str(rank),
+            "DMLC_ROLE": "worker",
+        })
+        procs.append(subprocess.Popen(command, env=env))
+    codes = [p.wait() for p in procs]
+    return next((c for c in codes if c), 0)
+
+
+def launch_ssh(hosts, num_workers, command):
+    port = _free_port()
+    root = hosts[0]
+    procs = []
+    for rank in range(num_workers):
+        host = hosts[rank % len(hosts)]
+        envs = " ".join("%s=%s" % kv for kv in [
+            ("DMLC_PS_ROOT_URI", root), ("DMLC_PS_ROOT_PORT", str(port)),
+            ("DMLC_NUM_WORKER", str(num_workers)),
+            ("DMLC_WORKER_ID", str(rank)), ("DMLC_ROLE", "worker")])
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host,
+               "cd %s; env %s %s" % (os.getcwd(), envs, " ".join(command))]
+        procs.append(subprocess.Popen(cmd))
+    codes = [p.wait() for p in procs]
+    return next((c for c in codes if c), 0)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Launch a distributed job")
+    parser.add_argument("-n", "--num-workers", required=True, type=int,
+                        help="number of worker processes")
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="ignored (no PS roles on TPU; kept for CLI compat)")
+    parser.add_argument("--launcher", default="local",
+                        choices=["local", "ssh"])
+    parser.add_argument("-H", "--hostfile", default=None,
+                        help="hostfile for ssh launcher, one host per line")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+    if args.launcher == "local":
+        sys.exit(launch_local(args.num_workers, args.command))
+    hosts = [l.strip() for l in open(args.hostfile) if l.strip()]
+    sys.exit(launch_ssh(hosts, args.num_workers, args.command))
+
+
+if __name__ == "__main__":
+    main()
