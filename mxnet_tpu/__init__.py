@@ -44,3 +44,4 @@ from . import callback
 from . import module
 from . import module as mod
 from .module import Module
+from . import gluon

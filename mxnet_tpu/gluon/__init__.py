@@ -1,0 +1,15 @@
+"""mxnet_tpu.gluon — the imperative-first API (reference python/mxnet/gluon).
+
+Define-by-run Blocks with opt-in compilation (hybridize → CachedOp ≡
+jax.jit) — the API shape closest to the JAX substrate (SURVEY §2.2).
+"""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+from . import rnn
+from . import data
+from . import model_zoo

@@ -1,0 +1,84 @@
+"""DataLoader: mini-batches from a Dataset.
+
+Reference: python/mxnet/gluon/data/dataloader.py — multiprocess workers over
+POSIX shm (cpu_shared_storage_manager.h).
+
+TPU-native redesign: worker parallelism uses a thread pool — batchify is
+numpy (releases the GIL in C) and the expensive decode also runs in C, so
+threads deliver the overlap without the reference's shared-memory
+serialization machinery; the batch lands on device once per step.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ... import ndarray as nd
+from .sampler import SequentialSampler, RandomSampler, BatchSampler, Sampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], nd.NDArray):
+        return nd.invoke("stack", list(data), {"axis": 0,
+                                               "num_args": len(data)})
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+class DataLoader(object):
+    """Loads data from a dataset and returns mini-batches
+    (dataloader.py:146)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0):
+        self._dataset = dataset
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler "
+                                 "is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch "
+                             "must not be specified if batch_sampler is "
+                             "specified.")
+
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers
+        if batchify_fn is None:
+            batchify_fn = default_batchify_fn
+        self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[idx] for idx in batch])
+            return
+
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            futures = [pool.submit(
+                lambda b: self._batchify_fn([self._dataset[i] for i in b]),
+                batch) for batch in self._batch_sampler]
+            for f in futures:
+                yield f.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

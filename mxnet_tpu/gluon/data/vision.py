@@ -1,0 +1,212 @@
+"""Vision datasets + transforms.
+
+Reference: python/mxnet/gluon/data/vision.py — MNIST, FashionMNIST,
+CIFAR10/100, ImageRecordDataset, ImageFolderDataset.
+
+Zero-egress environment: datasets read from `root` if present (standard
+idx/binary formats); `download` raises unless the file already exists.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import tarfile
+
+import numpy as np
+
+from ... import ndarray as nd
+from ... import recordio
+from .dataset import Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        if not os.path.isdir(self._root):
+            os.makedirs(self._root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from local idx files (vision.py:36)."""
+
+    _train_files = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+    _test_files = ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+    def _find(self, name):
+        for cand in (name, name + ".gz"):
+            p = os.path.join(self._root, cand)
+            if os.path.exists(p):
+                return p
+        raise IOError(
+            "%s not found under %s (no network egress; place the standard "
+            "MNIST idx files there)" % (name, self._root))
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _get_data(self):
+        img_name, lbl_name = self._train_files if self._train \
+            else self._test_files
+        with self._open(self._find(lbl_name)) as fin:
+            magic, num = struct.unpack(">II", fin.read(8))
+            label = np.frombuffer(fin.read(num), dtype=np.uint8).astype(np.int32)
+        with self._open(self._find(img_name)) as fin:
+            magic, num, rows, cols = struct.unpack(">IIII", fin.read(16))
+            data = np.frombuffer(fin.read(num * rows * cols), dtype=np.uint8)
+            data = data.reshape(num, rows, cols, 1)
+        self._data = [nd.array(x, dtype=np.uint8) for x in data]
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    """FashionMNIST — same idx format, different files (vision.py:86)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the local binary batches (vision.py:111)."""
+
+    _archive = "cifar-10-binary.tar.gz"
+    _train_names = ["data_batch_%d.bin" % i for i in range(1, 6)]
+    _test_names = ["test_batch.bin"]
+    _entry_bytes = 3073
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+    def _find(self, name):
+        for base, _, files in os.walk(self._root):
+            if name in files:
+                return os.path.join(base, name)
+        archive = os.path.join(self._root, self._archive)
+        if os.path.exists(archive):
+            with tarfile.open(archive) as tf:
+                tf.extractall(self._root)
+            return self._find(name)
+        raise IOError("%s not found under %s (no network egress)"
+                      % (name, self._root))
+
+    def _read_batch(self, filename):
+        with open(self._find(filename), "rb") as fin:
+            raw = fin.read()
+        data = np.frombuffer(raw, dtype=np.uint8).reshape(
+            -1, self._entry_bytes)
+        return data[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), \
+            data[:, 0].astype(np.int32)
+
+    def _get_data(self):
+        names = self._train_names if self._train else self._test_names
+        data, label = zip(*[self._read_batch(name) for name in names])
+        data = np.concatenate(data)
+        label = np.concatenate(label)
+        self._data = [nd.array(x, dtype=np.uint8) for x in data]
+        self._label = label
+
+
+class CIFAR100(CIFAR10):
+    """CIFAR100 binary format (coarse+fine label bytes)."""
+
+    _archive = "cifar-100-binary.tar.gz"
+    _train_names = ["train.bin"]
+    _test_names = ["test.bin"]
+    _entry_bytes = 3074
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 fine_label=True, train=True, transform=None):
+        self._fine = 1 if fine_label else 0
+        super().__init__(root, train, transform)
+
+    def _read_batch(self, filename):
+        with open(self._find(filename), "rb") as fin:
+            raw = fin.read()
+        data = np.frombuffer(raw, dtype=np.uint8).reshape(
+            -1, self._entry_bytes)
+        return data[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), \
+            data[:, self._fine].astype(np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images packed in a RecordIO file (vision.py:168)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack_img(record, self._flag)
+        img = nd.array(img, dtype=np.uint8)
+        if self._transform is not None:
+            return self._transform(img, header.label)
+        return img, header.label
+
+
+class ImageFolderDataset(Dataset):
+    """root/category/image.jpg layout (vision.py:191)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        from ...image import image as img_mod
+        with open(self.items[idx][0], "rb") as f:
+            img = img_mod.imdecode(f.read(), self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,419 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference: python/mxnet/gluon/parameter.py — Parameter with deferred shape
+init, grad_req, per-context data; ParameterDict with prefix namespacing,
+save:618/load:641.
+
+TPU note: a Parameter holds ONE NDArray (jax.Array) — "per-context copies"
+(list_data/list_grad) collapse to views of the single sharded array; the
+mesh, not the param dict, owns multi-device placement.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..context import Context, cpu, current_context
+from ..initializer import InitDesc
+from .. import initializer as init_mod
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization."""
+
+
+class Parameter(object):
+    """A Container holding parameters (weights) of Blocks
+    (gluon/parameter.py:33).
+
+    grad_req: 'write' | 'add' | 'null'.
+    Shape entries of 0 (or None) defer initialization until the first
+    forward pass infers them.
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None
+        self._grad = None
+        self._deferred_init = ()
+        self.name = name
+        self._differentiable = differentiable
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = None
+        self.grad_req = grad_req
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._stype = stype
+        self._grad_stype = grad_stype
+
+    def __repr__(self):
+        s = "Parameter {name} (shape={shape}, dtype={dtype})"
+        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+
+    # ------------------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ["write", "add", "null"], \
+            "grad_req must be one of 'write', 'add', or 'null', but got %s" % req
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+        elif self._data is not None and self._grad is None:
+            self._init_grad()
+
+    def _check_and_get(self, arr, ctx):
+        if arr is not None:
+            return arr
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter %s has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass. Please pass one batch of "
+                "data through the network before accessing Parameters." % self.name)
+        raise RuntimeError(
+            "Parameter %s has not been initialized. Note that you should "
+            "initialize parameters and create Trainer with "
+            "Block.collect_params() instead of Block.params because the "
+            "later does not include Parameters of nested child Blocks" % self.name)
+
+    def _load_init(self, data, ctx):
+        """Override with pre-loaded values (used by load)."""
+        if self.shape:
+            for self_dim, data_dim in zip(self.shape, data.shape):
+                assert self_dim == 0 or self_dim == data_dim, \
+                    "Failed loading Parameter %s from saved params: shape " \
+                    "incompatible expacted %s vs saved %s" % (
+                        self.name, str(self.shape), str(data.shape))
+        if self.dtype and np.dtype(self.dtype) != data.dtype:
+            data = data.astype(self.dtype)
+        if self._data is None:
+            self._deferred_init = ()
+            self._init_impl(data)
+        else:
+            self.set_data(data)
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init = self._deferred_init
+        self._deferred_init = ()
+        assert self.shape is not None and np.prod(self.shape) > 0, \
+            "Cannot initialize Parameter %s because it has invalid shape: %s." \
+            % (self.name, str(self.shape))
+        data = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx)
+        # the resolved init applies directly via _init_weight — gluon params
+        # carry explicit inits (bias='zeros', gamma='ones', ...), so the
+        # Module-path magic-name dispatch must NOT run here (reference
+        # parameter.py _finish_deferred_init passes {'__init__': init})
+        initializer = init_mod.create(init if init is not None
+                                      else default_init)
+        if isinstance(initializer, init_mod.Initializer):
+            initializer._init_weight(InitDesc(self.name, {}), data)
+        else:
+            initializer(InitDesc(self.name, {}), data)
+        self._init_impl(data)
+
+    def _init_impl(self, data):
+        self._data = data if isinstance(data, nd.NDArray) else nd.array(data)
+        if self.shape is None or 0 in self.shape:
+            self.shape = self._data.shape
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._grad = nd.zeros(self._data.shape, dtype=self._data.dtype,
+                              ctx=self._data.context)
+        from .. import autograd
+        autograd.mark_variables([self._data], [self._grad],
+                                grad_reqs=self._grad_req)
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Initialize data and grad (gluon/parameter.py initialize)."""
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        if self.shape is None or np.prod(self.shape) <= 0:
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise ValueError(
+                "Cannot initialize Parameter %s because it has invalid "
+                "shape: %s." % (self.name, str(self.shape)))
+        self._deferred_init = (init, ctx, default_init)
+        self._finish_deferred_init()
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_context(
+                ctx[0] if isinstance(ctx, (list, tuple)) else ctx)
+
+    def set_data(self, data):
+        """Set this parameter's value on all contexts."""
+        assert self._data is not None, \
+            "Parameter %s has not been initialized" % self.name
+        if isinstance(data, nd.NDArray):
+            data.copyto(self._data)
+        else:
+            self._data[:] = data
+
+    def data(self, ctx=None):
+        """The parameter NDArray (the single sharded array on TPU)."""
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter %s because grad_req="
+                "'null'" % self.name)
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return [self._deferred_init[1]]
+            raise RuntimeError("Parameter %s has not been initialized" % self.name)
+        return [self._data.context]
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        self._grad[:] = 0
+
+    def var(self):
+        """Symbol of this parameter (for HybridBlock tracing)."""
+        from .. import symbol
+        if self._var is None:
+            # dims of 0 mean "unknown" in the reference's C++ inference; the
+            # jax.eval_shape-based infer needs fully-unknown (None) so the
+            # op's fill_shapes hook completes the shape from the data
+            shape = self.shape
+            if shape is not None and 0 in shape:
+                shape = None
+            self._var = symbol.var(self.name, shape=shape,
+                                   dtype=self.dtype, lr_mult=self.lr_mult,
+                                   wd_mult=self.wd_mult, init=self.init)
+        return self._var
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        from .. import autograd
+        with autograd.pause():
+            self._data = self._data.astype(dtype)
+            if self._grad is not None:
+                self._grad = self._grad.astype(dtype)
+                autograd.mark_variables([self._data], [self._grad],
+                                        grad_reqs=self._grad_req)
+
+
+class Constant(Parameter):
+    """A constant parameter (grad_req null, init from value)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, nd.NDArray):
+            value = nd.array(value)
+        self.value = value
+
+        class Init(init_mod.Initializer):
+            def _init_weight(self, _, arr):
+                value.copyto(arr)
+        init_name = "Constant_{}_{}".format(name, id(self))
+        init_mod._INIT_REGISTRY[init_name.lower()] = Init
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=init_name)
+
+
+class ParameterDict(object):
+    """A dictionary managing Parameters with prefix namespacing
+    (gluon/parameter.py:430)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        s = "{name}(\n{content}\n)"
+        name = self._prefix + " " if self._prefix else ""
+        return s.format(name=name, content="\n".join(
+            [repr(v).replace("\n", "\n  ") for v in self.values()]))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Retrieve or create a Parameter named prefix+name."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and len(v) == len(existing):
+                        inferred_shape = []
+                        matched = True
+                        for dim1, dim2 in zip(v, existing):
+                            if dim1 != dim2 and dim1 * dim2 != 0:
+                                matched = False
+                                break
+                            elif dim1 == dim2:
+                                inferred_shape.append(dim1)
+                            elif dim1 == 0:
+                                inferred_shape.append(dim2)
+                            else:
+                                inferred_shape.append(dim1)
+                        if matched:
+                            param.shape = tuple(inferred_shape)
+                            continue
+                    assert v is None or v == existing, \
+                        "Cannot retrieve Parameter %s because desired " \
+                        "attribute does not match with stored for attribute " \
+                        "%s: desired %s vs stored %s." % (
+                            name, k, str(v), str(getattr(param, k)))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError("No constant named '{}'. Please specify value "
+                               "if you want to create a new constant.".format(name))
+            param = Constant(name, value)
+            self._params[name] = param
+        elif value is not None:
+            assert isinstance(param, Constant), \
+                "Parameter '{}' already exists but it is not a constant.".format(name)
+        return param
+
+    def update(self, other):
+        """Copy all Parameters in `other` into self."""
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    "Cannot update self with other because they have different " \
+                    "Parameters with the same name '%s'" % k
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        if verbose and isinstance(init, init_mod.Initializer):
+            init.set_verbosity(verbose=verbose)
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix '%s' is to be striped before saving, but "
+                    "Parameter's name '%s' does not start with '%s'"
+                    % (strip_prefix, param.name, strip_prefix))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        if restore_prefix:
+            for name in self.keys():
+                assert name.startswith(restore_prefix), \
+                    "restore_prefix is '%s' but Parameter name '%s' does not "\
+                    "start with it" % (restore_prefix, name)
+        lprefix = len(restore_prefix)
+        arg_dict = {restore_prefix + k: v for k, v in nd.load(filename).items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter '%s' is missing in file '%s'" % (name[lprefix:],
+                                                                filename)
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    "Parameter '%s' loaded from file '%s' is not present in " \
+                    "ParameterDict" % (name[lprefix:], filename)
+                continue
+            self[name]._load_init(arg_dict[name], ctx)

@@ -1,0 +1,5 @@
+"""Gluon RNN cells and layers (reference python/mxnet/gluon/rnn/)."""
+from .rnn_cell import *
+from .rnn_layer import *
+from . import rnn_cell
+from . import rnn_layer

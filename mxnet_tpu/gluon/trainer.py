@@ -1,0 +1,137 @@
+"""Gluon Trainer: applies an Optimizer to a set of Parameters.
+
+Reference: python/mxnet/gluon/trainer.py — Trainer:27 (kvstore wiring
+:110-127, step:156 with push/pull :190-195).
+
+TPU note: with the single sharded-array parameter model there is one update
+per parameter per step, running as a fused XLA computation (the optimizer
+ops); kvstore='dist_*' adds the cross-process allreduce before the update.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..model import _create_kvstore
+from .parameter import ParameterDict, Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer(object):
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = optimizer_params.get("rescale_grad", 1.0)
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_initialized = False
+        self._kvstore = kvstore
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        arg_arrays = {param.name: param.data() for param in self._params}
+        kvstore, update_on_kvstore = _create_kvstore(self._kvstore, 1,
+                                                     arg_arrays)
+        if kvstore and "dist" in kvstore.type:
+            # multi-host: grads allreduce through the store, updates local
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            for i, param in enumerate(self._params):
+                kvstore.init(i, param.data())
+            self._kvstore_obj = kvstore
+            self._update_on_kvstore = False
+        else:
+            self._kvstore_obj = kvstore
+            self._update_on_kvstore = update_on_kvstore
+            if kvstore:
+                for i, param in enumerate(self._params):
+                    kvstore.init(i, param.data())
+                if update_on_kvstore:
+                    kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its "
+                              "learning rate can be accessed.")
+        return self._optimizer.learning_rate if hasattr(
+            self._optimizer, "learning_rate") else self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its "
+                              "learning rate is mutated.")
+        self._optimizer.lr = lr
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimizer update scaled by 1/batch_size
+        (trainer.py:156)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+
+        self._optimizer.rescale_grad = self._scale / batch_size
+
+        kv = self._kvstore_obj
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            g = param.grad()
+            if kv is not None and "dist" in kv.type:
+                # cross-process gradient allreduce (DCN collectives)
+                kv.push(i, g)
+                if kv._updater is None:
+                    kv.pull(i, out=g)
+                    self._updaters[0](i, g, param.data())
+                continue
+            if kv is not None and self._update_on_kvstore:
+                kv.push(i, g)
+                kv.pull(i, out=param.data())
+                continue
+            self._updaters[0](i, g, param.data())
+
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore_obj:
+            self._kvstore_obj.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore_obj:
+            self._kvstore_obj.load_optimizer_states(fname)
+            self._optimizer = self._kvstore_obj._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            self._updaters[0].set_states(states)
+            self._updaters[0].optimizer = self._optimizer

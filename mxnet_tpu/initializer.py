@@ -183,6 +183,11 @@ class One(Initializer):
         self._set(arr, 1.0)
 
 
+# reference registers these plural aliases (initializer.py @register alias)
+_INIT_REGISTRY["zeros"] = Zero
+_INIT_REGISTRY["ones"] = One
+
+
 @register
 class Constant(Initializer):
     def __init__(self, value=0.0):

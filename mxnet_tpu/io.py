@@ -530,6 +530,7 @@ class LibSVMIter(DataIter):
                  label_shape=None, batch_size=1, **kwargs):
         super().__init__(batch_size)
         self._data_shape = tuple(data_shape)
+        has_inline_label = label_libsvm is None
         indptr = [0]
         indices = []
         values = []
@@ -539,14 +540,15 @@ class LibSVMIter(DataIter):
                 parts = line.strip().split()
                 if not parts:
                     continue
-                labels.append(float(parts[0]))
-                for kv in parts[1:]:
+                if has_inline_label:
+                    labels.append(float(parts[0]))
+                    parts = parts[1:]
+                for kv in parts:
                     k, v = kv.split(":")
                     indices.append(int(k))
                     values.append(float(v))
                 indptr.append(len(indices))
         if label_libsvm is not None:
-            labels = []
             with open(label_libsvm) as f:
                 for line in f:
                     parts = line.strip().split()

@@ -136,11 +136,17 @@ class KVStore(object):
                                  for v in vlist])
             if self._compressor is not None:
                 merged = self._compressor(k, merged)
+            merged = self._reduce_global(k, merged)
             if self._updater is not None:
                 self._updater(k if isinstance(k, int) else str(k), merged,
                               self._store[k])
             else:
                 self._store[k]._data = merged._data
+
+    def _reduce_global(self, key, merged):
+        """Cross-process reduction hook — identity for single-process stores;
+        KVStoreDist overrides with the DCN allreduce."""
+        return merged
 
     def pull(self, key, out=None, priority=0, row_ids=None):
         assert out is not None

@@ -77,51 +77,38 @@ class KVStoreDist(KVStore):
         return self._size
 
     def _allreduce(self, jax_array):
-        """Cross-process sum as ONE compiled collective: each process
-        contributes its local gradient as a shard on the 'proc' mesh axis and
-        a jitted sum-over-proc with replicated output runs the allreduce
-        on-device (DCN between hosts, ICI within) — no host gather."""
+        """Cross-process sum as ONE compiled collective: each process's
+        device-resident gradient becomes its shard on the 'proc' mesh axis
+        (device-to-device placement, no host copy) and a jitted sum-over-proc
+        with replicated output runs the allreduce on-device (DCN between
+        hosts, ICI within)."""
         if not self._multi:
             return jax_array
         import jax
-        import numpy as np
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        local = np.asarray(jax_array)
-        key = (local.shape, str(local.dtype))
+        in_sharding = NamedSharding(self._mesh, P("proc"))
+        key = (tuple(jax_array.shape), str(jax_array.dtype))
         fn = self._psum_cache.get(key)
         if fn is None:
             fn = jax.jit(lambda x: x.sum(axis=0),
                          out_shardings=NamedSharding(self._mesh, P()))
             self._psum_cache[key] = fn
-        global_shape = (self._size,) + local.shape
-        stacked = jax.make_array_from_process_local_data(
-            NamedSharding(self._mesh, P("proc")), local[None], global_shape)
+        local = jax_array[None]
+        global_shape = (self._size,) + tuple(jax_array.shape)
+        shards = [jax.device_put(local, d)
+                  for d in in_sharding.addressable_devices]
+        stacked = jax.make_array_from_single_device_arrays(
+            global_shape, in_sharding, shards)
         summed = fn(stacked)
         # fully-replicated output: every process holds the complete value
         return summed.addressable_shards[0].data
 
-    def push(self, key, value, priority=0):
-        from .kvstore import _key_value
-        keys, vals = _key_value(key, value)
-        for k, vlist in zip(keys, vals):
-            if k not in self._store:
-                raise MXNetError("key %r not initialized" % (k,))
-            merged = vlist[0]
-            if len(vlist) > 1:
-                from .ndarray import add_n
-                merged = add_n(*vlist)
-            if self._compressor is not None:
-                merged = self._compressor(k, merged)
-            if self._multi:
-                summed = self._allreduce(merged._data)
-                from .ndarray import array as nd_array
-                merged = nd_array(summed)
-            if self._updater is not None:
-                self._updater(k if isinstance(k, int) else str(k), merged,
-                              self._store[k])
-            else:
-                self._store[k]._data = merged._data
+    def _reduce_global(self, key, merged):
+        if not self._multi:
+            return merged
+        from .ndarray.ndarray import _wrap
+        return _wrap(self._allreduce(merged._data), merged._ctx)
 
     def init(self, key, value):
         super().init(key, value)

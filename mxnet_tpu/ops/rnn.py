@@ -1,0 +1,194 @@
+"""Fused multi-layer RNN op (RNN/LSTM/GRU, bidirectional).
+
+Reference: src/operator/rnn-inl.h + cudnn_rnn-inl.h — the cuDNN fused RNN
+with one packed parameter blob (all i2h/h2h weights layer-major, then all
+biases), gate orders LSTM=[i,f,c,o], GRU=[r,z,n] (matching
+python/mxnet/rnn/rnn_cell.py FusedRNNCell unpack order).
+
+TPU-native: each layer is a `lax.scan` over time — XLA compiles the scan
+body into a single fused step (the matmuls hit the MXU); layers/directions
+unroll in Python (static counts).  This is the standard TPU RNN recipe: big
+batched GEMM per step, no per-step kernel launches.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, P
+
+_NGATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _param_layout(mode, input_size, state_size, num_layers, bidirectional):
+    """Yield (kind, layer, direction, shape, offset) for the packed blob —
+    weights first (i2h, h2h per layer·direction), then biases, cuDNN order."""
+    ngates = _NGATES[mode]
+    d = 2 if bidirectional else 1
+    entries = []
+    off = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * d
+        for direction in range(d):
+            for kind, cols in (("i2h", isz), ("h2h", state_size)):
+                shape = (ngates * state_size, cols)
+                entries.append((kind, layer, direction, shape, off))
+                off += shape[0] * shape[1]
+    for layer in range(num_layers):
+        for direction in range(d):
+            for kind in ("i2h_bias", "h2h_bias"):
+                shape = (ngates * state_size,)
+                entries.append((kind, layer, direction, shape, off))
+                off += shape[0]
+    return entries, off
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
+    return _param_layout(mode, input_size, state_size, num_layers,
+                         bidirectional)[1]
+
+
+def _unpack(params, mode, input_size, state_size, num_layers, bidirectional):
+    entries, total = _param_layout(mode, input_size, state_size, num_layers,
+                                   bidirectional)
+    out = {}
+    for kind, layer, direction, shape, off in entries:
+        n = 1
+        for s in shape:
+            n *= s
+        out[(kind, layer, direction)] = params[off:off + n].reshape(shape)
+    return out
+
+
+def _cell_step(mode, state_size):
+    """Returns step(carry, gates_x, w) applying one timestep given
+    precomputed input projection."""
+    if mode == "lstm":
+        def step(h, c, gx, wh, bh):
+            gates = gx + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        return step
+    if mode == "gru":
+        def step(h, c, gx, wh, bh):
+            # cuDNN GRU: r,z,n with separate h2h bias inside the n-gate
+            gh = h @ wh.T + bh
+            rx, zx, nx = jnp.split(gx, 3, axis=-1)
+            rh, zh, nh = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(rx + rh)
+            z = jax.nn.sigmoid(zx + zh)
+            n = jnp.tanh(nx + r * nh)
+            h_new = (1 - z) * n + z * h
+            return h_new, c
+        return step
+    act = jnp.tanh if mode == "rnn_tanh" else (lambda x: jnp.maximum(x, 0))
+
+    def step(h, c, gx, wh, bh):
+        h_new = act(gx + h @ wh.T + bh)
+        return h_new, c
+    return step
+
+
+def _run_layer(x, h0, c0, wi, wh, bi, bh, mode, state_size, reverse=False):
+    """x: (T, N, I); returns (T, N, H), hT, cT."""
+    gx_all = x @ wi.T + bi          # one big batched GEMM over all timesteps
+    step = _cell_step(mode, state_size)
+
+    def body(carry, gx):
+        h, c = carry
+        h, c = step(h, c, gx, wh, bh)
+        return (h, c), h
+
+    (hT, cT), hs = lax.scan(body, (h0, c0), gx_all, reverse=reverse)
+    return hs, hT, cT
+
+
+_RNN_PARAMS = {
+    "state_size": P(int), "num_layers": P(int),
+    "bidirectional": P(bool, False),
+    "mode": P(str, choices=["rnn_relu", "rnn_tanh", "lstm", "gru"]),
+    "p": P(float, 0.0), "state_outputs": P(bool, False),
+    "lstm_state_clip_min": P("float_or_none", None),
+    "lstm_state_clip_max": P("float_or_none", None),
+}
+
+
+def _rnn_fill(attrs, in_shapes):
+    out = list(in_shapes)
+    data = out[0]
+    if data is not None:
+        isz = data[2]
+        h = attrs["state_size"]
+        L = attrs["num_layers"]
+        d = 2 if attrs["bidirectional"] else 1
+        if len(out) > 1 and out[1] is None:
+            out[1] = (rnn_param_size(attrs["mode"], isz, h, L,
+                                     attrs["bidirectional"]),)
+        if len(out) > 2 and out[2] is None:
+            out[2] = (L * d, data[1], h)
+        if len(out) > 3 and out[3] is None:
+            out[3] = (L * d, data[1], h)
+    return out
+
+
+def _rnn_nin(attrs):
+    return 4 if (attrs or {}).get("mode") == "lstm" else 3
+
+
+def _rnn_nout(attrs):
+    if not (attrs or {}).get("state_outputs"):
+        return 1
+    return 3 if attrs.get("mode") == "lstm" else 2
+
+
+@register("RNN", aliases=["rnn"], nin=_rnn_nin,
+          input_names=["data", "parameters", "state", "state_cell"],
+          nout=_rnn_nout, stochastic=True, mode_dependent=True,
+          fill_shapes=_rnn_fill, params=_RNN_PARAMS)
+def rnn(attrs, rng, data, parameters, state, state_cell=None):
+    """data: (T, N, I) time-major (reference layout=TNC only for fused op)."""
+    mode = attrs["mode"]
+    h = attrs["state_size"]
+    L = attrs["num_layers"]
+    bidi = attrs["bidirectional"]
+    d = 2 if bidi else 1
+    T, N, isz = data.shape
+    training = attrs.get("_training", False)
+
+    w = _unpack(parameters, mode, isz, h, L, bidi)
+    x = data
+    h_outs = []
+    c_outs = []
+    for layer in range(L):
+        outs = []
+        for direction in range(d):
+            hi = state[layer * d + direction]
+            ci = state_cell[layer * d + direction] if state_cell is not None \
+                else jnp.zeros_like(hi)
+            hs, hT, cT = _run_layer(
+                x, hi, ci,
+                w[("i2h", layer, direction)], w[("h2h", layer, direction)],
+                w[("i2h_bias", layer, direction)],
+                w[("h2h_bias", layer, direction)],
+                mode, h, reverse=(direction == 1))
+            outs.append(hs)
+            h_outs.append(hT)
+            c_outs.append(cT)
+        x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+        if attrs["p"] > 0 and training and layer < L - 1:
+            keep = 1.0 - attrs["p"]
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(rng, layer), keep, x.shape)
+            x = jnp.where(mask, x / keep, 0)
+
+    if not attrs["state_outputs"]:
+        return (x,)
+    h_stack = jnp.stack(h_outs)
+    if mode == "lstm":
+        return x, h_stack, jnp.stack(c_outs)
+    return x, h_stack

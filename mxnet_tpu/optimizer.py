@@ -618,6 +618,8 @@ class Updater(object):
     def set_states(self, states):
         """Load serialized states (numpy-backed pickle)."""
         states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            states, self.optimizer = states
 
         def to_nd(v):
             if isinstance(v, numpy.ndarray):

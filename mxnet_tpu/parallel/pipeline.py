@@ -83,7 +83,8 @@ def pipeline_shard_map(stage_fn, mesh, stage_params, x, n_microbatch,
     from jax import shard_map
 
     b = x.shape[0]
-    assert b % n_microbatch == 0, "batch must divide n_microbatch"
+    assert b % n_microbatch == 0, \
+        "n_microbatch must evenly divide the batch size"
     mb = b // n_microbatch
     xm = x.reshape((n_microbatch, mb) + x.shape[1:])
 

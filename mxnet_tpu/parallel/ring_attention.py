@@ -105,16 +105,32 @@ def _ring_body(q, k, v, axis_name, causal, scale, block_size):
     my_idx = lax.axis_index(axis_name)
     q_pos = my_idx * s_q + jnp.arange(s_q)
 
+    # sub-block the local KV chunk so no more than (s_q × bs) scores ever
+    # materialize (flash-style memory bound, honoured inside each ring step)
+    bs = min(block_size or s_k, s_k)
+    while s_k % bs:
+        bs -= 1
+    n_sub = s_k // bs
+
+    def _consume_chunk(o, m, l, kc, vc, kv_base):
+        def sub(carry, j):
+            o, m, l = carry
+            kb = lax.dynamic_slice_in_dim(kc, j * bs, bs, axis=1)
+            vb = lax.dynamic_slice_in_dim(vc, j * bs, bs, axis=1)
+            scores = _block_scores(q, kb, scale)
+            if causal:
+                kv_pos = kv_base + j * bs + jnp.arange(bs)
+                mask = q_pos[:, None] >= kv_pos[None, :]
+                scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
+            return _stable_update(o, m, l, scores, vb), None
+        (o, m, l), _ = lax.scan(sub, (o, m, l), jnp.arange(n_sub))
+        return o, m, l
+
     def step(carry, t):
         o, m, l, kc, vc = carry
         # the kv block currently held started life on device (my_idx - t)
         src = (my_idx - t) % n_dev
-        kv_pos = src * s_k + jnp.arange(s_k)
-        scores = _block_scores(q, kc, scale)
-        if causal:
-            mask = q_pos[:, None] >= kv_pos[None, :]
-            scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
-        o, m, l = _stable_update(o, m, l, scores, vc)
+        o, m, l = _consume_chunk(o, m, l, kc, vc, src * s_k)
         # rotate kv to the next device on the ring (ICI neighbour hop);
         # overlapped with the next step's compute by XLA latency hiding
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]

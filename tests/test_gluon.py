@@ -1,0 +1,282 @@
+"""Gluon tests (reference tests/python/unittest/test_gluon.py,
+test_gluon_rnn.py, test_gluon_data.py patterns)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert p.list_data()[0] is p.data()
+
+
+def test_parameter_sharing(tmp_path):
+    class Net(gluon.Block):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.dense0 = nn.Dense(5, in_units=5)
+                self.dense1 = nn.Dense(5, in_units=5)
+
+        def forward(self, x):
+            return self.dense1(self.dense0(x))
+
+    net1 = Net(prefix="net1_")
+    net2 = Net(prefix="net2_", params=net1.collect_params())
+    net1.collect_params().initialize()
+    net2(mx.nd.zeros((3, 5)))
+    path = str(tmp_path / "net1.params")
+    net1.save_params(path)
+    net3 = Net(prefix="net3_")
+    net3.load_params(path, mx.cpu())
+
+
+def test_dense_and_deferred_init():
+    net = nn.Dense(8, activation="relu")
+    net.initialize()
+    x = mx.nd.ones((4, 16))
+    y = net(x)
+    assert y.shape == (4, 8)
+    assert net.weight.shape == (8, 16)
+    assert np.all(y.asnumpy() >= 0)
+
+
+def test_sequential_and_hybridize():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 8))
+    y1 = net(x).asnumpy()
+    net.hybridize()
+    y2 = net(x).asnumpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_step_sgd():
+    net = nn.Dense(1, in_units=4, use_bias=False)
+    net.initialize(mx.init.One())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.ones((2, 4))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(batch_size=2)
+    # w was all ones; y = 4; dL/dw = 2*y*x summed = 16 per w; w' = 1 - .1*16/2
+    np.testing.assert_allclose(net.weight.data().asnumpy(),
+                               np.full((1, 4), 1 - 0.8), rtol=1e-5)
+
+
+def test_gluon_training_converges():
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 10).astype(np.float32)
+    w_true = rng.randn(10, 1).astype(np.float32)
+    Y = X @ w_true
+    dataset = gluon.data.ArrayDataset(X, Y)
+    loader = gluon.data.DataLoader(dataset, batch_size=20, shuffle=True)
+    net = nn.Dense(1)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    l2 = gluon.loss.L2Loss()
+    last = None
+    for epoch in range(15):
+        total = 0
+        for data, label in loader:
+            with mx.autograd.record():
+                out = net(data)
+                loss = l2(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            total += float(loss.mean().asscalar())
+        last = total
+    assert last < 0.05, last
+
+
+def test_conv2d_and_pooling():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"))
+        net.add(nn.MaxPool2D())
+        net.add(nn.Conv2D(16, kernel_size=3, padding=1))
+        net.add(nn.GlobalAvgPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(4))
+    net.initialize()
+    y = net(mx.nd.ones((2, 3, 16, 16)))
+    assert y.shape == (2, 4)
+    net.hybridize()
+    y2 = net(mx.nd.ones((2, 3, 16, 16)))
+    np.testing.assert_allclose(y.asnumpy(), y2.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_batchnorm_stats_update():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(8, 4, 2, 2) * 3 + 1)
+    with mx.autograd.record():
+        y = bn(x)
+    # moving stats must have moved away from init
+    assert abs(bn.running_mean.data().asnumpy()).sum() > 0
+
+
+@pytest.mark.parametrize("loss_name,expect", [
+    ("L2Loss", 0.125), ("L1Loss", 0.5), ("HuberLoss", 0.125)])
+def test_losses(loss_name, expect):
+    loss = getattr(gluon.loss, loss_name)()
+    pred = mx.nd.array([[1.0]])
+    label = mx.nd.array([[0.5]])
+    out = float(loss(pred, label).asscalar())
+    assert abs(out - expect) < 1e-6
+
+
+def test_softmax_ce_loss():
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    pred = mx.nd.array([[10.0, 0.0], [0.0, 10.0]])
+    label = mx.nd.array([0, 1])
+    out = loss(pred, label).asnumpy()
+    assert np.all(out < 0.01)
+
+
+def test_sigmoid_bce():
+    loss = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    pred = mx.nd.array([[100.0], [-100.0]])
+    label = mx.nd.array([[1.0], [0.0]])
+    out = loss(pred, label).asnumpy()
+    assert np.all(out < 1e-4)
+
+
+def test_rnn_cells_unroll():
+    for cell_cls in [gluon.rnn.rnn_cell.RNNCell,
+                     gluon.rnn.rnn_cell.LSTMCell,
+                     gluon.rnn.rnn_cell.GRUCell]:
+        cell = cell_cls(8, input_size=4)
+        cell.initialize()
+        x = mx.nd.ones((2, 3, 4))  # NTC
+        outputs, states = cell.unroll(3, x, layout="NTC",
+                                      merge_outputs=True)
+        assert outputs.shape == (2, 3, 8), (cell_cls, outputs.shape)
+
+
+def test_fused_lstm_layer():
+    lstm = gluon.rnn.LSTM(8, num_layers=2)
+    lstm.initialize()
+    x = mx.nd.ones((5, 2, 4))  # TNC
+    out = lstm(x)
+    assert out.shape == (5, 2, 8)
+    # with explicit states
+    states = lstm.begin_state(batch_size=2)
+    out, new_states = lstm(x, states)
+    assert out.shape == (5, 2, 8)
+    assert new_states[0].shape == (2, 2, 8)
+    assert new_states[1].shape == (2, 2, 8)
+
+
+def test_fused_vs_unfused_lstm():
+    """The fused lax.scan LSTM must match the per-step LSTMCell unroll."""
+    np.random.seed(0)
+    fused = gluon.rnn.LSTM(6, input_size=4, prefix="lstm_")
+    fused.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(3, 2, 4))  # TNC
+    fused_out = fused(x).asnumpy()
+
+    cell = gluon.rnn.rnn_cell.LSTMCell(6, input_size=4, prefix="cell_")
+    cell.initialize()
+    # copy fused weights into the cell
+    cell.i2h_weight.set_data(fused.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(fused.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(fused.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(fused.l0_h2h_bias.data())
+    cell_out, _ = cell.unroll(3, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(fused_out, cell_out.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bidirectional_gru_layer():
+    gru = gluon.rnn.GRU(8, num_layers=1, bidirectional=True)
+    gru.initialize()
+    x = mx.nd.ones((5, 2, 4))
+    out = gru(x)
+    assert out.shape == (5, 2, 16)
+
+
+def test_dataset_dataloader():
+    X = np.arange(40).reshape(10, 4).astype(np.float32)
+    Y = np.arange(10).astype(np.float32)
+    ds = gluon.data.ArrayDataset(X, Y)
+    assert len(ds) == 10
+    x0, y0 = ds[3]
+    np.testing.assert_allclose(x0, X[3])
+    loader = gluon.data.DataLoader(ds, batch_size=4, last_batch="discard")
+    batches = list(loader)
+    assert len(batches) == 2
+    assert batches[0][0].shape == (4, 4)
+    # threaded loader
+    loader = gluon.data.DataLoader(ds, batch_size=5, num_workers=2)
+    assert len(list(loader)) == 2
+
+
+def test_model_zoo_thumbnails():
+    """Smoke-test small-input variants of every family (reference
+    test_gluon_model_zoo.py runs all models; we use tiny inputs)."""
+    net = gluon.model_zoo.vision.resnet18_v1(classes=10, thumbnail=True)
+    net.initialize()
+    y = net(mx.nd.ones((1, 3, 32, 32)))
+    assert y.shape == (1, 10)
+
+    net = gluon.model_zoo.vision.resnet18_v2(classes=10, thumbnail=True)
+    net.initialize()
+    assert net(mx.nd.ones((1, 3, 32, 32))).shape == (1, 10)
+
+    net = gluon.model_zoo.vision.mobilenet0_25(classes=10)
+    net.initialize()
+    assert net(mx.nd.ones((1, 3, 32, 32))).shape == (1, 10)
+
+
+def test_get_model_names():
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    with pytest.raises(ValueError):
+        get_model("no_such_model")
+    net = get_model("squeezenet1.0", classes=4)
+    assert net is not None
+
+
+def test_symbol_block():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    blk = gluon.SymbolBlock(out, data)
+    blk.collect_params().initialize()
+    y = blk(mx.nd.ones((2, 5)))
+    assert y.shape == (2, 3)
+
+
+def test_block_save_load_roundtrip(tmp_path):
+    path = str(tmp_path / "net.params")
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4))
+        net.add(nn.Dense(2, in_units=8))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.ones((1, 4))
+    y1 = net(x).asnumpy()
+    net.save_params(path)
+
+    net2 = nn.HybridSequential(prefix="model_")
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4))
+        net2.add(nn.Dense(2, in_units=8))
+    net2.load_params(path, mx.cpu())
+    y2 = net2(x).asnumpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-6)

@@ -44,8 +44,10 @@ def launch_local(num_workers, command, env_extra=None):
     return next((c for c in codes if c), 0)
 
 
-def launch_ssh(hosts, num_workers, command):
-    port = _free_port()
+def launch_ssh(hosts, num_workers, command, port=None):
+    # _free_port() probes THIS machine, which says nothing about hosts[0];
+    # default to a fixed high port and let --port override on conflict
+    port = port or 29500
     root = hosts[0]
     procs = []
     for rank in range(num_workers):
@@ -71,6 +73,8 @@ def main():
                         choices=["local", "ssh"])
     parser.add_argument("-H", "--hostfile", default=None,
                         help="hostfile for ssh launcher, one host per line")
+    parser.add_argument("--port", type=int, default=None,
+                        help="coordinator port on the first host (ssh mode)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.command:
@@ -78,7 +82,7 @@ def main():
     if args.launcher == "local":
         sys.exit(launch_local(args.num_workers, args.command))
     hosts = [l.strip() for l in open(args.hostfile) if l.strip()]
-    sys.exit(launch_ssh(hosts, args.num_workers, args.command))
+    sys.exit(launch_ssh(hosts, args.num_workers, args.command, args.port))
 
 
 if __name__ == "__main__":
