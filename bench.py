@@ -1,0 +1,105 @@
+"""Benchmark driver: ResNet-50 training throughput (images/sec) on the
+available accelerator (one TPU chip under the driver; CPU fallback works).
+
+Baseline: the reference's published 109 images/sec training ResNet-50,
+1x K80, batch 32 (example/image-classification/README.md:147-155;
+BASELINE.md).  Prints ONE JSON line.
+
+The benched step is the framework's real path: symbolic ResNet-50 →
+whole-graph XLA program (fwd+bwd+SGD in one jit), batch 128.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu.models import get_resnet_symbol
+    from mxnet_tpu.executor import build_graph_fn
+
+    platform = jax.devices()[0].platform
+    batch = 256 if platform != "cpu" else 16
+    image = 224 if platform != "cpu" else 64
+    # bf16 params+activations: the TPU-idiomatic training dtype (MXU-native);
+    # labels/loss/batch-norm stats stay f32
+    dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
+
+    net = get_resnet_symbol(num_classes=1000, num_layers=50,
+                            image_shape=(3, image, image))
+    arg_names = net.list_arguments()
+    aux_names = net.list_auxiliary_states()
+    graph_fn = build_graph_fn(net, arg_names, aux_names)
+    shapes = {"data": (batch, 3, image, image), "softmax_label": (batch,)}
+    arg_shapes, _, aux_shapes = net.infer_shape(**shapes)
+
+    rng = np.random.RandomState(0)
+    data_names = {"data", "softmax_label"}
+    args = []
+    for n, s in zip(arg_names, arg_shapes):
+        if n == "data":
+            args.append(jnp.asarray(rng.uniform(0, 1, s).astype(np.float32),
+                                    dtype))
+        elif n == "softmax_label":
+            args.append(jnp.asarray(rng.randint(0, 1000, s).astype(np.float32)))
+        else:
+            args.append(jnp.asarray(
+                rng.uniform(-0.05, 0.05, s).astype(np.float32), dtype))
+    args = tuple(args)
+    auxs = tuple(jnp.zeros(s, jnp.float32) if "mean" in n
+                 else jnp.ones(s, jnp.float32)
+                 for n, s in zip(aux_names, aux_shapes))
+    grad_idx = [i for i, n in enumerate(arg_names) if n not in data_names]
+    label_pos = arg_names.index("softmax_label")
+    lr = 0.05
+
+    def train_step(args, auxs, key):
+        def loss_fn(*wrt):
+            av = list(args)
+            for i, w in zip(grad_idx, wrt):
+                av[i] = w
+            outs, new_aux = graph_fn(tuple(av), auxs, key, True)
+            probs = outs[0].astype(jnp.float32)
+            labels = av[label_pos].astype(jnp.int32)
+            ll = -jnp.mean(jnp.log(probs[jnp.arange(probs.shape[0]),
+                                         labels] + 1e-8))
+            return ll, new_aux
+
+        wrt = tuple(args[i] for i in grad_idx)
+        (loss, new_aux), grads = jax.value_and_grad(
+            loss_fn, argnums=tuple(range(len(wrt))), has_aux=True)(*wrt)
+        new_args = list(args)
+        for i, g in zip(grad_idx, grads):
+            new_args[i] = args[i] - jnp.asarray(lr, args[i].dtype) * g
+        return loss, tuple(new_args), new_aux
+
+    step = jax.jit(train_step, donate_argnums=(0,))
+    key = jax.random.PRNGKey(0)
+
+    # warmup/compile
+    loss, args, auxs = step(args, auxs, key)
+    jax.block_until_ready((loss, args, auxs))
+
+    n_steps = 10 if platform != "cpu" else 3
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        loss, args, auxs = step(args, auxs, jax.random.fold_in(key, i))
+    jax.block_until_ready((loss, args, auxs))
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * n_steps / dt
+    baseline = 109.0  # K80 batch-32 training img/s (BASELINE.md)
+    result = {
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / baseline, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""LSTM language-model symbol (reference example/rnn/lstm_bucketing.py —
+BASELINE.json config 4: LSTM PTB with BucketingModule)."""
+from .. import symbol as sym
+
+
+def lstm_lm_symbol(seq_len, vocab_size=10000, num_embed=200, num_hidden=200,
+                   num_layers=2):
+    """Returns (symbol, data_names, label_names) — a sym_gen for
+    BucketingModule keyed on seq_len."""
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    embed = sym.Embedding(data, input_dim=vocab_size, output_dim=num_embed,
+                          name="embed")
+    # fused RNN op wants TNC
+    body = sym.transpose(embed, axes=(1, 0, 2))
+    params = sym.Variable("lstm_parameters")
+    init_h = sym.Variable("lstm_init_h")
+    init_c = sym.Variable("lstm_init_c")
+    out = sym.RNN(body, params, init_h, init_c, state_size=num_hidden,
+                  num_layers=num_layers, mode="lstm", name="lstm")
+    out = sym.transpose(out, axes=(1, 0, 2))
+    pred = sym.Reshape(out, shape=(-1, num_hidden))
+    pred = sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+    lab = sym.Reshape(label, shape=(-1,))
+    return sym.SoftmaxOutput(pred, lab, name="softmax"), ("data",), \
+        ("softmax_label",)

@@ -198,11 +198,14 @@ def pooling(attrs, data):
                 hi += stride[i] - rem
         pads.append((lo, hi))
     pt = attrs["pool_type"]
+    # init values must be CONCRETE scalars: a traced init breaks
+    # reduce_window's autodiff on the TPU backend
     if pt == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+        init = -np.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else np.iinfo(np.dtype(data.dtype)).min
+        return lax.reduce_window(data, np.array(init, data.dtype), lax.max,
                                  window, strides, pads)
-    summed = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+    summed = lax.reduce_window(data, np.array(0, data.dtype), lax.add,
                                window, strides, pads)
     if pt == "sum":
         return summed

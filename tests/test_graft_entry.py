@@ -1,0 +1,18 @@
+"""Driver-artifact tests: __graft_entry__.dryrun_multichip must compile and
+run the sharded training step on the 8-device virtual CPU mesh (this is what
+the driver validates)."""
+import sys
+import os
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                "..")))
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_2():
+    import __graft_entry__ as g
+    g.dryrun_multichip(2)
