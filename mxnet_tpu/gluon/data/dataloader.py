@@ -10,7 +10,9 @@ serialization machinery; the batch lands on device once per step.
 """
 from __future__ import annotations
 
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from itertools import islice
 
 import numpy as np
 
@@ -73,11 +75,22 @@ class DataLoader(object):
                 yield self._batchify_fn([self._dataset[idx] for idx in batch])
             return
 
+        def _load(b):
+            return self._batchify_fn([self._dataset[i] for i in b])
+
+        # bounded in-flight window: keep ~2x workers of batches pending so a
+        # slow consumer never causes the whole epoch to materialize in memory
+        # (the reference bounds via its worker queue)
         with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
-            futures = [pool.submit(
-                lambda b: self._batchify_fn([self._dataset[i] for i in b]),
-                batch) for batch in self._batch_sampler]
-            for f in futures:
+            batches = iter(self._batch_sampler)
+            window = deque()
+            for batch in islice(batches, 2 * self._num_workers):
+                window.append(pool.submit(_load, batch))
+            while window:
+                f = window.popleft()
+                nxt = next(batches, None)
+                if nxt is not None:
+                    window.append(pool.submit(_load, nxt))
                 yield f.result()
 
     def __len__(self):

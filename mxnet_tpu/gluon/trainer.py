@@ -102,11 +102,14 @@ class Trainer(object):
                 continue
             g = param.grad()
             if kv is not None and "dist" in kv.type:
-                # cross-process gradient allreduce (DCN collectives)
+                # cross-process gradient allreduce (DCN collectives); always
+                # pull the aggregate back and update locally — the dist path
+                # never installs an optimizer on the store, and pulling
+                # unconditionally avoids silently frozen weights if one is
+                # ever wired in
                 kv.push(i, g)
-                if kv._updater is None:
-                    kv.pull(i, out=g)
-                    self._updaters[0](i, g, param.data())
+                kv.pull(i, out=g)
+                self._updaters[0](i, g, param.data())
                 continue
             if kv is not None and self._update_on_kvstore:
                 kv.push(i, g)

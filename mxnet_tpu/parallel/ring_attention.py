@@ -22,22 +22,33 @@ _NEG_INF = -1e30
 
 def _block_scores(q, k, scale):
     import jax.numpy as jnp
-    # (b, s_q, h, d) x (b, s_k, h, d) -> (b, h, s_q, s_k)
-    return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # (b, s_q, h, d) x (b, s_k, h, d) -> (b, h, s_q, s_k); f32 scores even
+    # for bf16 inputs (the MXU accumulates in f32 anyway) so the softmax
+    # logits keep full precision into the lse update
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
 
 
 def _stable_update(o, m, l, scores, v):
     """One blockwise-softmax accumulation step.
 
-    o: (b, s_q, h, d) running weighted values (unnormalized)
-    m: (b, h, s_q) running max;  l: (b, h, s_q) running denominator
+    o: (b, s_q, h, d) running weighted values (unnormalized, float32)
+    m: (b, h, s_q) running max;  l: (b, h, s_q) denominator (both float32)
+
+    Accumulators stay float32 regardless of q/k/v dtype (bf16/f16 ring
+    shards would otherwise overflow _NEG_INF and lose the lse precision).
+    A fully-masked block while m is still the _NEG_INF init would give
+    scores - m_new = 0 → p = 1 for every masked entry, silently summing
+    masked V rows — the explicit validity mask zeroes those lanes.
     """
     import jax.numpy as jnp
+    scores = scores.astype(jnp.float32)
     m_new = jnp.maximum(m, scores.max(axis=-1))
     correction = jnp.exp(m - m_new)
-    p = jnp.exp(scores - m_new[..., None])          # (b,h,q,k)
+    valid = scores > (_NEG_INF / 2)
+    p = jnp.where(valid, jnp.exp(scores - m_new[..., None]), 0.0)
     l_new = l * correction + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
     return o_new, m_new, l_new
 
@@ -83,12 +94,13 @@ def blockwise_attention(q, k, v, block_size=512, causal=False, scale=None,
         o, m, l = _stable_update(o, m, l, scores, vblk)
         return (o, m, l), None
 
-    o0 = jnp.zeros_like(q)
-    m0 = jnp.full((b, h, s_q), _NEG_INF, q.dtype)
-    l0 = jnp.zeros((b, h, s_q), q.dtype)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((b, h, s_q), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_q), jnp.float32)
     starts = kv_offset + jnp.arange(n_blocks) * block_size
     (o, m, l), _ = jax.lax.scan(step, (o0, m0, l0), (kb, vb, starts))
-    return o / l.transpose(0, 2, 1)[..., None]
+    l = jnp.maximum(l, 1e-30)  # fully-masked query rows -> 0, not NaN
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
 def _ring_body(q, k, v, axis_name, causal, scale, block_size):
@@ -138,12 +150,13 @@ def _ring_body(q, k, v, axis_name, causal, scale, block_size):
         vc = lax.ppermute(vc, axis_name, perm)
         return (o, m, l, kc, vc), None
 
-    o0 = jnp.zeros_like(q)
-    m0 = jnp.full((b, h, s_q), _NEG_INF, q.dtype)
-    l0 = jnp.zeros((b, h, s_q), q.dtype)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((b, h, s_q), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_q), jnp.float32)
     (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
                                   jnp.arange(n_dev))
-    return o / l.transpose(0, 2, 1)[..., None]
+    l = jnp.maximum(l, 1e-30)  # fully-masked query rows -> 0, not NaN
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
 def ring_attention_sharded(q, k, v, axis_name="sp", causal=False, scale=None,

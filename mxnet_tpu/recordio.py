@@ -81,34 +81,73 @@ class MXRecordIO(object):
         return self.handle.tell()
 
     def write(self, buf):
+        """Write one record, splitting around embedded magic words.
+
+        dmlc recordio escape: any 4B-aligned occurrence of the magic inside
+        the payload ends a part (cflag=1 first part, 2 middle, 3 last); the
+        embedded magic itself is dropped and re-inserted by read(). cflag=0
+        marks an unsplit record.
+        """
         assert self.writable
         data = bytes(buf)
-        # multi-part escape: if payload contains magic, split flags mark parts
-        # (dmlc recordio semantics); single-part when clean.
-        self.handle.write(_LE_U32.pack(_kMagic))
         length = len(data)
         assert length < (1 << 29), "record too large"
-        self.handle.write(_LE_U32.pack(length))
-        self.handle.write(data)
-        pad = (4 - length % 4) % 4
+        magic_b = _LE_U32.pack(_kMagic)
+        out = self.handle
+        dptr = 0
+        lower_align = (length >> 2) << 2
+        # C-speed scan: only 4B-aligned, fully-inside-lower_align hits split
+        pos = data.find(magic_b)
+        while 0 <= pos:
+            if pos % 4 == 0 and pos + 4 <= lower_align:
+                part_len = pos - dptr
+                out.write(magic_b)
+                out.write(_LE_U32.pack(((1 if dptr == 0 else 2) << 29)
+                                       | part_len))
+                if part_len:
+                    out.write(data[dptr:pos])
+                # part lengths are multiples of 4 here: no pad needed
+                dptr = pos + 4
+                pos = data.find(magic_b, pos + 4)
+            else:
+                pos = data.find(magic_b, pos + 1)
+        part_len = length - dptr
+        out.write(magic_b)
+        out.write(_LE_U32.pack(((3 if dptr else 0) << 29) | part_len))
+        if part_len:
+            out.write(data[dptr:])
+        pad = (4 - part_len % 4) % 4
         if pad:
-            self.handle.write(b"\x00" * pad)
+            out.write(b"\x00" * pad)
 
     def read(self):
+        """Read one record, reassembling multi-part (cflag 1/2/3) records."""
         assert not self.writable
-        hdr = self.handle.read(4)
-        if len(hdr) < 4:
-            return None
-        magic, = _LE_U32.unpack(hdr)
-        if magic != _kMagic:
-            raise IOError("Invalid magic number in record file %s" % self.uri)
-        length, = _LE_U32.unpack(self.handle.read(4))
-        length &= (1 << 29) - 1
-        data = self.handle.read(length)
-        pad = (4 - length % 4) % 4
-        if pad:
-            self.handle.read(pad)
-        return data
+        magic_b = _LE_U32.pack(_kMagic)
+        parts = []
+        while True:
+            hdr = self.handle.read(8)
+            if len(hdr) < 8:
+                if parts:
+                    raise IOError("Truncated multi-part record in %s"
+                                  % self.uri)
+                return None
+            magic, lrec = struct.unpack("<II", hdr)
+            if magic != _kMagic:
+                raise IOError("Invalid magic number in record file %s"
+                              % self.uri)
+            cflag = lrec >> 29
+            length = lrec & ((1 << 29) - 1)
+            data = self.handle.read(length)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.handle.read(pad)
+            parts.append(data)
+            if cflag in (0, 3):
+                break
+            # non-final part: the split point was an embedded magic word
+            parts.append(magic_b)
+        return b"".join(parts)
 
 
 class MXIndexedRecordIO(MXRecordIO):

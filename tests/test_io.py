@@ -81,6 +81,45 @@ def test_recordio_roundtrip(tmp_path):
     assert got == payloads
 
 
+def test_recordio_magic_in_payload(tmp_path):
+    """Payloads containing the magic word split into cflag 1/2/3 parts on
+    write and must reassemble exactly on read (dmlc recordio escape)."""
+    import struct
+    magic = struct.pack("<I", 0xced7230a)
+    path = str(tmp_path / "magic.rec")
+    payloads = [
+        magic,                       # payload IS the magic
+        b"abcd" + magic + b"efgh",   # aligned magic mid-payload
+        magic + magic + magic,       # consecutive magics
+        b"ab" + magic + b"cd",       # UNaligned magic: must not split
+        b"xyzw" * 3 + magic,         # trailing aligned magic
+        magic + b"tail",             # leading magic
+        b"plain record",
+    ]
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    assert got == payloads
+    # indexed access must also survive multi-part records
+    rec2 = str(tmp_path / "magic2.rec")
+    idx2 = str(tmp_path / "magic2.idx")
+    w = recordio.MXIndexedRecordIO(idx2, rec2, "w")
+    for i, p in enumerate(payloads):
+        w.write_idx(i, p)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx2, rec2, "r")
+    for i, p in enumerate(payloads):
+        assert r.read_idx(i) == p
+
+
 def test_indexed_recordio(tmp_path):
     rec = str(tmp_path / "t.rec")
     idx = str(tmp_path / "t.idx")

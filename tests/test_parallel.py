@@ -141,6 +141,26 @@ def test_blockwise_attention(causal):
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
 
 
+def test_blockwise_attention_fully_masked_block():
+    """A causal block whose kv positions all exceed the q positions must
+    contribute ZERO (not exp(0)=1 per masked lane while m is at the init)."""
+    rng = np.random.RandomState(2)
+    q = rng.randn(1, 4, 1, 8).astype(np.float32)
+    k = rng.randn(1, 4, 1, 8).astype(np.float32)
+    v = rng.randn(1, 4, 1, 8).astype(np.float32)
+    # kv_offset beyond every q position -> every score masked -> zeros out
+    out = np.asarray(blockwise_attention(q, k, v, block_size=4, causal=True,
+                                         q_offset=0, kv_offset=100))
+    np.testing.assert_allclose(out, np.zeros_like(out))
+    # bf16 inputs must not overflow the mask constant in the accumulators
+    import jax.numpy as jnp
+    qb, kb, vb = (jnp.asarray(x, jnp.bfloat16) for x in (q, k, v))
+    outb = np.asarray(blockwise_attention(qb, kb, vb, block_size=2,
+                                          causal=True).astype(jnp.float32))
+    ref = _dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(outb, ref, rtol=0.1, atol=0.05)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention(causal):
     import jax
