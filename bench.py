@@ -1,17 +1,38 @@
-"""Benchmark driver: ResNet-50 training throughput (images/sec) on the
-available accelerator (one TPU chip under the driver; CPU fallback works).
+"""Benchmark driver: ResNet-50 training throughput + MFU on the available
+accelerator (one TPU chip under the driver; CPU fallback works).
 
 Baseline: the reference's published 109 images/sec training ResNet-50,
 1x K80, batch 32 (example/image-classification/README.md:147-155;
 BASELINE.md).  Prints ONE JSON line.
 
-The benched step is the framework's real path: symbolic ResNet-50 →
-whole-graph XLA program (fwd+bwd+SGD in one jit), batch 128.
+The benched step is the framework's real path: symbolic ResNet-50 (NHWC
+internal layout — the TPU-preferred channels-last form the Convolution op
+supports via its reference `layout` parameter) traced to ONE fused
+fwd+bwd+SGD XLA program, batch 256 bf16.
+
+Timing protocol: the axon TPU tunnel's block_until_ready does not reliably
+block and host readback carries a ~2s fixed sync cost, so the step time is
+measured as the MARGINAL time between a K1-step and a K2-step dependent
+chain (fixed overhead cancels).  MFU uses XLA's own per-step FLOP count
+(cost_analysis, multiply-add = 2 FLOPs) against the chip's bf16 peak.
 """
 import json
 import time
 
 import numpy as np
+
+_PEAKS_TFLOPS = {  # bf16 peak by device kind substring
+    "v5 lite": 197.0, "v5e": 197.0, "v4": 275.0, "v5p": 459.0,
+    "v6 lite": 918.0, "v6e": 918.0,
+}
+
+
+def _peak_for(device):
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAKS_TFLOPS.items():
+        if key in kind:
+            return val * 1e12
+    return 197.0e12  # assume v5e when unknown
 
 
 def main():
@@ -21,19 +42,20 @@ def main():
     from mxnet_tpu.models import get_resnet_symbol
     from mxnet_tpu.executor import build_graph_fn
 
-    platform = jax.devices()[0].platform
-    batch = 256 if platform != "cpu" else 16
-    image = 224 if platform != "cpu" else 64
+    dev = jax.devices()[0]
+    on_cpu = dev.platform == "cpu"
+    batch = 16 if on_cpu else 256
+    image = 64 if on_cpu else 224
     # bf16 params+activations: the TPU-idiomatic training dtype (MXU-native);
     # labels/loss/batch-norm stats stay f32
-    dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
+    dtype = jnp.float32 if on_cpu else jnp.bfloat16
 
     net = get_resnet_symbol(num_classes=1000, num_layers=50,
-                            image_shape=(3, image, image))
+                            image_shape=(3, image, image), layout="NHWC")
     arg_names = net.list_arguments()
     aux_names = net.list_auxiliary_states()
     graph_fn = build_graph_fn(net, arg_names, aux_names)
-    shapes = {"data": (batch, 3, image, image), "softmax_label": (batch,)}
+    shapes = {"data": (batch, image, image, 3), "softmax_label": (batch,)}
     arg_shapes, _, aux_shapes = net.infer_shape(**shapes)
 
     rng = np.random.RandomState(0)
@@ -78,25 +100,54 @@ def main():
 
     step = jax.jit(train_step, donate_argnums=(0,))
     key = jax.random.PRNGKey(0)
+    compiled = step.lower(args, auxs, key).compile()
+    try:
+        step_flops = compiled.cost_analysis().get("flops", 0.0)
+    except Exception:
+        step_flops = 0.0
 
-    # warmup/compile
-    loss, args, auxs = step(args, auxs, key)
-    jax.block_until_ready((loss, args, auxs))
+    # warmup + marginal-protocol timing
+    loss, args, auxs = compiled(args, auxs, key)
+    _ = float(np.asarray(loss))
+    k1, k2 = (2, 6) if on_cpu else (20, 100)
+    reps = 1 if on_cpu else 2
+    marginals = []
+    fallback = []
+    for _rep in range(reps):
+        elapsed = {}
+        for K in (k1, k2):
+            t0 = time.perf_counter()
+            for i in range(K):
+                loss, args, auxs = compiled(args, auxs,
+                                            jax.random.fold_in(key, i))
+            _ = float(np.asarray(loss))  # true host sync
+            elapsed[K] = time.perf_counter() - t0
+        # per-rep K2-K1 difference cancels the fixed readback cost while
+        # both runs share the same chip state; min over reps filters the
+        # tunnel's multi-second sync stalls and transient pool contention
+        marginals.append((elapsed[k2] - elapsed[k1]) / (k2 - k1))
+        fallback.append(elapsed[k2] / k2)
+    dt = min(marginals)
+    if dt <= 0:  # noise guard (tiny CPU runs): fall back to the longer run
+        dt = min(fallback)
 
-    n_steps = 10 if platform != "cpu" else 3
-    t0 = time.perf_counter()
-    for i in range(n_steps):
-        loss, args, auxs = step(args, auxs, jax.random.fold_in(key, i))
-    jax.block_until_ready((loss, args, auxs))
-    dt = time.perf_counter() - t0
-
-    imgs_per_sec = batch * n_steps / dt
+    imgs_per_sec = batch / dt
+    peak = _peak_for(dev)
+    # MFU only against a real accelerator peak: the CPU fallback would
+    # otherwise report a fabricated ratio vs the assumed-TPU peak
+    mfu = step_flops / dt / peak if (step_flops and not on_cpu) else 0.0
     baseline = 109.0  # K80 batch-32 training img/s (BASELINE.md)
     result = {
         "metric": "resnet50_train_images_per_sec",
         "value": round(imgs_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(imgs_per_sec / baseline, 3),
+        "mfu": round(mfu, 4),
+        "step_ms": round(dt * 1e3, 2),
+        "batch": batch,
+        "xla_gflops_per_step": round(step_flops / 1e9, 1),
+        "peak_tflops": round(peak / 1e12, 1),
+        "device": getattr(dev, "device_kind", dev.platform),
     }
     print(json.dumps(result))
 

@@ -1,111 +1,128 @@
 """Training callbacks.
 
-Reference: python/mxnet/callback.py — Speedometer, do_checkpoint,
-module_checkpoint, log_train_metric, ProgressBar, LogValidationMetricsCallback.
+API parity with the reference's python/mxnet/callback.py (Speedometer,
+do_checkpoint, module_checkpoint, log_train_metric, ProgressBar,
+LogValidationMetricsCallback); implementation is this framework's own.
+
+Callback contract: batch-end/eval-end callbacks receive a BatchEndParam
+namedtuple (epoch, nbatch, eval_metric, locals); epoch-end checkpoint
+callbacks receive (iter_no, sym, arg, aux).
 """
 from __future__ import annotations
 
 import logging
-import math
 import sys
 import time
 
 
+def _fmt_metric(eval_metric):
+    """Render a metric's (name, value) pairs as 'name=value' strings."""
+    return ["%s=%f" % nv for nv in eval_metric.get_name_value()]
+
+
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Epoch-end callback checkpointing a module (callback.py:30)."""
-    period = int(max(1, period))
+    """Epoch-end callback checkpointing a module (ref callback.py:30)."""
+    period = max(1, int(period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+        epoch = iter_no + 1
+        if epoch % period == 0:
+            mod.save_checkpoint(prefix, epoch, save_optimizer_states)
     return _callback
 
 
 def do_checkpoint(prefix, period=1):
-    """Epoch-end callback writing prefix-symbol.json + params
-    (callback.py:53)."""
+    """Epoch-end callback writing prefix-symbol.json + prefix-%04d.params
+    (ref callback.py:53)."""
     from .model import save_checkpoint
-    period = int(max(1, period))
+    period = max(1, int(period))
 
     def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+        epoch = iter_no + 1
+        if epoch % period == 0:
+            save_checkpoint(prefix, epoch, sym, arg, aux)
     return _callback
 
 
 def log_train_metric(period, auto_reset=False):
-    """Batch-end callback logging the metric every `period` batches."""
+    """Batch-end callback logging the training metric every `period`
+    batches (ref callback.py:88)."""
+    period = max(1, int(period))
 
     def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
+        if param.eval_metric is None or param.nbatch % period != 0:
+            return
+        logging.info("Iter[%d] Batch[%d] Train-%s", param.epoch, param.nbatch,
+                     "\t".join(_fmt_metric(param.eval_metric)))
+        if auto_reset:
+            param.eval_metric.reset()
     return _callback
 
 
 class Speedometer(object):
-    """Log training speed + metrics every `frequent` batches
-    (callback.py:119)."""
+    """Batch-end callback reporting samples/sec (and the running metric)
+    every `frequent` batches (ref callback.py:119 API).
+
+    Timing starts at the first batch of each epoch (detected by the batch
+    counter moving backwards) so compile/startup time of batch 0 does not
+    pollute the first reading.
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
-        self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self.frequent = max(1, int(frequent))
         self.auto_reset = auto_reset
+        self._stamp = None      # (time, nbatch) of the last report/reset
+        self._prev_nbatch = -1
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
+        now = time.time()
+        if param.nbatch < self._prev_nbatch or self._stamp is None:
+            self._stamp = (now, param.nbatch)   # new epoch: restart clock
+            self._prev_nbatch = param.nbatch
+            return
+        self._prev_nbatch = param.nbatch
 
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        if param.nbatch % self.frequent:
+            return
+        t0, n0 = self._stamp
+        elapsed = now - t0
+        if elapsed <= 0:
+            return
+        rate = (param.nbatch - n0) * self.batch_size / elapsed
+        if param.eval_metric is not None:
+            pieces = _fmt_metric(param.eval_metric)
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s",
+                         param.epoch, param.nbatch, rate, "\t".join(pieces))
+            if self.auto_reset:
+                param.eval_metric.reset()
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, param.nbatch, rate)
+        self._stamp = (now, param.nbatch)
 
 
 class ProgressBar(object):
-    """ASCII progress bar per batch (callback.py:187)."""
+    """Batch-end callback drawing an in-place ASCII bar
+    (ref callback.py:187 API)."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
-        self.total = total
+        self.total = max(1, int(total))
+        self.length = max(1, int(length))
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        sys.stdout.write("[%s] %s%s\r" % (prog_bar, percents, "%"))
+        frac = min(max(param.nbatch / float(self.total), 0.0), 1.0)
+        done = int(self.length * frac + 0.5)
+        bar = "=" * done + "-" * (self.length - done)
+        sys.stdout.write("[%s] %d%%\r" % (bar, int(frac * 100 + 0.999)))
 
 
 class LogValidationMetricsCallback(object):
-    """Eval-end callback logging validation metrics (callback.py:211)."""
+    """Eval-end callback logging validation metrics (ref callback.py:211)."""
 
     def __call__(self, param):
-        if not param.eval_metric:
+        if param.eval_metric is None:
             return
-        name_value = param.eval_metric.get_name_value()
-        for name, value in name_value:
-            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)

@@ -8,91 +8,95 @@ from .. import symbol as sym
 
 
 def _residual_unit(data, num_filter, stride, dim_match, name,
-                   bottle_neck=True, bn_mom=0.9):
+                   bottle_neck=True, bn_mom=0.9, layout="NCHW",
+                   bn_axis=1):
     """Pre-activation residual unit (symbols/resnet.py residual_unit)."""
     if bottle_neck:
         bn1 = sym.BatchNorm(data, fix_gamma=False, eps=2e-5, momentum=bn_mom,
-                            name=name + "_bn1")
+                            name=name + "_bn1", axis=bn_axis)
         act1 = sym.Activation(bn1, act_type="relu", name=name + "_relu1")
         conv1 = sym.Convolution(act1, num_filter=int(num_filter * 0.25),
                                 kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                no_bias=True, name=name + "_conv1")
+                                no_bias=True, name=name + "_conv1", layout=layout)
         bn2 = sym.BatchNorm(conv1, fix_gamma=False, eps=2e-5, momentum=bn_mom,
-                            name=name + "_bn2")
+                            name=name + "_bn2", axis=bn_axis)
         act2 = sym.Activation(bn2, act_type="relu", name=name + "_relu2")
         conv2 = sym.Convolution(act2, num_filter=int(num_filter * 0.25),
                                 kernel=(3, 3), stride=stride, pad=(1, 1),
-                                no_bias=True, name=name + "_conv2")
+                                no_bias=True, name=name + "_conv2", layout=layout)
         bn3 = sym.BatchNorm(conv2, fix_gamma=False, eps=2e-5, momentum=bn_mom,
-                            name=name + "_bn3")
+                            name=name + "_bn3", axis=bn_axis)
         act3 = sym.Activation(bn3, act_type="relu", name=name + "_relu3")
         conv3 = sym.Convolution(act3, num_filter=num_filter, kernel=(1, 1),
                                 stride=(1, 1), pad=(0, 0), no_bias=True,
-                                name=name + "_conv3")
+                                name=name + "_conv3", layout=layout)
         if dim_match:
             shortcut = data
         else:
             shortcut = sym.Convolution(act1, num_filter=num_filter,
                                        kernel=(1, 1), stride=stride,
-                                       no_bias=True, name=name + "_sc")
+                                       no_bias=True, name=name + "_sc", layout=layout)
         return conv3 + shortcut
     bn1 = sym.BatchNorm(data, fix_gamma=False, momentum=bn_mom, eps=2e-5,
-                        name=name + "_bn1")
+                        name=name + "_bn1", axis=bn_axis)
     act1 = sym.Activation(bn1, act_type="relu", name=name + "_relu1")
     conv1 = sym.Convolution(act1, num_filter=num_filter, kernel=(3, 3),
                             stride=stride, pad=(1, 1), no_bias=True,
-                            name=name + "_conv1")
+                            name=name + "_conv1", layout=layout)
     bn2 = sym.BatchNorm(conv1, fix_gamma=False, momentum=bn_mom, eps=2e-5,
-                        name=name + "_bn2")
+                        name=name + "_bn2", axis=bn_axis)
     act2 = sym.Activation(bn2, act_type="relu", name=name + "_relu2")
     conv2 = sym.Convolution(act2, num_filter=num_filter, kernel=(3, 3),
                             stride=(1, 1), pad=(1, 1), no_bias=True,
-                            name=name + "_conv2")
+                            name=name + "_conv2", layout=layout)
     if dim_match:
         shortcut = data
     else:
         shortcut = sym.Convolution(act1, num_filter=num_filter, kernel=(1, 1),
                                    stride=stride, no_bias=True,
-                                   name=name + "_sc")
+                                   name=name + "_sc", layout=layout)
     return conv2 + shortcut
 
 
 def _resnet(units, num_stages, filter_list, num_classes, image_shape,
-            bottle_neck=True, bn_mom=0.9):
+            bottle_neck=True, bn_mom=0.9, layout="NCHW"):
     """symbols/resnet.py resnet()."""
+    bn_axis = 3 if layout == "NHWC" else 1
     data = sym.Variable("data")
     data = sym.BatchNorm(data, fix_gamma=True, eps=2e-5, momentum=bn_mom,
-                         name="bn_data")
+                         name="bn_data", axis=bn_axis)
     nchannel, height, _ = image_shape
     if height <= 32:  # cifar-style stem
         body = sym.Convolution(data, num_filter=filter_list[0],
                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                               no_bias=True, name="conv0")
+                               no_bias=True, name="conv0", layout=layout)
     else:  # imagenet stem
         body = sym.Convolution(data, num_filter=filter_list[0],
                                kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                               no_bias=True, name="conv0")
+                               no_bias=True, name="conv0", layout=layout)
         body = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
-                             name="bn0")
+                             name="bn0", axis=bn_axis)
         body = sym.Activation(body, act_type="relu", name="relu0")
         body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
-                           pool_type="max")
+                           pool_type="max", layout=layout)
 
     for i in range(num_stages):
         stride = (1, 1) if i == 0 and height > 32 else (2, 2) \
             if i > 0 else (1, 1)
         body = _residual_unit(body, filter_list[i + 1], stride, False,
                               name="stage%d_unit%d" % (i + 1, 1),
-                              bottle_neck=bottle_neck, bn_mom=bn_mom)
+                              bottle_neck=bottle_neck, bn_mom=bn_mom,
+                              layout=layout, bn_axis=bn_axis)
         for j in range(units[i] - 1):
             body = _residual_unit(body, filter_list[i + 1], (1, 1), True,
                                   name="stage%d_unit%d" % (i + 1, j + 2),
-                                  bottle_neck=bottle_neck, bn_mom=bn_mom)
+                                  bottle_neck=bottle_neck, bn_mom=bn_mom,
+                                  layout=layout, bn_axis=bn_axis)
     bn1 = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
-                        name="bn1")
+                        name="bn1", axis=bn_axis)
     relu1 = sym.Activation(bn1, act_type="relu", name="relu1")
     pool1 = sym.Pooling(relu1, global_pool=True, kernel=(7, 7),
-                        pool_type="avg", name="pool1")
+                        pool_type="avg", name="pool1", layout=layout)
     flat = sym.Flatten(pool1)
     fc1 = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
     return sym.SoftmaxOutput(fc1, name="softmax")
@@ -107,7 +111,7 @@ _SPECS = {
 
 
 def get_resnet_symbol(num_classes=1000, num_layers=50,
-                      image_shape=(3, 224, 224)):
+                      image_shape=(3, 224, 224), layout="NCHW"):
     """Build a ResNet symbol (symbols/resnet.py get_symbol)."""
     nchannel, height, _ = image_shape
     if height <= 28:
@@ -133,4 +137,4 @@ def get_resnet_symbol(num_classes=1000, num_layers=50,
             raise ValueError("no experiments done on num_layers %d" % num_layers)
         units, bottle_neck = _SPECS[num_layers]
     return _resnet(units, num_stages, filter_list, num_classes, image_shape,
-                   bottle_neck)
+                   bottle_neck, layout=layout)

@@ -28,24 +28,45 @@ def _as_list(obj):
     return [obj]
 
 
+def _invoke(callbacks, param):
+    """Fire every callback in an (optional, possibly-scalar) callback set."""
+    for cb in _as_list(callbacks):
+        cb(param)
+
+
+_PARAM_SUFFIXES = ("_weight", "_bias", "_gamma", "_beta")
+
+
 def _check_input_names(symbol, names, typename, throw):
-    """Check that input names are in symbol's arguments (base_module.py:33)."""
-    args = symbol.list_arguments()
-    for name in names:
-        if name in args:
-            continue
-        candidates = [arg for arg in args if
-                      not arg.endswith("_weight") and
-                      not arg.endswith("_bias") and
-                      not arg.endswith("_gamma") and
-                      not arg.endswith("_beta")]
-        msg = "\033[91mYou created Module with Module(..., %s_names=%s) but " \
-              "input with name '%s' is not found in symbol.list_arguments(). " \
-              "Did you mean one of:\n\t%s\033[0m" % (
-                  typename, str(names), name, "\n\t".join(candidates))
-        if throw:
-            raise ValueError(msg)
-        logging.warning(msg)
+    """Validate declared data/label names against the symbol's free
+    variables (ref base_module.py:33 contract)."""
+    args = set(symbol.list_arguments())
+    missing = [n for n in names if n not in args]
+    if not missing:
+        return
+    # suggest the non-parameter-looking free variables as likely intents
+    suggestions = [a for a in symbol.list_arguments()
+                   if not a.endswith(_PARAM_SUFFIXES)]
+    msg = ("Module %s_names=%s contains '%s', which is not an input of the "
+           "symbol. Free variables that look like inputs:\n\t%s"
+           % (typename, list(names), missing[0], "\n\t".join(suggestions)))
+    if throw:
+        raise ValueError(msg)
+    logging.warning(msg)
+
+
+def _lookahead_iter(source):
+    """Yield (batch, next_batch_or_None) so the consumer can stage the
+    upcoming batch while the device still computes the current one."""
+    it = iter(source)
+    try:
+        cur = next(it)
+    except StopIteration:
+        return
+    for nxt in it:
+        yield cur, nxt
+        cur = nxt
+    yield cur, None
 
 
 class BaseModule(object):
@@ -73,78 +94,68 @@ class BaseModule(object):
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _eval_batches(self, eval_data, num_batch, reset):
+        """Shared inference-iteration core for score/predict/iter_predict:
+        forward each batch in predict mode and yield (nbatch, batch)."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch >= num_batch:
+                return
+            self.forward(batch, is_train=False)
+            yield nbatch, batch
+
+    def _outputs_without_pad(self, batch, copy=False):
+        """Current outputs with the iterator's pad rows sliced off."""
+        keep = lambda o: o[0:o.shape[0] - batch.pad]  # noqa: E731
+        outs = [keep(o) for o in self.get_outputs()]
+        return [o.copy() for o in outs] if copy else outs
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0):
-        """Run prediction on eval_data and evaluate (base_module.py:205)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
+        """Evaluate `eval_metric` over an eval iterator (ref
+        base_module.py:205 contract)."""
         if not isinstance(eval_metric, metric.EvalMetric):
             eval_metric = metric.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(batch_end_params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+        seen = 0
+        for nbatch, batch in self._eval_batches(eval_data, num_batch, reset):
+            self.update_metric(eval_metric, batch.label)
+            _invoke(batch_end_callback,
+                    BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                  eval_metric=eval_metric, locals=locals()))
+            seen = nbatch + 1
+        _invoke(score_end_callback,
+                BatchEndParam(epoch=epoch, nbatch=seen,
+                              eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        """Iterate over predictions (base_module.py iter_predict)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+        """Lazily yield (outputs, nbatch, batch) per eval batch."""
+        for nbatch, batch in self._eval_batches(eval_data, num_batch, reset):
+            yield self._outputs_without_pad(batch), nbatch, batch
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
-        """Run prediction and collect outputs (base_module.py:320)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the same " \
-                    "in mini-batches. Maybe bucketing is used?"
-            output_list2 = [ndarray.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+        """Collect prediction outputs over an iterator (ref
+        base_module.py:320 contract)."""
+        collected = [self._outputs_without_pad(batch, copy=True)
+                     for _, batch in
+                     self._eval_batches(eval_data, num_batch, reset)]
+        if not collected or not merge_batches:
+            return collected
+        widths = {len(outs) for outs in collected}
+        if len(widths) != 1:
+            raise MXNetError("predict(merge_batches=True) needs every batch "
+                             "to produce the same number of outputs; got %s "
+                             "(bucketing?)" % sorted(widths))
+        merged = [ndarray.concatenate([outs[i] for outs in collected])
+                  for i in range(widths.pop())]
+        if len(merged) == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -154,8 +165,18 @@ class BaseModule(object):
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None):
-        """Train the module (base_module.py:376 — the canonical loop)."""
-        assert num_epoch is not None, "please specify number of epochs"
+        """Train the module over `train_data` (ref base_module.py:376
+        contract: bind → init params/optimizer → per-epoch
+        forward_backward/update/metric loop with callbacks + optional
+        validation scoring).
+
+        The batch loop stages the NEXT batch (prepare) right after update()
+        is queued: JAX dispatch is async, so host-side IO for batch t+1
+        overlaps the device computing batch t — the same overlap the
+        reference gets from its dependency engine's prefetch.
+        """
+        if num_epoch is None:
+            raise ValueError("fit() needs num_epoch")
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -168,71 +189,51 @@ class BaseModule(object):
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
-        if validation_metric is None:
-            validation_metric = eval_metric
+        validation_metric = validation_metric or eval_metric
         if not isinstance(eval_metric, metric.EvalMetric):
             eval_metric = metric.create(eval_metric)
 
-        ################################################################
-        # training loop
-        ################################################################
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            t_epoch = time.time()
             eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
+
+            for nbatch, (batch, upcoming) in \
+                    enumerate(_lookahead_iter(train_data)):
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
+                self.forward_backward(batch)
                 self.update()
-                try:
-                    # pre-fetch next batch: overlaps host IO with the async
-                    # device step (the engine/prefetch overlap, free in JAX)
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch)
-                except StopIteration:
-                    end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
+                if upcoming is not None:
+                    self.prepare(upcoming)
+                self.update_metric(eval_metric, batch.label)
                 if monitor is not None:
                     monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
+                _invoke(batch_end_callback,
+                        BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                      eval_metric=eval_metric,
+                                      locals=locals()))
 
-            # one epoch of training is finished
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - t_epoch)
 
-            # sync aux params across devices
+            # pull a consistent host-side copy of the params (and push it
+            # back, normalizing device placement) before checkpointing
             arg_params, aux_params = self.get_params()
             self.set_params(arg_params, aux_params)
+            for cb in _as_list(epoch_end_callback):
+                cb(epoch, self.symbol, arg_params, aux_params)
 
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params, aux_params)
-
-            # ----------------------------------------
-            # evaluation on validation set
             if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
 
-            # end of 1 epoch, reset the data-iter for another epoch
             train_data.reset()
 
     # ------------------------------------------------------------------

@@ -66,6 +66,11 @@ def fully_connected(attrs, data, weight, bias=None):
 # Convolution / Deconvolution
 # ---------------------------------------------------------------------------
 
+def _channels_last(attrs):
+    lay = attrs.get("layout") or ""
+    return lay.endswith("C")
+
+
 def _conv_fill(attrs, in_shapes):
     out = list(in_shapes)
     data = out[0]
@@ -73,9 +78,14 @@ def _conv_fill(attrs, in_shapes):
         k = attrs["kernel"]
         nf = attrs["num_filter"]
         ng = attrs.get("num_group", 1)
-        cin = data[1]
+        if _channels_last(attrs):
+            cin = data[-1]
+            wshape = (nf,) + tuple(k) + (cin // ng,)
+        else:
+            cin = data[1]
+            wshape = (nf, cin // ng) + tuple(k)
         if len(out) > 1 and out[1] is None:
-            out[1] = (nf, cin // ng) + tuple(k)
+            out[1] = wshape
         if len(out) > 2 and out[2] is None:
             out[2] = (nf,)
     return out
@@ -121,16 +131,27 @@ def _conv_dims(attrs, ndim):
 def convolution(attrs, data, weight, bias=None):
     _, stride, dilate, pad = _conv_dims(attrs, data.ndim)
     nd = data.ndim - 2
-    # logical NCHW / NCDHW; lax dimension_numbers spell it explicitly
-    spec = "NC" + "DHW"[3 - nd:]
-    wspec = "OI" + "DHW"[3 - nd:]
+    sp = "DHW"[3 - nd:]
+    if _channels_last(attrs):
+        # channels-last (layout=NWC/NHWC/NDHWC): the TPU-preferred layout —
+        # XLA tiles the trailing C dim straight onto the MXU lanes with no
+        # relayout pass. Weights follow the reference's channels-last
+        # convention (num_filter, *kernel, C/num_group).
+        spec = "N" + sp + "C"
+        wspec = "O" + sp + "I"
+    else:
+        # logical NCHW / NCDHW; lax dimension_numbers spell it explicitly
+        spec = "NC" + sp
+        wspec = "OI" + sp
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilate, feature_group_count=attrs["num_group"],
         dimension_numbers=(spec, wspec, spec),
         preferred_element_type=data.dtype)
     if bias is not None and not attrs["no_bias"]:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = (1,) * (data.ndim - 1) + (-1,) if _channels_last(attrs) \
+            else (1, -1) + (1,) * nd
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -172,31 +193,40 @@ def deconvolution(attrs, data, weight, bias=None):
                   "pool_type": P(str, "max", choices=["max", "avg", "sum"]),
                   "global_pool": P(bool, False),
                   "pooling_convention": P(str, "valid", choices=["valid", "full"]),
+                  "layout": P("str_or_none", None),
                   "cudnn_off": P(bool, False)})
 def pooling(attrs, data):
     nd = data.ndim - 2
+    cl = _channels_last(attrs)
+    spatial = tuple(range(1, data.ndim - 1)) if cl \
+        else tuple(range(2, data.ndim))
     if attrs["global_pool"]:
-        axes = tuple(range(2, data.ndim))
         if attrs["pool_type"] == "max":
-            return jnp.max(data, axis=axes, keepdims=True)
+            return jnp.max(data, axis=spatial, keepdims=True)
         if attrs["pool_type"] == "sum":
-            return jnp.sum(data, axis=axes, keepdims=True)
-        return jnp.mean(data, axis=axes, keepdims=True)
+            return jnp.sum(data, axis=spatial, keepdims=True)
+        return jnp.mean(data, axis=spatial, keepdims=True)
     k = tuple(attrs["kernel"])
     stride = tuple(attrs["stride"]) or (1,) * nd
     pad = tuple(attrs["pad"]) or (0,) * nd
-    window = (1, 1) + k
-    strides = (1, 1) + stride
-    pads = [(0, 0), (0, 0)]
+    spatial_pads = []
     for i in range(nd):
         lo = hi = pad[i]
         if attrs["pooling_convention"] == "full":
             # ceil mode: add extra high padding so the last partial window counts
-            size = data.shape[2 + i] + 2 * pad[i]
+            size = data.shape[spatial[i]] + 2 * pad[i]
             rem = (size - k[i]) % stride[i]
             if rem != 0:
                 hi += stride[i] - rem
-        pads.append((lo, hi))
+        spatial_pads.append((lo, hi))
+    if cl:
+        window = (1,) + k + (1,)
+        strides = (1,) + stride + (1,)
+        pads = [(0, 0)] + spatial_pads + [(0, 0)]
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + stride
+        pads = [(0, 0), (0, 0)] + spatial_pads
     pt = attrs["pool_type"]
     # init values must be CONCRETE scalars: a traced init breaks
     # reduce_window's autodiff on the TPU backend
@@ -241,18 +271,32 @@ def _batch_norm_impl(attrs, data, gamma, beta, mov_mean, mov_var):
     training = attrs.get("_training", False) and not attrs["use_global_stats"]
     if attrs["fix_gamma"]:
         gamma = jnp.ones_like(gamma)
+    # stats in f32 regardless of activation dtype: bf16 accumulation over
+    # batch*spatial elements is numerically unusable, and the casts fuse
+    # into the reduction loop (no extra HBM pass)
+    xf = data.astype(jnp.float32)
     if training:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        # one fused pass computes E[x] and E[x^2] together; f32 accumulators
+        # keep the cancellation in E[x^2]-E[x]^2 benign for normalized nets
+        mean = jnp.mean(xf, axis=red)
+        # clamp: E[x^2]-E[x]^2 can go slightly negative from f32
+        # cancellation on large-mean inputs, which would NaN the rsqrt
+        var = jnp.maximum(
+            jnp.mean(jnp.square(xf), axis=red) - jnp.square(mean), 0.0)
         m = attrs["momentum"]
         new_mean = m * mov_mean + (1 - m) * lax.stop_gradient(mean)
         new_var = m * mov_var + (1 - m) * lax.stop_gradient(var)
     else:
-        mean, var = mov_mean, mov_var
+        mean = mov_mean.astype(jnp.float32)
+        var = mov_var.astype(jnp.float32)
         new_mean, new_var = mov_mean, mov_var
-    inv = lax.rsqrt(var.reshape(bshape) + attrs["eps"])
-    out = (data - mean.reshape(bshape)) * inv * gamma.reshape(bshape) \
-        + beta.reshape(bshape)
+    # fold (x - mean) * inv * gamma + beta into ONE per-channel multiply-add
+    # over the activation: scale = gamma*inv, shift = beta - mean*scale
+    inv = lax.rsqrt(var + attrs["eps"])
+    scale = gamma.astype(jnp.float32) * inv
+    shift = beta.astype(jnp.float32) - mean * scale
+    out = (xf * scale.reshape(bshape) + shift.reshape(bshape)) \
+        .astype(data.dtype)
     return out, mean, var, new_mean, new_var
 
 

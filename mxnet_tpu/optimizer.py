@@ -121,28 +121,23 @@ class Optimizer(object):
         self._index_update_count[index] += 1
         self.num_update = max(self._index_update_count[index], self.num_update)
 
-    def _get_lr(self, index):
-        if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
-        else:
-            lr = self.lr
+    def _multiplier(self, index, table, param_attr):
+        """Per-parameter multiplier resolution order: explicit Parameter
+        attr > index-keyed entry > name-keyed entry > 1."""
         if index in self.param_dict:
-            lr *= self.param_dict[index].lr_mult
-        elif index in self.lr_mult:
-            lr *= self.lr_mult[index]
-        elif index in self.idx2name:
-            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lr
+            return getattr(self.param_dict[index], param_attr)
+        if index in table:
+            return table[index]
+        name = self.idx2name.get(index)
+        return table.get(name, 1.0) if name is not None else 1.0
+
+    def _get_lr(self, index):
+        base = self.lr_scheduler(self.num_update) \
+            if self.lr_scheduler is not None else self.lr
+        return base * self._multiplier(index, self.lr_mult, "lr_mult")
 
     def _get_wd(self, index):
-        wd = self.wd
-        if index in self.param_dict:
-            wd *= self.param_dict[index].wd_mult
-        elif index in self.wd_mult:
-            wd *= self.wd_mult[index]
-        elif index in self.idx2name:
-            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wd
+        return self.wd * self._multiplier(index, self.wd_mult, "wd_mult")
 
     def _common_attrs(self, index):
         a = {"lr": self._get_lr(index), "wd": self._get_wd(index),

@@ -164,3 +164,51 @@ def test_simple_bind_var_shape_attr():
     y = x * 2
     a, o, _ = y.infer_shape()
     assert o == [(2, 2)]
+
+
+def test_resnet_nhwc_layout_matches_nchw():
+    """layout='NHWC' (TPU-preferred channels-last) must produce identical
+    outputs to the default NCHW build given transposed data/weights."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import get_resnet_symbol
+    from mxnet_tpu.executor import build_graph_fn
+
+    rng = np.random.RandomState(0)
+    data = rng.uniform(0, 1, (2, 3, 32, 32)).astype(np.float32)
+    outs = {}
+    saved = {}
+    for lay in ("NCHW", "NHWC"):
+        net = get_resnet_symbol(num_classes=10, num_layers=18,
+                                image_shape=(3, 32, 32), layout=lay)
+        an = net.list_arguments()
+        auxn = net.list_auxiliary_states()
+        gf = build_graph_fn(net, an, auxn)
+        shp = {"data": (2, 3, 32, 32) if lay == "NCHW" else (2, 32, 32, 3),
+               "softmax_label": (2,)}
+        ash, _, auxsh = net.infer_shape(**shp)
+        vals = {}
+        for n, s in zip(an, ash):
+            if n == "data":
+                vals[n] = jnp.asarray(data if lay == "NCHW"
+                                      else data.transpose(0, 2, 3, 1))
+            elif n == "softmax_label":
+                vals[n] = jnp.zeros(s, jnp.float32)
+            elif lay == "NCHW":
+                saved[n] = np.random.RandomState(
+                    abs(hash(n)) % 2**31).uniform(-0.05, 0.05, s) \
+                    .astype(np.float32)
+                vals[n] = jnp.asarray(saved[n])
+            else:  # NHWC: reuse NCHW init, transposing conv kernels OIHW->OHWI
+                v = saved[n]
+                if v.ndim == 4:
+                    v = v.transpose(0, 2, 3, 1)
+                vals[n] = jnp.asarray(v)
+        auxs = tuple(jnp.zeros(s, jnp.float32) if "mean" in n
+                     else jnp.ones(s, jnp.float32)
+                     for n, s in zip(auxn, auxsh))
+        o, _ = gf(tuple(vals[n] for n in an), auxs, jax.random.PRNGKey(0),
+                  False)
+        outs[lay] = np.asarray(o[0])
+    np.testing.assert_allclose(outs["NHWC"], outs["NCHW"], rtol=1e-5,
+                               atol=1e-6)
