@@ -8,22 +8,44 @@ BASELINE.md).  Prints ONE JSON line.
 The benched step is the framework's real path: symbolic ResNet-50 (NHWC
 internal layout — the TPU-preferred channels-last form the Convolution op
 supports via its reference `layout` parameter) traced to ONE fused
-fwd+bwd+SGD XLA program, batch 256 bf16.
+fwd+bwd+SGD XLA program, batch 256 bf16.  Input normalization (uint8 →
+bf16, scale) runs in-graph: batches cross host→device as uint8 NHWC (4x
+less transfer than f32), the TPU does the cast — the idiomatic TPU input
+split.
 
-Timing protocol: the axon TPU tunnel's block_until_ready does not reliably
-block and host readback carries a ~2s fixed sync cost, so the step time is
-measured as the MARGINAL time between a K1-step and a K2-step dependent
-chain (fixed overhead cancels).  MFU uses XLA's own per-step FLOP count
-(cost_analysis, multiply-add = 2 FLOPs) against the chip's bf16 peak.
+Two measurements:
+  1. compute: marginal step time on resident device batches (the r1/r2
+     protocol — fixed tunnel sync overhead cancels between a K1- and a
+     K2-step chain).  This is `mfu`.  The compiled step now INCLUDES input
+     normalization (uint8 → bf16 scale), so the program benched is the one
+     a real input pipeline feeds.
+  2. pipeline: the measured streaming rate of ImageRecordIter itself —
+     RecordIO read, rand-crop 224 from stored 256, mirror, batch assembly
+     on this host (`pipeline_images_per_sec` for raw records,
+     `pipeline_jpeg_images_per_sec` for JPEG decode).  The end-to-end
+     number `piped_images_per_sec` is min(compute, pipeline): on this
+     harness the TPU is reached through a ~5 MB/s dev tunnel (measured),
+     so feeding batches through it would bench the tunnel (~30 img/s),
+     not the framework — on a co-located TPU host the host→device link
+     (PCIe/DMA, GB/s) is never the binding constraint; the min of chip
+     rate and host pipeline rate is.  `input_bound` says which side binds.
+
+MFU uses XLA's own per-step FLOP count (cost_analysis, multiply-add = 2
+FLOPs) against the chip's bf16 peak.
 """
 import json
+import os
+import shutil
+import sys
+import tempfile
 import time
 
 import numpy as np
 
 _PEAKS_TFLOPS = {  # bf16 peak by device kind substring
-    "v5 lite": 197.0, "v5e": 197.0, "v4": 275.0, "v5p": 459.0,
+    "v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
     "v6 lite": 918.0, "v6e": 918.0,
+    "v4": 275.0, "v3": 123.0, "v2": 45.0,
 }
 
 
@@ -32,7 +54,21 @@ def _peak_for(device):
     for key, val in _PEAKS_TFLOPS.items():
         if key in kind:
             return val * 1e12
-    return 197.0e12  # assume v5e when unknown
+    return None  # unknown device kind: no honest MFU denominator
+
+
+def _make_raw_rec(path, n, stored, seed=0):
+    """Pack n random raw-uint8 records at stored x stored (the
+    `im2rec --encoding raw` format)."""
+    from mxnet_tpu import recordio
+    rng = np.random.default_rng(seed)
+    w = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    for i in range(n):
+        img = rng.integers(0, 256, (stored, stored, 3), dtype=np.uint8)
+        header = recordio.IRHeader(0, float(i % 1000), i, 0)
+        w.write_idx(i, recordio.pack(header, img.tobytes()))
+    w.close()
+    return path + ".rec"
 
 
 def main():
@@ -41,11 +77,13 @@ def main():
     import mxnet_tpu  # noqa: F401
     from mxnet_tpu.models import get_resnet_symbol
     from mxnet_tpu.executor import build_graph_fn
+    from mxnet_tpu.image import ImageRecordIterImpl
 
     dev = jax.devices()[0]
     on_cpu = dev.platform == "cpu"
     batch = 16 if on_cpu else 256
     image = 64 if on_cpu else 224
+    stored = image + 32  # rand-crop window source size
     # bf16 params+activations: the TPU-idiomatic training dtype (MXU-native);
     # labels/loss/batch-norm stats stay f32
     dtype = jnp.float32 if on_cpu else jnp.bfloat16
@@ -60,82 +98,136 @@ def main():
 
     rng = np.random.RandomState(0)
     data_names = {"data", "softmax_label"}
-    args = []
-    for n, s in zip(arg_names, arg_shapes):
-        if n == "data":
-            args.append(jnp.asarray(rng.uniform(0, 1, s).astype(np.float32),
-                                    dtype))
-        elif n == "softmax_label":
-            args.append(jnp.asarray(rng.randint(0, 1000, s).astype(np.float32)))
-        else:
-            args.append(jnp.asarray(
-                rng.uniform(-0.05, 0.05, s).astype(np.float32), dtype))
-    args = tuple(args)
+    params = []
+    grad_idx = [i for i, n in enumerate(arg_names) if n not in data_names]
+    for i in grad_idx:
+        params.append(jnp.asarray(
+            rng.uniform(-0.05, 0.05, arg_shapes[i]).astype(np.float32),
+            dtype))
+    params = tuple(params)
     auxs = tuple(jnp.zeros(s, jnp.float32) if "mean" in n
                  else jnp.ones(s, jnp.float32)
                  for n, s in zip(aux_names, aux_shapes))
-    grad_idx = [i for i, n in enumerate(arg_names) if n not in data_names]
+    data_pos = arg_names.index("data")
     label_pos = arg_names.index("softmax_label")
     lr = 0.05
+    inv255 = 1.0 / 255.0
 
-    def train_step(args, auxs, key):
+    def train_step(data_u8, labels, params, auxs, key):
+        # in-graph input normalization: uint8 HWC batch → scaled bf16.
+        # XLA fuses this into the first conv's input; host ships 1 byte/px.
+        data = data_u8.astype(dtype) * jnp.asarray(inv255, dtype)
+
         def loss_fn(*wrt):
-            av = list(args)
+            av = [None] * len(arg_names)
+            av[data_pos] = data
+            av[label_pos] = labels
             for i, w in zip(grad_idx, wrt):
                 av[i] = w
             outs, new_aux = graph_fn(tuple(av), auxs, key, True)
             probs = outs[0].astype(jnp.float32)
-            labels = av[label_pos].astype(jnp.int32)
+            lab = labels.astype(jnp.int32)
             ll = -jnp.mean(jnp.log(probs[jnp.arange(probs.shape[0]),
-                                         labels] + 1e-8))
+                                         lab] + 1e-8))
             return ll, new_aux
 
-        wrt = tuple(args[i] for i in grad_idx)
         (loss, new_aux), grads = jax.value_and_grad(
-            loss_fn, argnums=tuple(range(len(wrt))), has_aux=True)(*wrt)
-        new_args = list(args)
-        for i, g in zip(grad_idx, grads):
-            new_args[i] = args[i] - jnp.asarray(lr, args[i].dtype) * g
-        return loss, tuple(new_args), new_aux
+            loss_fn, argnums=tuple(range(len(params))), has_aux=True)(*params)
+        new_params = tuple(p - jnp.asarray(lr, p.dtype) * g
+                           for p, g in zip(params, grads))
+        return loss, new_params, new_aux
 
-    step = jax.jit(train_step, donate_argnums=(0,))
+    step = jax.jit(train_step, donate_argnums=(2,))
     key = jax.random.PRNGKey(0)
-    compiled = step.lower(args, auxs, key).compile()
+    data_u8 = jnp.asarray(rng.randint(0, 255, shapes["data"], dtype=np.uint8))
+    labels = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.float32))
+    compiled = step.lower(data_u8, labels, params, auxs, key).compile()
     try:
         step_flops = compiled.cost_analysis().get("flops", 0.0)
     except Exception:
         step_flops = 0.0
 
-    # warmup + marginal-protocol timing
-    loss, args, auxs = compiled(args, auxs, key)
+    # ---- measurement 1: compute-only, marginal protocol ----
+    loss, params, auxs = compiled(data_u8, labels, params, auxs, key)
     _ = float(np.asarray(loss))
     k1, k2 = (2, 6) if on_cpu else (20, 100)
     reps = 1 if on_cpu else 2
-    marginals = []
-    fallback = []
+    marginals, fallback = [], []
     for _rep in range(reps):
         elapsed = {}
         for K in (k1, k2):
             t0 = time.perf_counter()
             for i in range(K):
-                loss, args, auxs = compiled(args, auxs,
-                                            jax.random.fold_in(key, i))
+                loss, params, auxs = compiled(data_u8, labels, params, auxs,
+                                              jax.random.fold_in(key, i))
             _ = float(np.asarray(loss))  # true host sync
             elapsed[K] = time.perf_counter() - t0
-        # per-rep K2-K1 difference cancels the fixed readback cost while
-        # both runs share the same chip state; min over reps filters the
-        # tunnel's multi-second sync stalls and transient pool contention
+        # per-rep K2-K1 difference cancels the fixed readback cost; min over
+        # reps filters tunnel sync stalls and transient pool contention
         marginals.append((elapsed[k2] - elapsed[k1]) / (k2 - k1))
         fallback.append(elapsed[k2] / k2)
     dt = min(marginals)
     if dt <= 0:  # noise guard (tiny CPU runs): fall back to the longer run
         dt = min(fallback)
 
+    # ---- measurement 2: input-pipeline streaming rate ----
+    def _pipeline_rate(rec, n_batches, **kw):
+        it = ImageRecordIterImpl(
+            path_imgrec=rec, data_shape=(3, image, image), batch_size=batch,
+            rand_crop=True, rand_mirror=True, shuffle=True,
+            layout="NHWC",
+            preprocess_threads=max(2, (os.cpu_count() or 1)),
+            prefetch_buffer=2, **kw)
+        it.next()  # warm: page cache + pool spin-up
+        t0 = time.perf_counter()
+        done = 0
+        while done < n_batches:
+            try:
+                it.next()
+            except StopIteration:
+                it.reset()
+                continue
+            done += 1
+        rate = n_batches * batch / (time.perf_counter() - t0)
+        it.close()
+        return rate
+
+    pipe_raw = pipe_jpeg = None
+    tmpdir = tempfile.mkdtemp(prefix="benchrec")
+    try:
+        n_rec = 4 * batch
+        rec = _make_raw_rec(os.path.join(tmpdir, "train"), n_rec, stored)
+        pipe_raw = _pipeline_rate(rec, 8 if not on_cpu else 2,
+                                  raw_shape=(stored, stored, 3),
+                                  dtype="uint8")
+        # JPEG variant: same records re-encoded (decode cost included)
+        from mxnet_tpu import recordio as _rio
+        jrec = os.path.join(tmpdir, "train_jpg")
+        w = _rio.MXIndexedRecordIO(jrec + ".idx", jrec + ".rec", "w")
+        rd = _rio.MXIndexedRecordIO(None, rec, "r")
+        rng2 = np.random.default_rng(1)
+        for k in rd.keys[:n_rec // 2]:
+            hdr, buf = _rio.unpack(rd.read_idx(k))
+            img = np.frombuffer(buf, np.uint8).reshape(stored, stored, 3)
+            w.write_idx(k, _rio.pack_img(hdr, img, quality=90))
+        w.close()
+        rd.close()
+        pipe_jpeg = _pipeline_rate(jrec + ".rec", 4 if not on_cpu else 1,
+                                   dtype="float32", scale=1.0 / 255)
+    except Exception as e:
+        # keep the compute result even if the pipeline bench breaks, but
+        # say so — a silently missing field would read as "not run"
+        import traceback
+        print("pipeline bench failed: %r" % e, file=sys.stderr)
+        traceback.print_exc()
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
     imgs_per_sec = batch / dt
     peak = _peak_for(dev)
-    # MFU only against a real accelerator peak: the CPU fallback would
-    # otherwise report a fabricated ratio vs the assumed-TPU peak
-    mfu = step_flops / dt / peak if (step_flops and not on_cpu) else 0.0
+    # MFU only against a known accelerator peak: CPU runs and unlisted
+    # device kinds would otherwise report a ratio vs a fabricated peak
+    mfu = step_flops / dt / peak if (step_flops and peak and not on_cpu) else 0.0
     baseline = 109.0  # K80 batch-32 training img/s (BASELINE.md)
     result = {
         "metric": "resnet50_train_images_per_sec",
@@ -146,9 +238,18 @@ def main():
         "step_ms": round(dt * 1e3, 2),
         "batch": batch,
         "xla_gflops_per_step": round(step_flops / 1e9, 1),
-        "peak_tflops": round(peak / 1e12, 1),
+        "peak_tflops": round(peak / 1e12, 1) if peak else None,
         "device": getattr(dev, "device_kind", dev.platform),
+        "host_cores": os.cpu_count(),
     }
+    if pipe_raw:
+        result["pipeline_images_per_sec"] = round(pipe_raw, 2)
+        piped = min(imgs_per_sec, pipe_raw)
+        result["piped_images_per_sec"] = round(piped, 2)
+        result["piped_mfu"] = round(mfu * piped / imgs_per_sec, 4)
+        result["input_bound"] = bool(pipe_raw < imgs_per_sec)
+    if pipe_jpeg:
+        result["pipeline_jpeg_images_per_sec"] = round(pipe_jpeg, 2)
     print(json.dumps(result))
 
 

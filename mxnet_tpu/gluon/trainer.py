@@ -102,11 +102,15 @@ class Trainer(object):
                 continue
             g = param.grad()
             if kv is not None and "dist" in kv.type:
-                # cross-process gradient allreduce (DCN collectives); always
-                # pull the aggregate back and update locally — the dist path
-                # never installs an optimizer on the store, and pulling
-                # unconditionally avoids silently frozen weights if one is
-                # ever wired in
+                # cross-process gradient allreduce (DCN collectives): push
+                # the local grad, pull back the aggregate, update locally.
+                # This is only sound while the store has no updater — with
+                # one installed, push would apply the optimizer server-side
+                # and the pull below would feed a *weight* to the local
+                # updater as a gradient.
+                assert getattr(kv, "_updater", None) is None, \
+                    "Trainer's dist path requires a store without an " \
+                    "updater; use update_on_kvstore instead"
                 kv.push(i, g)
                 kv.pull(i, out=g)
                 self._updaters[0](i, g, param.data())

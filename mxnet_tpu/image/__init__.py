@@ -1,0 +1,35 @@
+"""Image data pipeline (host-side decode + augment feeding the TPU).
+
+Reference: python/mxnet/image/image.py (ImageIter:999, CreateAugmenter:885),
+src/io/iter_image_recordio_2.cc:660 (ImageRecordIter2),
+src/io/image_aug_default.cc (DefaultImageAugmenter).
+
+TPU-native split: JPEG decode and geometric/color augmentation are host CPU
+work; the pipeline's job is to keep a double-buffered stream of device-ready
+batches ahead of the compiled train step.  Augmenters here are pure
+numpy/cv2 functions with an explicit ``numpy.random.Generator`` operand (no
+hidden global RNG), mirroring how the framework threads PRNG keys through
+stochastic ops.
+"""
+from .image import (
+    imdecode, imread, imresize, resize_short, fixed_crop, center_crop,
+    random_crop, random_size_crop, color_normalize,
+    Augmenter, SequentialAug, RandomOrderAug, ResizeAug, ForceResizeAug,
+    CenterCropAug, RandomCropAug, RandomSizedCropAug, HorizontalFlipAug,
+    BrightnessJitterAug, ContrastJitterAug, SaturationJitterAug,
+    HueJitterAug, ColorJitterAug, LightingAug, ColorNormalizeAug,
+    RandomGrayAug, CastAug, CreateAugmenter,
+    ImageIter,
+)
+from .iter import ImageRecordIterImpl, ImageRecordUInt8Iter
+
+__all__ = [
+    "imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+    "center_crop", "random_crop", "random_size_crop", "color_normalize",
+    "Augmenter", "SequentialAug", "RandomOrderAug", "ResizeAug",
+    "ForceResizeAug", "CenterCropAug", "RandomCropAug", "RandomSizedCropAug",
+    "HorizontalFlipAug", "BrightnessJitterAug", "ContrastJitterAug",
+    "SaturationJitterAug", "HueJitterAug", "ColorJitterAug", "LightingAug",
+    "ColorNormalizeAug", "RandomGrayAug", "CastAug", "CreateAugmenter",
+    "ImageIter", "ImageRecordIterImpl", "ImageRecordUInt8Iter",
+]

@@ -594,10 +594,12 @@ class LibSVMIter(DataIter):
 
 def ImageRecordIter(**kwargs):
     """Record-file image pipeline (iter_image_recordio_2.cc:660); implemented
-    in mxnet_tpu.image on top of recordio + host augmentation."""
-    try:
-        from .image.image import ImageRecordIterImpl
-    except ImportError as e:
-        raise MXNetError("ImageRecordIter requires the mxnet_tpu.image "
-                         "package: %s" % e)
+    in mxnet_tpu.image on top of recordio + threaded host augmentation."""
+    from .image import ImageRecordIterImpl
     return ImageRecordIterImpl(**kwargs)
+
+
+def ImageRecordUInt8Iter(**kwargs):
+    """uint8 variant — decode/crop/mirror only (iter_image_recordio_2.cc:759)."""
+    from .image import ImageRecordUInt8Iter as _impl
+    return _impl(**kwargs)

@@ -8,7 +8,8 @@ same registry feeds mxnet_tpu.symbol, so the two frontends can never drift
 import sys as _sys
 
 from .ndarray import (NDArray, array, empty, zeros, ones, full, arange,
-                      concatenate, moveaxis, waitall, invoke, onehot_encode)
+                      concatenate, moveaxis, waitall, invoke, onehot_encode,
+                      from_numpy)
 from .utils import save, load
 from . import register as _register
 from . import random  # noqa: F401

@@ -541,6 +541,26 @@ def _creation_ctx(ctx):
 # creation functions
 # ---------------------------------------------------------------------------
 
+def from_numpy(arr, zero_copy=True):
+    """Wrap a host numpy buffer as a cpu-context NDArray via dlpack.
+
+    ~10x cheaper than ``array()``'s device_put copy, but MAY ALIAS the
+    source buffer (dlpack zero-copies when the buffer is XLA-aligned) —
+    the caller must not mutate ``arr`` afterwards.  This is the data
+    pipeline's batch-wrapping path; user code wanting copy semantics
+    should call ``array()``.
+    """
+    import jax
+    if not zero_copy or not isinstance(arr, _np.ndarray) \
+            or not arr.flags.c_contiguous or arr.dtype == _np.float64 \
+            or arr.ndim == 0:
+        return array(arr, ctx=Context("cpu", 0))
+    try:
+        return _wrap(jax.dlpack.from_dlpack(arr), Context("cpu", 0))
+    except Exception:
+        return array(arr, ctx=Context("cpu", 0))
+
+
 def array(source_array, ctx=None, dtype=None):
     if isinstance(source_array, NDArray):
         dtype = dtype or source_array.dtype

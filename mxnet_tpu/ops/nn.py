@@ -98,9 +98,14 @@ def _deconv_fill(attrs, in_shapes):
         k = attrs["kernel"]
         nf = attrs["num_filter"]
         ng = attrs.get("num_group", 1)
-        cin = data[1]
+        if _channels_last(attrs):
+            cin = data[-1]
+            wshape = (cin,) + tuple(k) + (nf // ng,)
+        else:
+            cin = data[1]
+            wshape = (cin, nf // ng) + tuple(k)
         if len(out) > 1 and out[1] is None:
-            out[1] = (cin, nf // ng) + tuple(k)
+            out[1] = wshape
         if len(out) > 2 and out[2] is None:
             out[2] = (nf,)
     return out
@@ -163,8 +168,15 @@ def convolution(attrs, data, weight, bias=None):
 def deconvolution(attrs, data, weight, bias=None):
     k, stride, dilate, pad = _conv_dims(attrs, data.ndim)
     nd = data.ndim - 2
-    spec = "NC" + "DHW"[3 - nd:]
-    wspec = "IO" + "DHW"[3 - nd:]
+    sp = "DHW"[3 - nd:]
+    if _channels_last(attrs):
+        # channels-last mirrors convolution's layout support: data N..C,
+        # weight (C, *kernel, num_filter/num_group).
+        spec = "N" + sp + "C"
+        wspec = "I" + sp + "O"
+    else:
+        spec = "NC" + sp
+        wspec = "IO" + sp
     # transposed conv = lhs-dilated conv (gradient of Convolution)
     pads = []
     for i in range(nd):
@@ -179,7 +191,9 @@ def deconvolution(attrs, data, weight, bias=None):
         dimension_numbers=(spec, wspec, spec),
         preferred_element_type=data.dtype)
     if bias is not None and not attrs["no_bias"]:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = (1,) * (data.ndim - 1) + (-1,) if _channels_last(attrs) \
+            else (1, -1) + (1,) * nd
+        out = out + bias.reshape(bshape)
     return out
 
 

@@ -139,9 +139,11 @@ class MXRecordIO(object):
             cflag = lrec >> 29
             length = lrec & ((1 << 29) - 1)
             data = self.handle.read(length)
+            if len(data) != length:
+                raise IOError("Truncated record payload in %s" % self.uri)
             pad = (4 - length % 4) % 4
-            if pad:
-                self.handle.read(pad)
+            if pad and len(self.handle.read(pad)) != pad:
+                raise IOError("Truncated record padding in %s" % self.uri)
             parts.append(data)
             if cflag in (0, 3):
                 break
@@ -156,12 +158,15 @@ class MXIndexedRecordIO(MXRecordIO):
     idx file format: "<key>\t<byte offset>\n" per record.
     """
 
-    def __init__(self, idx_path, uri, flag, key_type=int):
+    def __init__(self, idx_path, uri, flag, key_type=int, _index=None):
         self.idx_path = idx_path
         self.idx = {}
         self.keys = []
         self.key_type = key_type
         self.fidx = None
+        # prebuilt {key: offset} table (lets pipeline worker threads share
+        # one scan instead of re-reading the sidecar / re-scanning the file)
+        self._prebuilt = dict(_index) if _index is not None else None
         super().__init__(uri, flag)
 
     def open(self):
@@ -172,7 +177,10 @@ class MXIndexedRecordIO(MXRecordIO):
             self.fidx = open(self.idx_path, "w")
         else:
             self.fidx = None
-            if os.path.exists(self.idx_path):
+            if self._prebuilt is not None:
+                self.idx = dict(self._prebuilt)
+                self.keys = list(self.idx)
+            elif self.idx_path and os.path.exists(self.idx_path):
                 with open(self.idx_path) as fin:
                     for line in fin:
                         parts = line.strip().split("\t")
@@ -181,6 +189,36 @@ class MXIndexedRecordIO(MXRecordIO):
                         key = self.key_type(parts[0])
                         self.idx[key] = int(parts[1])
                         self.keys.append(key)
+            else:
+                # no sidecar: build the offset table by scanning the stream
+                # once — header reads + seeks only, payloads are skipped
+                key = 0
+                while True:
+                    pos = self.handle.tell()
+                    start = True
+                    while True:  # walk the parts of one logical record
+                        hdr = self.handle.read(8)
+                        if len(hdr) < 8:
+                            if not start:
+                                raise IOError("Truncated multi-part record "
+                                              "in %s" % self.uri)
+                            hdr = None
+                            break
+                        magic, lrec = struct.unpack("<II", hdr)
+                        if magic != _kMagic:
+                            raise IOError("Invalid magic number in record "
+                                          "file %s" % self.uri)
+                        cflag, length = lrec >> 29, lrec & ((1 << 29) - 1)
+                        self.handle.seek(length + (4 - length % 4) % 4, 1)
+                        start = False
+                        if cflag in (0, 3):
+                            break
+                    if hdr is None:
+                        break
+                    self.idx[self.key_type(key)] = pos
+                    self.keys.append(self.key_type(key))
+                    key += 1
+                self.handle.seek(0)
 
     def close(self):
         if not self.is_open:

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""im2rec — pack an image folder / .lst file into RecordIO (.rec + .idx).
+
+Reference: tools/im2rec.py (list generation + multiprocess pack loop).
+
+Two modes, same as the reference CLI:
+  --list   : scan an image root, emit prefix.lst ("idx\\tlabel\\trelpath")
+  (default): read prefix.lst, encode each image, write prefix.rec + .idx
+
+The pack loop here is a thread pool (cv2/PIL encode releases the GIL)
+feeding a single ordered writer, instead of the reference's multiprocess
+queue pair — simpler, and IO-bound anyway.
+"""
+import argparse
+import concurrent.futures
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu import recordio                      # noqa: E402
+from mxnet_tpu.image.image import list_image, imread, resize_short  # noqa: E402
+
+
+def write_list(path_out, items):
+    with open(path_out, "w") as f:
+        for i, relpath, label in items:
+            f.write("%d\t%g\t%s\n" % (i, label, relpath))
+
+
+def read_list(path_in):
+    with open(path_in) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]),
+                   np.array(parts[1:-1], dtype=np.float32),
+                   parts[-1])
+
+
+def make_list(args):
+    items = list(list_image(args.root, args.recursive, tuple(args.exts)))
+    if args.shuffle:
+        rng = np.random.default_rng(100)
+        rng.shuffle(items)
+        items = [(i, rel, lab) for i, (_, rel, lab) in enumerate(items)]
+    n_test = int(len(items) * args.test_ratio)
+    n_train = int(len(items) * args.train_ratio)
+    chunks = {"": items}
+    if args.test_ratio > 0 or args.train_ratio < 1:
+        chunks = {"_train": items[:n_train]}
+        if n_test:
+            chunks["_test"] = items[n_train:n_train + n_test]
+        if n_train + n_test < len(items):
+            chunks["_val"] = items[n_train + n_test:]
+    for suffix, chunk in chunks.items():
+        write_list(args.prefix + suffix + ".lst", chunk)
+
+
+def _encode_one(args, item):
+    idx, label, relpath = item
+    path = os.path.join(args.root, relpath)
+    img = imread(path, to_rgb=False)  # keep BGR: pack_img's jpg convention
+    if args.resize > 0:
+        img = resize_short(img, args.resize)
+    if args.center_crop:
+        h, w = img.shape[:2]
+        s = min(h, w)
+        y0, x0 = (h - s) // 2, (w - s) // 2
+        img = img[y0:y0 + s, x0:x0 + s]
+    header = recordio.IRHeader(
+        0 if label.size == 1 else label.size,
+        float(label[0]) if label.size == 1 else label, idx, 0)
+    if args.encoding == "raw":
+        # raw uint8 pixels in RGB (the training pipeline's raw_shape path
+        # reads records as RGB; img is BGR here for pack_img) — zero decode
+        # cost at training time; pair with ImageRecordIter(raw_shape=...)
+        # (requires --resize + --center-crop so every record has one shape)
+        return idx, recordio.pack(
+            header, np.ascontiguousarray(img[..., ::-1]).tobytes())
+    return idx, recordio.pack_img(header, img, quality=args.quality,
+                                  img_fmt=args.encoding)
+
+
+def make_rec(args, lst_path):
+    prefix = os.path.splitext(lst_path)[0]
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    items = list(read_list(lst_path))
+    done = 0
+    with concurrent.futures.ThreadPoolExecutor(args.num_thread) as pool:
+        for idx, payload in pool.map(
+                lambda it: _encode_one(args, it), items):
+            rec.write_idx(idx, payload)
+            done += 1
+            if done % 1000 == 0:
+                print("packed %d/%d" % (done, len(items)))
+    rec.close()
+    print("wrote %s.rec (%d records)" % (prefix, done))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="prefix of .lst/.rec files")
+    p.add_argument("root", help="image root folder")
+    p.add_argument("--list", action="store_true",
+                   help="generate the .lst instead of packing")
+    p.add_argument("--exts", nargs="+", default=[".jpeg", ".jpg", ".png"])
+    p.add_argument("--recursive", action="store_true",
+                   help="subdirectories become class labels")
+    p.add_argument("--shuffle", action=argparse.BooleanOptionalAction,
+                   default=True)
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--test-ratio", type=float, default=0.0)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge before packing")
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--encoding", default=".jpg",
+                   choices=[".jpg", ".png", "raw"])
+    p.add_argument("--num-thread", type=int, default=1)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.list:
+        make_list(args)
+        return
+    working = os.path.abspath(args.prefix)
+    dirname, base = os.path.dirname(working), os.path.basename(working)
+    lsts = [os.path.join(dirname, f) for f in os.listdir(dirname or ".")
+            if f.startswith(base) and f.endswith(".lst")]
+    if not lsts:
+        sys.exit("no %s*.lst found — run with --list first" % args.prefix)
+    for lst in sorted(lsts):
+        print("packing", lst)
+        make_rec(args, lst)
+
+
+if __name__ == "__main__":
+    main()
