@@ -205,7 +205,6 @@ def main():
         jrec = os.path.join(tmpdir, "train_jpg")
         w = _rio.MXIndexedRecordIO(jrec + ".idx", jrec + ".rec", "w")
         rd = _rio.MXIndexedRecordIO(None, rec, "r")
-        rng2 = np.random.default_rng(1)
         for k in rd.keys[:n_rec // 2]:
             hdr, buf = _rio.unpack(rd.read_idx(k))
             img = np.frombuffer(buf, np.uint8).reshape(stored, stored, 3)

@@ -10,6 +10,7 @@ ops); kvstore='dist_*' adds the cross-process allreduce before the update.
 from __future__ import annotations
 
 from .. import optimizer as opt
+from ..base import MXNetError
 from ..model import _create_kvstore
 from .parameter import ParameterDict, Parameter
 
@@ -108,9 +109,10 @@ class Trainer(object):
                 # one installed, push would apply the optimizer server-side
                 # and the pull below would feed a *weight* to the local
                 # updater as a gradient.
-                assert getattr(kv, "_updater", None) is None, \
-                    "Trainer's dist path requires a store without an " \
-                    "updater; use update_on_kvstore instead"
+                if getattr(kv, "_updater", None) is not None:
+                    raise MXNetError(
+                        "Trainer's dist path requires a store without an "
+                        "updater; use update_on_kvstore instead")
                 kv.push(i, g)
                 kv.pull(i, out=g)
                 self._updaters[0](i, g, param.data())

@@ -80,7 +80,10 @@ def imresize(img, w, h, interp=INTER_LINEAR):
         return out
     from PIL import Image
     pil = Image.fromarray(img.squeeze(-1) if img.shape[2] == 1 else img)
-    out = np.asarray(pil.resize((w, h), Image.BILINEAR))
+    pil_interp = {INTER_NEAREST: Image.NEAREST, INTER_CUBIC: Image.BICUBIC,
+                  INTER_AREA: Image.BOX,
+                  INTER_LANCZOS4: Image.LANCZOS}.get(interp, Image.BILINEAR)
+    out = np.asarray(pil.resize((w, h), pil_interp))
     if out.ndim == 2:
         out = out[:, :, None]
     return out
@@ -167,7 +170,17 @@ class Augmenter(object):
     def dumps(self):
         """Serialized [name, param-dict] form (ref image.py:Augmenter.dumps)."""
         import json
-        return json.dumps([self.__class__.__name__, self.__dict__])
+
+        def enc(v):
+            if isinstance(v, np.ndarray):
+                return v.tolist()
+            if isinstance(v, Augmenter):
+                return json.loads(v.dumps())
+            if isinstance(v, (list, tuple)):
+                return [enc(x) for x in v]
+            return v
+        return json.dumps([self.__class__.__name__,
+                           {k: enc(v) for k, v in self.__dict__.items()}])
 
     def __call__(self, img, rng):
         raise NotImplementedError
@@ -429,6 +442,10 @@ class ImageIter(DataIter):
         self.layout = layout
         self.dtype = dtype
         self._data_name, self._label_name = data_name, label_name
+        if last_batch_handle not in ("pad", "discard"):
+            raise MXNetError("last_batch_handle must be 'pad' or 'discard', "
+                             "got %r" % last_batch_handle)
+        self._last_batch_handle = last_batch_handle
         self._shuffle = shuffle
         self._rng = np.random.default_rng(seed)
         self._aug_rng = np.random.default_rng(seed + 1)
@@ -464,10 +481,13 @@ class ImageIter(DataIter):
             raise MXNetError("ImageIter needs path_imgrec, path_imglist, "
                              "or imglist")
 
-        # rank sharding: contiguous slice per part, like the record iterator
+        # rank sharding: contiguous slice per part, remainder to the last
+        # part (same cover contract as ImageRecordIterImpl)
         if num_parts > 1:
             per = len(self.seq) // num_parts
-            self.seq = self.seq[part_index * per:(part_index + 1) * per]
+            lo = part_index * per
+            hi = lo + per if part_index < num_parts - 1 else len(self.seq)
+            self.seq = self.seq[lo:hi]
 
         if aug_list is None:
             aug_list = CreateAugmenter(data_shape, **aug_kwargs)
@@ -514,6 +534,9 @@ class ImageIter(DataIter):
 
     def next(self):
         if self._cursor >= len(self.seq):
+            raise StopIteration
+        if (self._last_batch_handle == "discard"
+                and len(self.seq) - self._cursor < self.batch_size):
             raise StopIteration
         c, h, w = self.data_shape
         nhwc = self.layout == "NHWC"

@@ -169,6 +169,10 @@ def check_op_gradient(op_name, attrs, inputs, wrt=None, eps=1e-5,
         if op.stochastic:
             xs = [np.asarray(
                 jax.random.PRNGKey(key_seed), dtype=np.uint32)] + xs
+            if wrt is not None:
+                # caller's wrt indexes the *user-visible* inputs; shift past
+                # the implicit leading PRNG-key operand
+                wrt = [i + 1 for i in wrt]
         if wrt is None:
             wrt = [i for i, x in enumerate(xs)
                    if np.issubdtype(x.dtype, np.floating)]
@@ -177,6 +181,8 @@ def check_op_gradient(op_name, attrs, inputs, wrt=None, eps=1e-5,
         if not isinstance(outs, (list, tuple)):
             outs = (outs,)
         n_vis = op.num_visible_outputs if visible_only else len(outs)
+        if callable(n_vis):
+            n_vis = n_vis(a)
         rng = np.random.default_rng(0)
         proj = []
         for i, o in enumerate(outs):

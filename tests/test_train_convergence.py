@@ -1,0 +1,72 @@
+"""Trainer-level convergence tests with metric thresholds.
+
+Reference: tests/python/train/{test_mlp.py,test_conv.py} — small end-to-end
+runs asserting final accuracy above a threshold, not exact numbers
+(SURVEY §4).  MNIST is not downloadable here (zero egress), so the dataset
+is a synthetic stand-in with the same shape contract: 28x28 single-channel
+images, 10 classes, each class a smooth random prototype plus noise.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _synth_mnist(n_per_class=40, seed=7):
+    rng = np.random.default_rng(seed)
+    # smooth prototypes: low-frequency 7x7 patterns upsampled to 28x28
+    protos = []
+    for _ in range(10):
+        low = rng.random((7, 7)).astype(np.float32)
+        protos.append(np.kron(low, np.ones((4, 4), np.float32)))
+    X, Y = [], []
+    for k, p in enumerate(protos):
+        for _ in range(n_per_class):
+            X.append(np.clip(p + rng.normal(0, 0.25, (28, 28)), 0, 1))
+            Y.append(k)
+    X = np.stack(X).astype(np.float32)[:, None] - 0.5
+    Y = np.array(Y, np.float32)
+    perm = rng.permutation(len(Y))
+    return X[perm], Y[perm]
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, num_hidden=64)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _lenet_ish():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(5, 5), num_filter=8)
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=16)
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=10)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+@pytest.mark.parametrize("build,epochs,lr,threshold", [
+    (_mlp, 12, 0.1, 0.93),
+    (_lenet_ish, 10, 0.05, 0.90),
+], ids=["mlp", "conv"])
+def test_convergence(build, epochs, lr, threshold):
+    X, Y = _synth_mnist()
+    n_train = 320
+    train = mx.io.NDArrayIter(X[:n_train], Y[:n_train], batch_size=32,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(X[n_train:], Y[n_train:], batch_size=32,
+                            label_name="softmax_label")
+    mod = mx.mod.Module(build(), context=mx.cpu())
+    mod.fit(train, num_epoch=epochs,
+            optimizer_params={"learning_rate": lr, "momentum": 0.9})
+    acc = mx.metric.Accuracy()
+    mod.score(val, acc)
+    assert acc.get()[1] > threshold, \
+        "validation accuracy %.3f below %.2f" % (acc.get()[1], threshold)

@@ -129,6 +129,10 @@ def main(argv=None):
     if args.list:
         make_list(args)
         return
+    if args.encoding == "raw" and not (args.resize and args.center_crop):
+        sys.exit("--encoding raw requires --resize N and --center-crop so "
+                 "every record has one fixed shape (the reader interprets "
+                 "raw payloads via a single raw_shape)")
     working = os.path.abspath(args.prefix)
     dirname, base = os.path.dirname(working), os.path.basename(working)
     lsts = [os.path.join(dirname, f) for f in os.listdir(dirname or ".")
