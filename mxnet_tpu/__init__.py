@@ -42,6 +42,12 @@ from . import kvstore
 from . import model
 from . import callback
 from . import module
+from . import profiler
+from . import monitor
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
+from . import test_utils
 from . import module as mod
 from .module import Module
 from . import gluon

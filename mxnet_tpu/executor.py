@@ -256,8 +256,11 @@ class Executor:
             self._materialized = False
             self.outputs = _LazyOutputs(self)
             return self.outputs
-        outs, new_aux = self._get_fwd(False)(self._arg_vals(), self._aux_vals(),
-                                             self._key())
+        from . import profiler
+        with profiler.record_span("forward", "forward"):
+            outs, new_aux = self._get_fwd(False)(self._arg_vals(),
+                                                 self._aux_vals(),
+                                                 self._key())
         self._set_outputs(outs)
         self._pending_train_fwd = False
         return self.outputs
@@ -268,18 +271,21 @@ class Executor:
         key = getattr(self, "_pending_key", None)
         if key is None:
             key = self._key()
+        from . import profiler
         fn = self._get_fwd_bwd(out_grads is not None)
         grad_names = self._grad_names
         old = tuple(self.grad_dict[n]._data for n in grad_names)
-        if out_grads is None:
-            outs, new_aux, new_grads = fn(self._arg_vals(), self._aux_vals(),
-                                          key, old)
-        else:
-            if isinstance(out_grads, NDArray):
-                out_grads = [out_grads]
-            head = tuple(o._data for o in out_grads)
-            outs, new_aux, new_grads = fn(self._arg_vals(), self._aux_vals(),
-                                          key, head, old)
+        with profiler.record_span("forward_backward", "backward"):
+            if out_grads is None:
+                outs, new_aux, new_grads = fn(self._arg_vals(),
+                                              self._aux_vals(), key, old)
+            else:
+                if isinstance(out_grads, NDArray):
+                    out_grads = [out_grads]
+                head = tuple(o._data for o in out_grads)
+                outs, new_aux, new_grads = fn(self._arg_vals(),
+                                              self._aux_vals(), key, head,
+                                              old)
         self._set_outputs(outs)
         for n, a in zip(self.aux_names, new_aux):
             self.aux_dict[n]._data = a

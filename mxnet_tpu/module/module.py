@@ -433,7 +433,12 @@ class Module(BaseModule):
         """Apply optimizer to gradients (module.py:629 → model.py:126)."""
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
+        from .. import profiler
         self._params_dirty = True
+        with profiler.record_span("update", "update"):
+            self._update_impl()
+
+    def _update_impl(self):
         if self._update_on_kvstore:
             for name in self._param_names:
                 if self._exec.grad_dict.get(name) is None:

@@ -1,7 +1,15 @@
-"""PythonModule: modules implemented directly in Python (no symbol/executor).
+"""Python-defined modules: plug hand-written host computation into the
+Module training loop (no symbol, no executor).
 
-Reference: python/mxnet/module/python_module.py — used for components like
-loss layers or hand-written numpy computation inside a SequentialModule.
+Reference: python/mxnet/module/python_module.py:30 (PythonModule ABC,
+PythonLossModule:190) — the escape hatch used for custom loss heads or
+numpy post-processing stages inside a SequentialModule.
+
+Differences from the reference worth knowing:
+- ``PythonLossModule`` ships a default gradient (softmax cross-entropy:
+  ``p - onehot(label)``) so the common case needs no ``grad_func``;
+- ``install_monitor`` is a no-op rather than an error, so a Python stage
+  inside a monitored SequentialModule doesn't abort the chain.
 """
 from __future__ import annotations
 
@@ -10,27 +18,28 @@ import logging
 import numpy as np
 
 from .. import ndarray as nd
-from ..io import DataDesc
+from ..base import MXNetError
 from .base_module import BaseModule
 
 
 class PythonModule(BaseModule):
-    """A convenient base for modules written in the frontend
-    (python_module.py:30)."""
+    """Base for parameterless host-side modules (python_module.py:30).
+
+    Subclasses implement ``forward`` / ``backward`` /
+    ``_compute_output_shapes``; everything stateful about params and
+    optimizers is vacuous by construction.
+    """
 
     def __init__(self, data_names, label_names, output_names, logger=logging):
         super().__init__(logger=logger)
-        if isinstance(data_names, tuple):
-            data_names = list(data_names)
-        if isinstance(label_names, tuple):
-            label_names = list(label_names)
-        self._data_names = data_names
-        self._label_names = label_names
-        self._output_names = output_names
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names)
         self._data_shapes = None
         self._label_shapes = None
         self._output_shapes = None
 
+    # -- static I/O description -------------------------------------------
     @property
     def data_names(self):
         return self._data_names
@@ -51,96 +60,104 @@ class PythonModule(BaseModule):
     def output_shapes(self):
         return self._output_shapes
 
+    # -- vacuous parameter lifecycle ---------------------------------------
     def get_params(self):
-        return (dict(), dict())
+        return {}, {}
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
         self.params_initialized = True
 
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self.optimizer_initialized = True
+
     def update(self):
         pass
 
     def update_metric(self, eval_metric, labels):
-        if self._label_shapes is None:
-            return
-        eval_metric.update(labels, self.get_outputs())
+        if self._label_shapes is not None:
+            eval_metric.update(labels, self.get_outputs())
 
+    def install_monitor(self, mon):
+        pass  # nothing device-side to observe
+
+    # -- bind --------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
         if self.binded and not force_rebind:
-            self.logger.warning("Already bound, ignoring bind()")
+            self.logger.warning("%s already bound", type(self).__name__)
             return
+        got = [d[0] for d in data_shapes]
+        if got != self._data_names:
+            raise MXNetError("%s expects data %s, got %s"
+                             % (type(self).__name__, self._data_names, got))
+        if label_shapes is not None and \
+                len(label_shapes) != len(self._label_names):
+            raise MXNetError("%s expects %d labels, got %d"
+                             % (type(self).__name__, len(self._label_names),
+                                len(label_shapes)))
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        assert len(data_shapes) == len(self._data_names)
-        assert [x[0] for x in data_shapes] == self._data_names
         self._data_shapes = data_shapes
         self._label_shapes = label_shapes
-        if label_shapes is not None:
-            assert self._label_names is not None
-            assert len(self._label_names) == len(label_shapes)
         self._output_shapes = self._compute_output_shapes()
         self.binded = True
 
     def _compute_output_shapes(self):
-        raise NotImplementedError()
+        """Return [(name, shape)] for the outputs given bound inputs."""
+        raise NotImplementedError
 
-    def init_optimizer(self, kvstore="local", optimizer="sgd",
-                       optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
-        pass
 
-    def install_monitor(self, mon):
-        pass
+def _softmax_ce_grad(scores, labels):
+    """Default loss gradient: scores are softmax probabilities, labels are
+    class indices -> d(sum CE)/d(scores) = p - onehot."""
+    p = scores.asnumpy() if isinstance(scores, nd.NDArray) else \
+        np.asarray(scores)
+    lab = labels.asnumpy() if isinstance(labels, nd.NDArray) else \
+        np.asarray(labels)
+    onehot = np.eye(p.shape[-1], dtype=p.dtype)[lab.astype(int)]
+    return p - onehot
 
 
 class PythonLossModule(PythonModule):
-    """A loss head in Python: forward passthrough, backward via a provided
-    gradient function (python_module.py:190)."""
+    """Identity forward + user-defined backward (python_module.py:190)."""
 
     def __init__(self, name="pyloss", data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  grad_func=None):
-        super().__init__(list(data_names), list(label_names),
-                         [name + "_output"], logger=logger)
+        if len(data_names) != 1 or len(label_names) != 1:
+            raise MXNetError("PythonLossModule is single-input/single-label")
+        super().__init__(data_names, label_names, [name + "_output"],
+                         logger=logger)
         self._name = name
-        assert len(data_names) == 1
-        assert len(label_names) == 1
+        if grad_func is not None and not callable(grad_func):
+            raise MXNetError("grad_func must be callable")
+        self._grad_func = grad_func or _softmax_ce_grad
         self._scores = None
         self._labels = None
-        self._scores_grad = None
-        if grad_func is not None:
-            assert callable(grad_func)
-        self._grad_func = grad_func
+        self._grad = None
 
     def _compute_output_shapes(self):
         return [(self._name + "_output", self._data_shapes[0][1])]
 
     def forward(self, data_batch, is_train=None):
         self._scores = data_batch.data[0]
-        if is_train is None:
-            is_train = self.for_training
-        if is_train:
+        if is_train if is_train is not None else self.for_training:
             self._labels = data_batch.label[0] if data_batch.label else None
 
     def get_outputs(self, merge_multi_context=True):
         return [self._scores]
 
     def backward(self, out_grads=None):
-        assert out_grads is None, "For a loss module, out_grads should be None"
+        if out_grads is not None:
+            raise MXNetError("a loss module defines its own gradient; "
+                             "out_grads must be None")
         assert self.for_training
-        if self._grad_func is not None:
-            grad = self._grad_func(self._scores, self._labels)
-            if not isinstance(grad, nd.NDArray):
-                grad = nd.array(grad)
-            self._scores_grad = grad
-        else:
-            raise NotImplementedError()
+        g = self._grad_func(self._scores, self._labels)
+        self._grad = g if isinstance(g, nd.NDArray) else nd.array(g)
 
     def get_input_grads(self, merge_multi_context=True):
-        return [self._scores_grad]
-
-    def install_monitor(self, mon):
-        raise NotImplementedError()
+        return [self._grad]

@@ -1,59 +1,68 @@
-"""SequentialModule: chain modules, feeding outputs to the next's inputs.
+"""SequentialModule — run a chain of modules as one, piping outputs to the
+next stage's inputs.
 
-Reference: python/mxnet/module/sequential_module.py.
+Reference: python/mxnet/module/sequential_module.py:33 (API contract:
+``add(module, take_labels=..., auto_wiring=...)``, labels only reach stages
+that ask for them, inner stages get input grads for the backward chain).
+
+Re-designed around an explicit ``_Stage`` record instead of parallel
+module/meta lists; wiring between stages is computed by one helper used by
+both bind-time shape plumbing and run-time batch plumbing.
 """
 from __future__ import annotations
 
+import collections
 import logging
 
+from ..base import MXNetError
 from ..initializer import Uniform
-from ..io import DataDesc
+from ..io import DataBatch, DataDesc
 from .base_module import BaseModule
+
+_Stage = collections.namedtuple("_Stage", ["module", "take_labels",
+                                           "auto_wire"])
 
 
 class SequentialModule(BaseModule):
-    """A container chaining several modules (sequential_module.py:33)."""
+    """A pipeline of modules executed in order (sequential_module.py:33)."""
 
+    # meta-kwarg names kept for reference API compatibility
     META_TAKE_LABELS = "take_labels"
     META_AUTO_WIRING = "auto_wiring"
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
-        self._modules = []
-        self._metas = []
+        self._stages = []
         self._label_shapes = None
-        self._data_shapes = None
-        self._meta_keys = set([getattr(SequentialModule, x)
-                               for x in dir(SequentialModule)
-                               if x.startswith("META_")])
 
-    def add(self, module, **kwargs):
-        """Add a module with meta flags (take_labels, auto_wiring)."""
-        self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, "Unknown meta \"%s\", a typo?" % key
-        self._metas.append(kwargs)
+    def add(self, module, **meta):
+        unknown = set(meta) - {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
+        if unknown:
+            raise MXNetError("SequentialModule.add: unknown meta %s "
+                             "(valid: take_labels, auto_wiring)"
+                             % sorted(unknown))
+        self._stages.append(_Stage(module,
+                                   bool(meta.get(self.META_TAKE_LABELS)),
+                                   bool(meta.get(self.META_AUTO_WIRING))))
+        # a new stage invalidates any previous bind/init state
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
+    # -- properties delegate to the ends of the chain ----------------------
     @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._stages[0].module.data_names if self._stages else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._stages[-1].module.output_names if self._stages else []
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._modules[0].data_shapes
+        return self._stages[0].module.data_shapes
 
     @property
     def label_shapes(self):
@@ -63,153 +72,152 @@ class SequentialModule(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._stages[-1].module.output_shapes
 
+    # -- params ------------------------------------------------------------
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
-        for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return (arg_params, aux_params)
+        args, auxs = {}, {}
+        for st in self._stages:
+            a, x = st.module.get_params()
+            args.update(a)
+            auxs.update(x)
+        return args, auxs
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False,
                     allow_extra=False):
         if self.params_initialized and not force_init:
             return
-        assert self.binded, "call bind before initializing the parameters"
-        for module in self._modules:
-            module.init_params(initializer=initializer, arg_params=arg_params,
-                               aux_params=aux_params,
-                               allow_missing=allow_missing,
-                               force_init=force_init, allow_extra=allow_extra)
-
-        def _check_name(known_names, new_names, modules, i):
-            for name in new_names:
-                assert not name in known_names, "Duplicated parameter names: " \
-                    "name \"%s\" in layer %d (%s) is already used in layer %d " \
-                    "(%s)." % (name, i, type(modules[i]),
-                               known_names[name], type(modules[known_names[name]]))
-                known_names[name] = i
-
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, arg_params.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux_params.keys(), self._modules, i_layer)
+        assert self.binded, "bind() must run before init_params()"
+        for st in self._stages:
+            st.module.init_params(initializer=initializer,
+                                  arg_params=arg_params,
+                                  aux_params=aux_params,
+                                  allow_missing=allow_missing,
+                                  force_init=force_init,
+                                  allow_extra=allow_extra)
+        self._raise_on_shadowed_params()
         self.params_initialized = True
+
+    def _raise_on_shadowed_params(self):
+        """Two stages naming the same parameter is almost certainly a bug
+        (the dicts returned by get_params would silently merge them)."""
+        owner = {}
+        for i, st in enumerate(self._stages):
+            for group in st.module.get_params():
+                for name in group:
+                    if name in owner:
+                        raise MXNetError(
+                            "parameter %r appears in stage %d and stage %d "
+                            "of the SequentialModule; give the layers "
+                            "distinct names" % (name, owner[name], i))
+                    owner[name] = i
+
+    # -- bind --------------------------------------------------------------
+    def _wire(self, stage, shapes):
+        """Rename incoming descriptors to the stage's expected input names
+        when auto_wiring is on."""
+        if not stage.auto_wire:
+            return shapes
+        names = stage.module.data_names
+        if len(names) != len(shapes):
+            raise MXNetError(
+                "auto_wiring: stage expects %d inputs, got %d"
+                % (len(names), len(shapes)))
+        return [DataDesc(n, d.shape if isinstance(d, DataDesc) else d[1])
+                for n, d in zip(names, shapes)]
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
         if self.binded and not force_rebind:
-            self.logger.warning("Already bound, ignoring bind()")
+            self.logger.warning("SequentialModule already bound")
             return
+        if shared_module is not None:
+            raise MXNetError("SequentialModule does not support "
+                             "shared_module")
+        if not self._stages:
+            raise MXNetError("SequentialModule has no stages to bind")
         if inputs_need_grad:
             assert for_training
-        assert shared_module is None, "Shared module is not supported"
-        assert len(self._modules) > 0, "Attempting to bind an empty SequentialModule"
 
+        chain_shapes = data_shapes
+        labels_used = False
+        for i, st in enumerate(self._stages):
+            # inner stages must produce input grads so backward can chain
+            need_grad = inputs_need_grad or (for_training and i > 0)
+            st.module.bind(
+                data_shapes=self._wire(st, chain_shapes),
+                label_shapes=label_shapes if st.take_labels else None,
+                for_training=for_training,
+                inputs_need_grad=need_grad,
+                force_rebind=force_rebind, grad_req=grad_req)
+            labels_used |= st.take_labels
+            chain_shapes = st.module.output_shapes
+
+        self._label_shapes = label_shapes if labels_used else None
         self.binded = True
-        self._label_shapes = label_shapes
-
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
-
-            my_inputs_need_grad = bool(inputs_need_grad or
-                                       (for_training and i_layer > 0))
-
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [DataDesc(new_name, shape)
-                                  for (new_name, (_, shape)) in
-                                  zip(data_names, my_data_shapes)]
-
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-            # the output of the previous module is the data of the next
-            my_data_shapes = module.output_shapes
-
-        if not anybody_ever_needs_label:
-            self._label_shapes = None
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
-            self.logger.warning("optimizer already initialized, ignoring.")
+            self.logger.warning("optimizer already initialized")
             return
-        for module in self._modules:
-            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                                  optimizer_params=optimizer_params,
-                                  force_init=force_init)
+        for st in self._stages:
+            st.module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                     optimizer_params=optimizer_params,
+                                     force_init=force_init)
         self.optimizer_initialized = True
 
+    # -- compute -----------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
-        from ..io import DataBatch
-        data_batch = DataBatch(data=data_batch.data, label=data_batch.label,
-                               pad=data_batch.pad, index=data_batch.index,
-                               provide_data=data_batch.provide_data,
-                               provide_label=data_batch.provide_label)
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
+        batch = data_batch
+        for i, st in enumerate(self._stages):
+            st.module.forward(batch, is_train=is_train)
+            if i + 1 == len(self._stages):
                 break
-            data_batch.data = module.get_outputs()
-            out_shapes = module.output_shapes
-            data_batch.provide_data = [DataDesc(name, shape)
-                                       for name, shape in out_shapes]
+            outs = st.module.get_outputs()
+            batch = DataBatch(
+                data=outs, label=data_batch.label, pad=data_batch.pad,
+                index=data_batch.index,
+                provide_data=[DataDesc(n, s)
+                              for n, s in st.module.output_shapes],
+                provide_label=data_batch.provide_label)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(zip(range(len(self._modules)),
-                                                 self._modules))):
-            module.backward(out_grads=out_grads)
-            if i_layer == 0:
-                break
-            out_grads = module.get_input_grads()
+        grads = out_grads
+        for i in range(len(self._stages) - 1, -1, -1):
+            self._stages[i].module.backward(out_grads=grads)
+            if i:
+                grads = self._stages[i].module.get_input_grads()
 
     def update(self):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
-        for module in self._modules:
-            module.update()
+        for st in self._stages:
+            st.module.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(merge_multi_context)
+        return self._stages[-1].module.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and self.inputs_need_grad
-        return self._modules[0].get_input_grads(merge_multi_context)
+        assert self.binded and self.params_initialized \
+            and self.inputs_need_grad
+        return self._stages[0].module.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                module.update_metric(eval_metric, labels)
+        for st in self._stages:
+            if st.take_labels:
+                st.module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
-            module.install_monitor(mon)
+        for st in self._stages:
+            st.module.install_monitor(mon)

@@ -1,0 +1,77 @@
+"""Monitor — per-batch tensor statistics via the executor callback hook.
+
+Reference: python/mxnet/monitor.py (Monitor installs a C++ monitor
+callback, collects (batch, tensor-name, stat) rows per step, prints sorted
+on toc_print).  Here the hook is Executor.set_monitor_callback
+(mxnet_tpu/executor.py), which fires per named output when the lazy fused
+step materializes; with ``monitor_all`` the executor also reports
+arguments and gradients.
+"""
+import logging
+import re
+
+import numpy as np
+
+from .ndarray import NDArray
+
+
+class Monitor(object):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
+        if stat_func is None:
+            def stat_func(x):  # reference default: mean |x|
+                return np.abs(x).mean()
+        self.stat_func = stat_func
+        self.interval = interval
+        self.sort = sort
+        self.re_pattern = re.compile(pattern)
+        self.monitor_all = monitor_all
+        self.queue = []
+        self.step = 0
+        self.activated = False
+        self.exes = []
+        self.logger = logging.getLogger(__name__)
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_pattern.match(name):
+            return
+        if isinstance(arr, NDArray):
+            arr = arr.asnumpy()
+        else:
+            arr = np.asarray(arr)
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def install(self, exe):
+        """Attach to an executor (ref Monitor.install)."""
+        exe.set_monitor_callback(self.stat_helper, self.monitor_all)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if the interval elapsed."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Finish the batch; returns [(step, name, stat_str)]."""
+        if not self.activated:
+            return []
+        # force pending lazy outputs so callbacks fire
+        for exe in self.exes:
+            outs = getattr(exe, "outputs", None)
+            if outs:
+                for o in outs:
+                    if isinstance(o, NDArray):
+                        o.wait_to_read()
+        self.activated = False
+        res = []
+        queue = sorted(self.queue) if self.sort else self.queue
+        for step, name, stat in queue:
+            res.append((step, name, str(stat)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            self.logger.info("Batch: %7d %30s %s", step, name, stat)

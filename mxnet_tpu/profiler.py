@@ -1,0 +1,174 @@
+"""Profiler — Chrome-trace timeline + optional XLA (xplane) capture.
+
+Reference: src/engine/profiler.cc (per-op `OprExecStat` records dumped as
+Chrome trace-event JSON, `DumpProfile:147`), python/mxnet/profiler.py:27-55
+(`profiler_set_config`, `profiler_set_state`, `dump_profile`), autostart env
+`MXNET_PROFILER_AUTOSTART` (profiler.cc:66).
+
+TPU-native redesign: the reference times each engine op on its worker
+thread.  Here a training step is ONE fused XLA program (SURVEY §7 hard
+part (g)), so per-Python-op timing inside the step does not exist by
+design.  Instead:
+
+- host-side REGIONS (forward/backward/update/io/eager ops) are recorded as
+  Chrome trace-event spans — same dump format, same `dump_profile()`
+  contract, loadable in chrome://tracing / perfetto;
+- for the inside-the-step view, `start_xla_trace(logdir)` /
+  `stop_xla_trace()` wrap jax.profiler's xplane capture (TensorBoard's
+  trace viewer shows per-fusion device timing) — the tool for MFU hunting.
+
+Spans are cheap (two perf_counter calls + list append when ON, one branch
+when OFF).
+"""
+import atexit
+import json
+import os
+import threading
+import time
+
+_LOCK = threading.Lock()
+_EVENTS = []
+_STATE = {"running": False, "filename": "profile.json",
+          "continuous_dump": False}
+_T0 = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _T0) * 1e6
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json",
+                        continuous_dump=False, **kwargs):
+    """Configure output path (ref profiler.py:profiler_set_config).
+
+    ``mode`` is accepted for API parity; all host regions are recorded."""
+    _STATE["filename"] = filename
+    _STATE["continuous_dump"] = continuous_dump
+
+
+def set_config(**kwargs):
+    profiler_set_config(**kwargs)
+
+
+def profiler_set_state(state="stop"):
+    """'run' starts collecting host spans; 'stop' halts (ref :40)."""
+    assert state in ("run", "stop")
+    _STATE["running"] = state == "run"
+
+
+def set_state(state="stop"):
+    profiler_set_state(state)
+
+
+def is_running():
+    return _STATE["running"]
+
+
+class record_span:
+    """Context manager: one Chrome trace 'X' (complete) event.
+
+    Categories mirror the reference's lanes: 'forward', 'backward',
+    'update', 'io', 'op', 'kvstore'.
+    """
+    __slots__ = ("name", "cat", "_t0")
+
+    def __init__(self, name, cat="op"):
+        self.name = name
+        self.cat = cat
+        self._t0 = 0.0
+
+    def __enter__(self):
+        if _STATE["running"]:
+            self._t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if _STATE["running"] and self._t0:
+            t1 = _now_us()
+            with _LOCK:
+                _EVENTS.append({
+                    "name": self.name, "cat": self.cat, "ph": "X",
+                    "ts": self._t0, "dur": t1 - self._t0,
+                    "pid": os.getpid(),
+                    "tid": threading.get_ident() & 0xffff})
+        return False
+
+
+def instant(name, cat="marker"):
+    """Instant event (counter markers, epoch boundaries)."""
+    if _STATE["running"]:
+        with _LOCK:
+            _EVENTS.append({"name": name, "cat": cat, "ph": "i",
+                            "ts": _now_us(), "s": "g",
+                            "pid": os.getpid(),
+                            "tid": threading.get_ident() & 0xffff})
+
+
+def counter(name, value, cat="counter"):
+    """Counter sample (e.g. images/sec, loss)."""
+    if _STATE["running"]:
+        with _LOCK:
+            _EVENTS.append({"name": name, "cat": cat, "ph": "C",
+                            "ts": _now_us(), "pid": os.getpid(),
+                            "args": {name: value}})
+
+
+def dump_profile(finished=True):
+    """Write the Chrome trace JSON (ref MXDumpProfile / profiler.cc:147)."""
+    with _LOCK:
+        events = list(_EVENTS)
+        if finished:
+            _EVENTS.clear()
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"framework": "mxnet_tpu"}}
+    with open(_STATE["filename"], "w") as f:
+        json.dump(doc, f)
+    return _STATE["filename"]
+
+
+def dump(finished=True):
+    return dump_profile(finished)
+
+
+def dumps():
+    with _LOCK:
+        return json.dumps({"traceEvents": list(_EVENTS)})
+
+
+def pause():
+    _STATE["running"] = False
+
+
+def resume():
+    _STATE["running"] = True
+
+
+# -- XLA / device-side capture ----------------------------------------------
+
+_XLA_DIR = None
+
+
+def start_xla_trace(logdir="/tmp/mxnet_tpu_xplane"):
+    """Begin a jax.profiler xplane capture (device timeline per fusion).
+
+    View with TensorBoard's profile plugin; this is the tool that shows
+    where the fused train step's time actually goes."""
+    global _XLA_DIR
+    import jax
+    jax.profiler.start_trace(logdir)
+    _XLA_DIR = logdir
+    return logdir
+
+
+def stop_xla_trace():
+    global _XLA_DIR
+    import jax
+    jax.profiler.stop_trace()
+    d, _XLA_DIR = _XLA_DIR, None
+    return d
+
+
+# autostart parity: MXNET_PROFILER_AUTOSTART=1 (profiler.cc:66)
+if os.environ.get("MXNET_PROFILER_AUTOSTART") == "1":
+    profiler_set_state("run")
+    atexit.register(dump_profile)

@@ -1,0 +1,136 @@
+"""Network visualization: parameter summary table + graphviz plotting.
+
+Reference: python/mxnet/visualization.py (`print_summary` — layer table
+with output shapes and parameter counts; `plot_network` — graphviz DOT).
+Operates on the symbol JSON graph (nodes/arg_nodes/heads), so it works on
+anything `Symbol.tojson()` round-trips.
+"""
+import json
+
+import numpy as np
+
+from .base import MXNetError
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a layer-by-layer summary (ref visualization.py:print_summary).
+
+    ``shape``: dict of input name -> shape, required to report output
+    shapes and parameter counts.
+    """
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {h[0] for h in conf["heads"]}
+
+    # per-internal-output shapes
+    shape_by_node = {}
+    if shape is not None:
+        internals = symbol.get_internals()
+        _, out_shapes, _ = internals.infer_shape(**shape)
+        for name, s in zip(internals.list_outputs(), out_shapes):
+            shape_by_node[name] = s
+
+    def out_shape_of(node):
+        name = node["name"]
+        for probe in (name + "_output", name):
+            if probe in shape_by_node:
+                return shape_by_node[probe]
+        return None
+
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(vals):
+        line = ""
+        for v, pos in zip(vals, positions):
+            line = (line + str(v))[:pos - 1].ljust(pos)
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields)
+    print("=" * line_length)
+
+    arg_shapes = {}
+    if shape is not None:
+        arg_names = symbol.list_arguments()
+        arg_sh, _, aux_sh = symbol.infer_shape(**shape)
+        arg_shapes = dict(zip(arg_names, arg_sh))
+        arg_shapes.update(zip(symbol.list_auxiliary_states(), aux_sh))
+
+    total = 0
+    inputs = set(shape or ())
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if name in inputs:
+                print_row(["%s (%s)" % (name, "input"),
+                           (shape or {}).get(name, ""), 0, ""])
+            continue
+        params = 0
+        for in_idx in node["inputs"]:
+            in_node = nodes[in_idx[0]]
+            if in_node["op"] == "null" and in_node["name"] not in inputs \
+                    and not in_node["name"].endswith("_label"):
+                s = arg_shapes.get(in_node["name"])
+                if s:
+                    params += int(np.prod(s))
+        total += params
+        prev = ", ".join(nodes[j[0]]["name"] for j in node["inputs"]
+                         if nodes[j[0]]["op"] != "null")
+        shape_str = out_shape_of(node) or ""
+        print_row(["%s (%s)" % (name, op), shape_str, params, prev])
+    print("=" * line_length)
+    print("Total params: {:,}".format(total))
+    print("_" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the network (ref plot_network).
+
+    Requires the `graphviz` python package; raises with guidance if absent.
+    """
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError("plot_network requires the graphviz package "
+                         "(pip install graphviz); use print_summary for a "
+                         "text view")
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {"shape": "box", "fixedsize": "false", "style": "filled"}
+    node_attr.update(node_attrs or {})
+    dot = Digraph(name=title, format=save_format)
+    fill = {"Convolution": "#fb8072", "FullyConnected": "#fb8072",
+            "BatchNorm": "#bebada", "Activation": "#ffffb3",
+            "Pooling": "#80b1d3", "Concat": "#fdb462",
+            "SoftmaxOutput": "#b3de69"}
+    hidden = set()
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and (name.endswith("_weight")
+                                 or name.endswith("_bias")
+                                 or name.endswith("_gamma")
+                                 or name.endswith("_beta")
+                                 or "moving_" in name or "_label" in name):
+                hidden.add(i)
+                continue
+            dot.node(name=name, label=name,
+                     **{**node_attr, "fillcolor": "#8dd3c7"})
+        else:
+            dot.node(name=name, label="%s\n%s" % (name, op),
+                     **{**node_attr, "fillcolor": fill.get(op, "#d9d9d9")})
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            continue
+        for in_idx in node["inputs"]:
+            j = in_idx[0]
+            if j in hidden:
+                continue
+            dot.edge(tail_name=nodes[j]["name"], head_name=node["name"])
+    return dot

@@ -185,3 +185,44 @@ def test_sequential_module():
     seq.backward()
     seq.update()
     assert seq.get_outputs()[0].shape == (4, 4)
+
+
+def test_python_loss_module_chain():
+    """PythonLossModule supplies the head gradient for a symbol stage."""
+    import numpy as np
+    net1 = mx.sym.softmax(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc1"))
+    mod1 = mx.mod.Module(net1, label_names=None, context=mx.cpu())
+    loss = mx.mod.PythonLossModule(data_names=("softmax_output",))
+    seq = mx.mod.SequentialModule()
+    seq.add(mod1).add(loss, take_labels=True, auto_wiring=True)
+    seq.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    seq.init_params()
+    seq.init_optimizer(kvstore=None)
+    X = np.random.RandomState(0).rand(4, 6).astype(np.float32)
+    batch = mio.DataBatch(data=[mx.nd.array(X)],
+                          label=[mx.nd.array(np.array([0., 1., 2., 3.]))])
+    w0 = mod1.get_params()[0]["fc1_weight"].asnumpy().copy()
+    for _ in range(5):
+        seq.forward(batch, is_train=True)
+        seq.backward()
+        seq.update()
+    w1 = mod1.get_params()[0]["fc1_weight"].asnumpy()
+    assert np.abs(w1 - w0).sum() > 1e-3  # default softmax-CE grad flowed
+
+
+def test_sequential_module_duplicate_param_rejected():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc_same")
+    net_b = mx.sym.FullyConnected(mx.sym.Variable("fc_same_output"),
+                                  num_hidden=4, name="fc_same")
+    m1 = mx.mod.Module(net, label_names=None, context=mx.cpu())
+    m2 = mx.mod.Module(net_b, data_names=("fc_same_output",),
+                       label_names=None, context=mx.cpu())
+    seq = mx.mod.SequentialModule().add(m1).add(m2, auto_wiring=True)
+    seq.bind(data_shapes=[("data", (2, 4))])
+    import pytest
+    with pytest.raises(mx.MXNetError):
+        seq.init_params()
