@@ -22,6 +22,8 @@ from .image import (
     ImageIter,
 )
 from .iter import ImageRecordIterImpl, ImageRecordUInt8Iter
+from .detection import (ImageDetRecordIterImpl, ImageDetRecordIter,
+                        ImageDetIter, parse_det_label, pack_det_label)
 
 __all__ = [
     "imdecode", "imread", "imresize", "resize_short", "fixed_crop",

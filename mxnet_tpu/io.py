@@ -603,3 +603,9 @@ def ImageRecordUInt8Iter(**kwargs):
     """uint8 variant — decode/crop/mirror only (iter_image_recordio_2.cc:759)."""
     from .image import ImageRecordUInt8Iter as _impl
     return _impl(**kwargs)
+
+
+def ImageDetRecordIter(**kwargs):
+    """Detection record iterator (iter_image_det_recordio.cc)."""
+    from .image.detection import ImageDetRecordIterImpl
+    return ImageDetRecordIterImpl(**kwargs)

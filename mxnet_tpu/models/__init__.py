@@ -6,5 +6,6 @@ alexnet.py, vgg.py, mlp.py) — the canonical Module-API model zoo.
 from .lenet import get_lenet, get_mlp
 from .resnet import get_resnet_symbol
 from .lstm_lm import lstm_lm_symbol
+from .ssd import get_ssd_symbol
 
 __all__ = ["get_lenet", "get_mlp", "get_resnet_symbol", "lstm_lm_symbol"]

@@ -259,6 +259,10 @@ SPECS["SpatialTransformer"] = S(
         [[1, 0, 0, 0, 1, 0]], dtype=np.float64)],
     {"transform_type": "affine", "sampler_type": "bilinear",
      "target_shape": (4, 4)}, rtol=1e-2, atol=1e-3)
+SPECS["ROIPooling"] = S(
+    lambda: [_distinct(1, 2, 5, 5),
+             np.array([[0, 0, 0, 4, 4], [0, 1, 1, 3, 3]], np.float64)],
+    {"pooled_size": (2, 2), "spatial_scale": 1.0}, wrt=[0])
 SPECS["SequenceLast"] = S(lambda: [_u(4, 2, 3)], {"use_sequence_length": False})
 SPECS["SequenceMask"] = S(lambda: [_u(4, 2, 3)], {"use_sequence_length": False})
 SPECS["SequenceReverse"] = S(lambda: [_u(4, 2, 3)],
@@ -321,6 +325,11 @@ SKIPS = {
     # recurrent: gradient flows tested end-to-end in test_gluon.py RNN
     # suites; the flat-param fused op's finite-difference sweep is O(P^2)
     "RNN": "fused RNN: covered by gluon rnn_layer equivalence tests",
+    # detection ops: outputs are stop_gradient training targets /
+    # post-processed detections (reference backward emits zeros)
+    "_contrib_MultiBoxPrior": "anchor generation from static shapes",
+    "_contrib_MultiBoxTarget": "stop-gradient target assignment",
+    "_contrib_MultiBoxDetection": "stop-gradient NMS post-processing",
 }
 
 
