@@ -150,12 +150,62 @@ class Executor:
                              % (what, len(names), len(values)))
         return {n: v for n, v in zip(names, values) if v is not None}
 
-    def _apply_sharding(self):
+    @staticmethod
+    def _spans_processes(sh):
+        """True when a sharding's mesh includes non-addressable devices
+        (multi-host jax.distributed job)."""
+        try:
+            return len(sh.mesh.devices.flat) > len(sh.addressable_devices)
+        except AttributeError:
+            return False
+
+    def _place_global(self, value, sh):
+        """Place a host value with GLOBAL shape under a sharding (used for
+        bind-time arg/aux/grad buffers)."""
         import jax
+        if sh is None:
+            return jax.device_put(value, self._ctx.jax_device())
+        if self._spans_processes(sh):
+            host = _np.asarray(value)
+            return jax.make_array_from_callback(
+                host.shape, sh, lambda idx: host[idx])
+        return jax.device_put(value, sh)
+
+    def _place_local(self, value, sh):
+        """Place this process's LOCAL portion (its batch slice for
+        dp-sharded inputs, the full value for replicated entries) — the
+        TPU-native equivalent of the reference's per-worker data partition
+        (kvstore_dist.h rank/size record sharding)."""
+        import jax
+        if sh is None:
+            return jax.device_put(value, self._ctx.jax_device())
+        if self._spans_processes(sh):
+            return jax.make_array_from_process_local_data(
+                sh, _np.asarray(value))
+        return jax.device_put(value, sh)
+
+    @staticmethod
+    def _localize(arr):
+        """Host-readable view of a possibly multi-process array: the full
+        value when replicated, this process's dim0 rows when dp-sharded
+        (metrics in dist training are per-worker, like the reference)."""
+        if getattr(arr, "is_fully_addressable", True):
+            return arr
+        if getattr(arr, "is_fully_replicated", False):
+            return arr.addressable_shards[0].data
+        import jax
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: (s.index[0].start or 0)
+                        if s.index else 0)
+        local = _np.concatenate([_np.asarray(s.data) for s in shards],
+                                axis=0)
+        return jax.device_put(local, shards[0].data.devices().pop())
+
+    def _apply_sharding(self):
         for name, sh in self._sharding.items():
             for d in (self.arg_dict, self.aux_dict, self.grad_dict):
                 if name in d:
-                    d[name]._data = jax.device_put(d[name]._data, sh)
+                    d[name]._data = self._place_global(d[name]._data, sh)
 
     # ------------------------------------------------------------------
     def _build(self):
@@ -242,12 +292,13 @@ class Executor:
             sh = self._sharding.get(k) if self._sharding else None
             if isinstance(v, NDArray):
                 v = v._data
-                self.arg_dict[k]._data = v if sh is None \
-                    else jax.device_put(v, sh)
+            if sh is None:
+                self.arg_dict[k]._data = v if hasattr(v, "sharding") \
+                    else jax.device_put(_np.asarray(v),
+                                        self._ctx.jax_device())
             else:
-                self.arg_dict[k]._data = jax.device_put(
-                    _np.asarray(v), sh if sh is not None
-                    else self._ctx.jax_device())
+                # batch feed: local slice on multi-process meshes
+                self.arg_dict[k]._data = self._place_local(v, sh)
         if is_train:
             # lazy: the fused fwd+bwd program at backward() computes outputs
             # too, so running forward now would execute the graph twice.
@@ -305,7 +356,7 @@ class Executor:
                 self.aux_dict[n]._data = a
 
     def _set_outputs(self, outs):
-        self.outputs = [_wrap(o, self._ctx) for o in outs]
+        self.outputs = [_wrap(self._localize(o), self._ctx) for o in outs]
         if self._monitor is not None:
             for name, o in zip(self.output_names, self.outputs):
                 self._monitor(name, o)

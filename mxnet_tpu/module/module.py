@@ -80,6 +80,7 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._plan = None  # parallel.ShardingPlan (set_sharding_plan)
+        self._dist_fused = False  # grads reduced inside the jitted step
 
     def set_sharding_plan(self, plan):
         """Attach a parallel.ShardingPlan; bind() will place data batch-
@@ -89,18 +90,50 @@ class Module(BaseModule):
         assert not self.binded, "set_sharding_plan must precede bind"
         self._plan = plan
 
+    def _maybe_auto_dist_plan(self):
+        """Inside a launched multi-process job (jax.distributed env set),
+        install a data-parallel ShardingPlan over the GLOBAL device mesh so
+        gradients are reduced by compiled collectives inside the one fused
+        step — the default dist path.  Per-key kvstore push/pull remains
+        the compat veneer for direct KVStore use."""
+        if self._plan is not None:
+            return
+        from .. import kvstore_dist
+        if not kvstore_dist.init_distributed():
+            return
+        import jax
+        if jax.process_count() <= 1:
+            return
+        import numpy as np
+        from jax.sharding import Mesh
+        from ..parallel.mesh import ShardingPlan
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        self._plan = ShardingPlan(mesh, batch_axis="dp")
+        self._dist_fused = True
+
+    def _global_shapes(self, descs):
+        """Scale local batch descriptors to global (dim0 x num_processes)
+        in fused-dist mode."""
+        if not self._dist_fused:
+            return descs
+        import jax
+        n = jax.process_count()
+        return [DataDesc(d.name, (d.shape[0] * n,) + tuple(d.shape[1:]),
+                         d.dtype, d.layout) for d in descs]
+
     def _build_sharding_map(self):
         if self._plan is None:
             return None
         plan = self._plan
         shardings = {}
-        for d in self._data_shapes:
+        for d in self._global_shapes(self._data_shapes):
             shardings[d.name] = plan.data_sharding(d.shape)
-        for l in (self._label_shapes or []):
+        for l in self._global_shapes(self._label_shapes or []):
             shardings[l.name] = plan.data_sharding(l.shape)
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(
-            **{d.name: d.shape for d in self._data_shapes},
-            **({l.name: l.shape for l in self._label_shapes}
+            **{d.name: d.shape for d in self._global_shapes(self._data_shapes)},
+            **({l.name: l.shape
+                for l in self._global_shapes(self._label_shapes)}
                if self._label_shapes else {}))
         for name, s in zip(self._symbol.list_arguments(), arg_shapes):
             if name not in shardings:
@@ -253,12 +286,18 @@ class Module(BaseModule):
         else:
             self._label_shapes = None
 
-        shapes = {d.name: d.shape for d in self._data_shapes}
-        if self._label_shapes:
-            shapes.update({l.name: l.shape for l in self._label_shapes})
-        types = {d.name: d.dtype for d in self._data_shapes}
-        if self._label_shapes:
-            types.update({l.name: l.dtype for l in self._label_shapes})
+        # in a launched dist job, default to the fused sharded step:
+        # user-facing shapes stay LOCAL, the compiled program is GLOBAL
+        self._maybe_auto_dist_plan()
+        gdata = self._global_shapes(self._data_shapes)
+        glabel = self._global_shapes(self._label_shapes or []) or None
+
+        shapes = {d.name: d.shape for d in gdata}
+        if glabel:
+            shapes.update({l.name: l.shape for l in glabel})
+        types = {d.name: d.dtype for d in gdata}
+        if glabel:
+            types.update({l.name: l.dtype for l in glabel})
 
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shapes)
         arg_types, _, aux_types = self._symbol.infer_type(**types)
@@ -341,6 +380,11 @@ class Module(BaseModule):
         from ..model import _create_kvstore
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._exec.arg_dict)
+        if self._dist_fused:
+            # gradients are reduced by compiled collectives inside the
+            # jitted step; the store would double-count them.  Keep the
+            # store only for rank/num_workers/barrier bookkeeping.
+            update_on_kvstore = False
 
         batch_size = self._data_shapes[0].shape[0]
         if kvstore and "dist" in kvstore.type and "_async" not in kvstore.type:
@@ -446,7 +490,7 @@ class Module(BaseModule):
                 self._kvstore.push(name, self._exec.grad_dict[name])
                 self._kvstore.pull(name, out=self._exec.arg_dict[name])
         else:
-            if self._kvstore:
+            if self._kvstore and not self._dist_fused:
                 for name in self._param_names:
                     g = self._exec.grad_dict.get(name)
                     if g is None:

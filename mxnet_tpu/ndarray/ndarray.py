@@ -265,13 +265,23 @@ class NDArray:
             # params placed over a mesh must stay sharded through the
             # get_params/set_params round-trips of Module.fit
             dst = other.context.jax_device()
+            multiproc = False
             try:
                 sh = other._data.sharding
                 if len(sh.device_set) > 1:
                     dst = sh
+                    multiproc = len(sh.device_set) > \
+                        len(getattr(sh, "addressable_devices", sh.device_set))
             except AttributeError:
                 pass
-            other._data = jax.device_put(self._data, dst)
+            if multiproc:
+                # cross-host sharding: every process holds the same host
+                # value; assemble the global array shard-by-shard
+                host = _np.asarray(self._data)
+                other._data = jax.make_array_from_callback(
+                    host.shape, dst, lambda idx: host[idx])
+            else:
+                other._data = jax.device_put(self._data, dst)
             return other
         if isinstance(other, Context):
             return _wrap(jax.device_put(self._data, other.jax_device()), other)

@@ -1,6 +1,11 @@
-"""Distributed kvstore test: N local processes over loopback, the reference's
-tests/nightly/dist_sync_kvstore.py pattern (each worker pushes rank-dependent
-values; asserts the aggregate)."""
+"""Distributed kvstore + fused-step tests: N local processes over loopback.
+
+Reference pattern: tests/nightly/dist_sync_kvstore.py:20-25 — each worker
+pushes rank-dependent values and asserts exact aggregates, including
+compressed and row-sparse paths; plus the fused Module path where
+gradients never leave the jitted step (kvstore push is forbidden by
+monkeypatch and replicas must stay bit-identical).
+"""
 import os
 import subprocess
 import sys
@@ -18,21 +23,108 @@ _WORKER = textwrap.dedent("""
 
     kv = mx.kv.create("dist_sync")
     rank, size = kv.rank, kv.num_workers
-    assert size == 2, size
-    kv.init("w", mx.nd.zeros((4,)))
-    # each worker pushes (rank+1) * ones; sync allreduce sums to 3
-    kv.push("w", mx.nd.ones((4,)) * (rank + 1))
-    out = mx.nd.zeros((4,))
-    kv.pull("w", out=out)
-    np.testing.assert_allclose(out.asnumpy(), np.full(4, 3.0))
+    assert size == {N}, size
+
+    # --- many keys, exact aggregates (dist_sync_kvstore.py pattern) ---
+    shapes = {{"a": (4,), "b": (3, 5), "c": (2, 2, 2)}}
+    for i, (k, s) in enumerate(sorted(shapes.items())):
+        kv.init(k, mx.nd.zeros(s))
+        kv.push(k, mx.nd.ones(s) * (rank + 1) * (i + 1))
+        out = mx.nd.zeros(s)
+        kv.pull(k, out=out)
+        expect = (i + 1) * size * (size + 1) / 2.0
+        np.testing.assert_allclose(out.asnumpy(), np.full(s, expect),
+                                   rtol=1e-6)
+
+    # --- 2-bit compressed push: values quantize exactly to threshold ---
+    kvc = mx.kv.create("dist_sync")
+    kvc.set_gradient_compression({{"type": "2bit", "threshold": 0.5}})
+    kvc.init("g", mx.nd.zeros((6,)))
+    # every worker pushes 0.5 -> quantized exactly; aggregate = 0.5*size
+    kvc.push("g", mx.nd.ones((6,)) * 0.5)
+    out = mx.nd.zeros((6,))
+    kvc.pull("g", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(6, 0.5 * size),
+                               rtol=1e-6)
+    # second push of 0.3: below threshold -> quantizes to 0 everywhere,
+    # residual 0.3 carried; aggregate stays unchanged
+    kvc.push("g", mx.nd.ones((6,)) * 0.3)
+    out2 = mx.nd.zeros((6,))
+    kvc.pull("g", out=out2)
+    np.testing.assert_allclose(out2.asnumpy(), np.full(6, 0.0), atol=1e-6)
+    # third push of 0.3: residual 0.3 + 0.3 >= 0.5 -> quantizes to 0.5
+    kvc.push("g", mx.nd.ones((6,)) * 0.3)
+    kvc.pull("g", out=out2)
+    np.testing.assert_allclose(out2.asnumpy(), np.full(6, 0.5 * size),
+                               rtol=1e-6)
+
+    # --- row-sparse pull after dist push ---
+    kv.init("rs", mx.nd.zeros((6, 3)))
+    kv.push("rs", mx.nd.ones((6, 3)) * (rank + 1))
+    rows = mx.nd.array(np.array([1, 4], np.float32))
+    sparse_out = mx.nd.zeros((6, 3)).tostype("row_sparse")
+    kv.row_sparse_pull("rs", out=sparse_out, row_ids=rows)
+    dense = sparse_out.tostype("default").asnumpy()
+    total = size * (size + 1) / 2.0
+    np.testing.assert_allclose(dense[[1, 4]], np.full((2, 3), total))
+    np.testing.assert_allclose(dense[[0, 2, 3, 5]], 0.0)
+
     kv.barrier()
-    print("WORKER_OK", rank)
+    print("KV_OK_%d" % rank)
+
+    # --- fused Module dist path: ONE compiled step, no per-key push ---
+    import mxnet_tpu.kvstore_dist as kvd
+
+    def _forbid_push(self, *a, **k):
+        raise AssertionError("per-key push used in fused dist path")
+    kvd.KVStoreDist.push = _forbid_push
+
+    B = 8  # local batch
+    rng = np.random.default_rng(0)  # identical across ranks
+    Xg = rng.standard_normal((B * size, 6)).astype(np.float32)
+    Yg = (np.arange(B * size) % 3).astype(np.float32)
+    X, Y = Xg[rank * B:(rank + 1) * B], Yg[rank * B:(rank + 1) * B]
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (B, 6))],
+             label_shapes=[("softmax_label", (B,))])
+    assert mod._dist_fused, "auto dist plan not installed"
+    init_w = np.full((3, 6), 0.01, np.float32)
+    mod.init_params(arg_params={"fc_weight": mx.nd.array(init_w),
+                                "fc_bias": mx.nd.zeros((3,))},
+                    allow_missing=False)
+    mod.init_optimizer(kvstore="dist_sync",
+                       optimizer_params={"learning_rate": 0.5})
+    from mxnet_tpu.io import DataBatch
+    for step in range(3):
+        b = DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)])
+        mod.forward_backward(b)
+        mod.update()
+    w = mod._exec.arg_dict["fc_weight"].asnumpy()
+
+    # expected: single-process SGD on the GLOBAL batch with
+    # rescale = 1/(B*size) — replicas must match it bit-for-bit-ish
+    We = init_w.copy(); be = np.zeros(3, np.float32)
+    for step in range(3):
+        logits = Xg @ We.T + be
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        p = e / e.sum(1, keepdims=True)
+        onehot = np.eye(3, dtype=np.float32)[Yg.astype(int)]
+        gW = (p - onehot).T @ Xg / (B * size)
+        gb = (p - onehot).sum(0) / (B * size)
+        We -= 0.5 * gW; be -= 0.5 * gb
+    np.testing.assert_allclose(w, We, rtol=1e-4, atol=1e-5)
+    print("FUSED_OK_%d" % rank)
 """)
 
 
-def test_dist_sync_two_workers(tmp_path):
+def _run_workers(tmp_path, n, timeout=240):
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
+    script.write_text(_WORKER.replace("{N}", str(n)).replace("{{", "{")
+                      .replace("}}", "}"))
     launch = os.path.join(os.path.dirname(__file__), "..", "tools",
                           "launch.py")
     env = dict(os.environ)
@@ -40,12 +132,19 @@ def test_dist_sync_two_workers(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     repo_root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.run(
-        [sys.executable, launch, "-n", "2", "--launcher", "local",
+    return subprocess.run(
+        [sys.executable, launch, "-n", str(n), "--launcher", "local",
          sys.executable, str(script)],
-        capture_output=True, text=True, timeout=150, env=env)
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_dist_sync_workers(tmp_path, n):
+    proc = _run_workers(tmp_path, n)
     out = proc.stdout + proc.stderr
     if proc.returncode != 0 and "coordinator" in out.lower():
         pytest.skip("jax.distributed unavailable in this environment")
     assert proc.returncode == 0, out
-    assert "WORKER_OK 0" in out and "WORKER_OK 1" in out, out
+    for r in range(n):
+        assert "KV_OK_%d" % r in out, out
+        assert "FUSED_OK_%d" % r in out, out
