@@ -45,6 +45,7 @@ from . import module
 from . import profiler
 from . import monitor
 from .monitor import Monitor
+from . import rnn
 from . import visualization
 from . import visualization as viz
 from . import test_utils

@@ -192,3 +192,13 @@ def rnn(attrs, rng, data, parameters, state, state_cell=None):
     if mode == "lstm":
         return x, h_stack, jnp.stack(c_outs)
     return x, h_stack
+
+
+@register("_begin_state", nin=1, input_names=["data"],
+          params={"num_hidden": P(int), "batch_axis": P(int, 0)})
+def _begin_state(attrs, data):
+    """Zero initial state shaped (batch, num_hidden) from any batch-major
+    input — lets symbolic RNN cells start from zeros without knowing the
+    batch size at graph-construction time (mx.rnn begin_state analog)."""
+    b = data.shape[attrs["batch_axis"]]
+    return jnp.zeros((b, attrs["num_hidden"]), dtype=data.dtype)

@@ -1,0 +1,35 @@
+"""RNN checkpoint helpers (python/mxnet/rnn/rnn.py:32-97).
+
+Cells' fused/unfused weight layouts are normalized through
+(un)pack_weights around the standard Module checkpoint format, so a model
+trained with FusedRNNCell restores into unfused cells and vice versa.
+"""
+from .. import model as _model
+from ..base import MXNetError
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
+    """Save with cell weights packed to the canonical layout (rnn.py:32)."""
+    cells = cells if isinstance(cells, (list, tuple)) else [cells]
+    for cell in cells:
+        arg_params = cell.pack_weights(arg_params)
+    _model.save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Load and unpack per-cell weights (rnn.py:62)."""
+    sym, arg, aux = _model.load_checkpoint(prefix, epoch)
+    cells = cells if isinstance(cells, (list, tuple)) else [cells]
+    for cell in cells:
+        arg = cell.unpack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback variant (rnn.py:97)."""
+    period = max(1, int(period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+    return _callback

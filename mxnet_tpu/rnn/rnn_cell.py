@@ -1,0 +1,489 @@
+"""Symbolic RNN cells + unroll for the Module/BucketingModule path.
+
+Reference: python/mxnet/rnn/rnn_cell.py (BaseRNNCell:108, RNNCell:362,
+LSTMCell:408, GRUCell:469, FusedRNNCell:536, SequentialRNNCell:748,
+DropoutCell:827, ZoneoutCell:909, ResidualCell:957, BidirectionalCell:998,
+RNNParams:78).
+
+TPU-native notes: an explicitly unrolled cell graph and the fused `RNN` op
+compile to the same XLA program class (the fused op uses lax.scan, the
+unroll emits T repeated blocks that XLA's loop canonicalizer handles);
+FusedRNNCell here targets the scan-based op — the analog of cuDNN RNN.
+Weight layout matches the reference (i2h/h2h weight+bias per gate block)
+so checkpoints round-trip.
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+from ..base import MXNetError
+
+
+class RNNParams(object):
+    """Container lazily creating shared weight Variables (rnn_cell.py:78)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = sym.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell(object):
+    """One recurrence step over symbols (rnn_cell.py:108)."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._init_counter = -1
+        self._counter = -1
+
+    @property
+    def params(self):
+        return self._params
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def state_info(self):
+        """[{'shape': (0, H), '__layout__': 'NC'}, ...] — 0 = batch."""
+        raise NotImplementedError
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def __call__(self, inputs, states):
+        """One step: (output_sym, [next_state_syms])."""
+        raise NotImplementedError
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def begin_state(self, func=None, anchor=None, **kwargs):
+        """Initial states.  With the default func, states are zeros derived
+        from ``anchor`` (any batch-major input symbol) via the
+        `_begin_state` op; pass func=sym.Variable for trainable/fed states.
+        """
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            if func is not None:
+                states.append(func(name="%sbegin_state_%d"
+                              % (self._prefix, self._init_counter), **kwargs))
+                continue
+            if anchor is None:
+                raise MXNetError("begin_state needs an `anchor` symbol to "
+                                 "infer the batch dimension (or pass func=)")
+            states.append(sym._begin_state(
+                anchor, num_hidden=info["shape"][1],
+                name="%sbegin_state_%d" % (self._prefix,
+                                           self._init_counter)))
+        return states
+
+    # -- weight (un)packing: reference fused<->unfused layout -------------
+    def unpack_weights(self, args):
+        """Split fused parameter blobs into per-gate entries (identity for
+        already-unfused cells)."""
+        return dict(args)
+
+    def pack_weights(self, args):
+        return dict(args)
+
+    def unroll(self, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC", merge_outputs=None):
+        """Unroll ``length`` steps (rnn_cell.py BaseRNNCell.unroll).
+
+        inputs: one symbol (batch, T, C) for NTC — sliced per step — or a
+        list of per-step symbols.  Returns (outputs, states); outputs is a
+        single (batch, T, H) symbol when merge_outputs else a list.
+        """
+        self.reset()
+        axis = layout.find("T")
+        if inputs is None:
+            inputs = [sym.Variable("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        if isinstance(inputs, sym.Symbol):
+            if len(inputs) != 1:
+                raise MXNetError("unroll expects a single-output symbol")
+            anchor = inputs
+            inputs = list(sym.SliceChannel(inputs, axis=axis,
+                                           num_outputs=length,
+                                           squeeze_axis=True))
+        else:
+            anchor = inputs[0]
+        if begin_state is None:
+            begin_state = self.begin_state(anchor=anchor)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            out, states = self(inputs[t], states)
+            outputs.append(out)
+        if merge_outputs:
+            outputs = sym.Concat(
+                *[sym.expand_dims(o, axis=axis) for o in outputs], dim=axis)
+        return outputs, states
+
+
+class RNNCell(BaseRNNCell):
+    """Elman RNN: h' = act(W_i x + b_i + W_h h + b_h) (rnn_cell.py:362)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym.FullyConnected(inputs, weight=self.params.get("i2h_weight"),
+                                 bias=self.params.get("i2h_bias"),
+                                 num_hidden=self._num_hidden,
+                                 name=name + "i2h")
+        h2h = sym.FullyConnected(states[0],
+                                 weight=self.params.get("h2h_weight"),
+                                 bias=self.params.get("h2h_bias"),
+                                 num_hidden=self._num_hidden,
+                                 name=name + "h2h")
+        out = sym.Activation(i2h + h2h, act_type=self._activation,
+                             name=name + "out")
+        return out, [out]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM with reference gate order i, f, c, o (rnn_cell.py:408)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._forget_bias = forget_bias
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        h = self._num_hidden
+        i2h = sym.FullyConnected(inputs, weight=self.params.get("i2h_weight"),
+                                 bias=self.params.get("i2h_bias"),
+                                 num_hidden=h * 4, name=name + "i2h")
+        h2h = sym.FullyConnected(states[0],
+                                 weight=self.params.get("h2h_weight"),
+                                 bias=self.params.get("h2h_bias"),
+                                 num_hidden=h * 4, name=name + "h2h")
+        gates = sym.SliceChannel(i2h + h2h, num_outputs=4, axis=1,
+                                 name=name + "slice")
+        in_gate = sym.Activation(gates[0], act_type="sigmoid")
+        forget_gate = sym.Activation(gates[1] + self._forget_bias,
+                                     act_type="sigmoid")
+        in_trans = sym.Activation(gates[2], act_type="tanh")
+        out_gate = sym.Activation(gates[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_trans
+        next_h = out_gate * sym.Activation(next_c, act_type="tanh",
+                                           name=name + "state_act")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU with reference gate order r, z, n (rnn_cell.py:469)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        h = self._num_hidden
+        i2h = sym.FullyConnected(inputs, weight=self.params.get("i2h_weight"),
+                                 bias=self.params.get("i2h_bias"),
+                                 num_hidden=h * 3, name=name + "i2h")
+        h2h = sym.FullyConnected(states[0],
+                                 weight=self.params.get("h2h_weight"),
+                                 bias=self.params.get("h2h_bias"),
+                                 num_hidden=h * 3, name=name + "h2h")
+        i2h_g = sym.SliceChannel(i2h, num_outputs=3, axis=1)
+        h2h_g = sym.SliceChannel(h2h, num_outputs=3, axis=1)
+        reset = sym.Activation(i2h_g[0] + h2h_g[0], act_type="sigmoid")
+        update = sym.Activation(i2h_g[1] + h2h_g[1], act_type="sigmoid")
+        cand = sym.Activation(i2h_g[2] + reset * h2h_g[2], act_type="tanh")
+        next_h = update * states[0] + (1.0 - update) * cand
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stacked cells (rnn_cell.py:748)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        return self
+
+    @property
+    def state_info(self):
+        return [info for c in self._cells for info in c.state_info]
+
+    def begin_state(self, **kwargs):
+        return [s for c in self._cells for s in c.begin_state(**kwargs)]
+
+    def unpack_weights(self, args):
+        for c in self._cells:
+            args = c.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for c in self._cells:
+            args = c.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        next_states = []
+        pos = 0
+        out = inputs
+        for c in self._cells:
+            n = len(c.state_info)
+            out, ns = c(out, states[pos:pos + n])
+            next_states.extend(ns)
+            pos += n
+        return out, next_states
+
+    def reset(self):
+        super().reset()
+        for c in self._cells:
+            c.reset()
+
+
+class DropoutCell(BaseRNNCell):
+    """Stateless dropout step (rnn_cell.py:827)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        out = sym.Dropout(inputs, p=self._dropout,
+                          name="%st%d" % (self._prefix, self._counter)) \
+            if self._dropout > 0 else inputs
+        return out, states
+
+
+class ModifierCell(BaseRNNCell):
+    """Wraps a base cell, reusing its params (rnn_cell.py:867)."""
+
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell.prefix, params=base_cell.params)
+        self.base_cell = base_cell
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, **kwargs):
+        return self.base_cell.begin_state(**kwargs)
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+    def reset(self):
+        super().reset()
+        self.base_cell.reset()
+
+
+class ResidualCell(ModifierCell):
+    """output += input (rnn_cell.py:957)."""
+
+    def __call__(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization: randomly hold previous states
+    (rnn_cell.py:909)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self._zo_out = zoneout_outputs
+        self._zo_state = zoneout_states
+        self._prev = None
+
+    def reset(self):
+        super().reset()
+        self._prev = None
+
+    def __call__(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+
+        def mix(p, new, old):
+            if p <= 0 or old is None:
+                return new
+            mask = sym.Dropout(sym.ones_like(new), p=p)
+            # dropout scales kept by 1/(1-p); normalize back to {0,1}
+            keep = mask * (1.0 - p)
+            return keep * new + (1.0 - keep) * old
+        prev_out = self._prev
+        mixed_out = mix(self._zo_out, out, prev_out)
+        mixed_states = [mix(self._zo_state, ns, s)
+                        for ns, s in zip(next_states, states)]
+        self._prev = out
+        return mixed_out, mixed_states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Forward + backward cells over the sequence (rnn_cell.py:998).
+    Only usable through unroll()."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__(prefix="", params=params)
+        self._l = l_cell
+        self._r = r_cell
+        self._output_prefix = output_prefix
+
+    @property
+    def state_info(self):
+        return self._l.state_info + self._r.state_info
+
+    def begin_state(self, **kwargs):
+        return self._l.begin_state(**kwargs) + self._r.begin_state(**kwargs)
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll()")
+
+    def unroll(self, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC", merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if isinstance(inputs, sym.Symbol):
+            anchor = inputs
+            inputs = list(sym.SliceChannel(inputs, axis=axis,
+                                           num_outputs=length,
+                                           squeeze_axis=True))
+        else:
+            anchor = inputs[0]
+        if begin_state is None:
+            begin_state = self.begin_state(anchor=anchor)
+        nl = len(self._l.state_info)
+        l_out, l_states = self._l.unroll(length, inputs,
+                                         begin_state[:nl], layout=layout)
+        r_out, r_states = self._r.unroll(length, list(reversed(inputs)),
+                                         begin_state[nl:], layout=layout)
+        r_out = list(reversed(r_out))
+        outputs = [sym.Concat(lo, ro, dim=1,
+                              name="%st%d" % (self._output_prefix, t))
+                   for t, (lo, ro) in enumerate(zip(l_out, r_out))]
+        if merge_outputs:
+            outputs = sym.Concat(
+                *[sym.expand_dims(o, axis=axis) for o in outputs], dim=axis)
+        return outputs, l_states + r_states
+
+
+class FusedRNNCell(BaseRNNCell):
+    """The scan-based fused multi-layer RNN op — cuDNN FusedRNNCell analog
+    (rnn_cell.py:536; op: mxnet_tpu/ops/rnn.py `RNN`)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, prefix=None, params=None,
+                 forget_bias=1.0):
+        prefix = "%s_" % mode if prefix is None else prefix
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidi = bidirectional
+        self._dropout = dropout
+
+    @property
+    def state_info(self):
+        d = 2 if self._bidi else 1
+        n = [{"shape": (self._num_layers * d, 0, self._num_hidden),
+              "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            n.append({"shape": (self._num_layers * d, 0, self._num_hidden),
+                      "__layout__": "LNC"})
+        return n
+
+    def unroll(self, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC", merge_outputs=None):
+        self.reset()
+        if not isinstance(inputs, sym.Symbol):
+            inputs = sym.Concat(*[sym.expand_dims(i, axis=1)
+                                  for i in inputs], dim=1)
+        if layout == "NTC":
+            inputs = sym.transpose(inputs, axes=(1, 0, 2))  # -> TNC
+        out = sym.RNN(inputs, self.params.get("parameters"),
+                      self.params.get("state"),
+                      *((self.params.get("state_cell"),)
+                        if self._mode == "lstm" else ()),
+                      mode=self._mode, state_size=self._num_hidden,
+                      num_layers=self._num_layers,
+                      bidirectional=self._bidi, p=self._dropout,
+                      name=self._prefix + "rnn")
+        outputs = out if not isinstance(out, (list, tuple)) else out
+        if layout == "NTC":
+            outputs = sym.transpose(outputs, axes=(1, 0, 2))
+        if not merge_outputs:
+            outputs = list(sym.SliceChannel(outputs, axis=layout.find("T"),
+                                            num_outputs=length,
+                                            squeeze_axis=True))
+        return outputs, []
+
+    def unfuse(self):
+        """Equivalent SequentialRNNCell of unfused cells (rnn_cell.py:700)."""
+        stack = SequentialRNNCell()
+        make = {"rnn_tanh": lambda p: RNNCell(self._num_hidden, "tanh", p),
+                "rnn_relu": lambda p: RNNCell(self._num_hidden, "relu", p),
+                "lstm": lambda p: LSTMCell(self._num_hidden, p),
+                "gru": lambda p: GRUCell(self._num_hidden, p)}[self._mode]
+        for i in range(self._num_layers):
+            stack.add(make("%sl%d_" % (self._prefix, i)))
+            if self._dropout > 0 and i < self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      "%sl%d_drop_" % (self._prefix, i)))
+        return stack

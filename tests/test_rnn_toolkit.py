@@ -1,0 +1,157 @@
+"""mx.rnn toolkit tests: cells, unroll, bucketed LM training.
+
+Reference: tests/python/unittest/test_rnn.py (cell output shapes,
+unfuse equivalence) and tests/python/train/test_bucketing.py (bucketed LM
+converges; ≤1 compile per bucket).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import rnn as mrnn
+
+
+def _step_shapes(cell, num_in=8, batch=4, length=3):
+    data = mx.sym.Variable("data")  # (B, T, I)
+    outputs, states = cell.unroll(length, inputs=data, merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(batch, length, num_in))
+    return out_shapes[0]
+
+
+def test_rnn_cell_unroll_shapes():
+    assert _step_shapes(mrnn.RNNCell(16)) == (4, 3, 16)
+    assert _step_shapes(mrnn.LSTMCell(16)) == (4, 3, 16)
+    assert _step_shapes(mrnn.GRUCell(16)) == (4, 3, 16)
+
+
+def test_stacked_and_modifier_cells():
+    stack = mrnn.SequentialRNNCell()
+    stack.add(mrnn.LSTMCell(16, prefix="l0_"))
+    stack.add(mrnn.DropoutCell(0.0, prefix="d0_"))
+    stack.add(mrnn.ResidualCell(mrnn.LSTMCell(16, prefix="l1_")))
+    assert _step_shapes(stack, num_in=16) == (4, 3, 16)
+
+
+def test_bidirectional_cell():
+    bi = mrnn.BidirectionalCell(mrnn.LSTMCell(8, prefix="f_"),
+                                mrnn.LSTMCell(8, prefix="b_"))
+    assert _step_shapes(bi) == (4, 3, 16)  # concat of both directions
+
+
+def test_cell_executes_and_matches_numpy():
+    """RNNCell unroll numerics vs a hand numpy loop."""
+    cell = mrnn.RNNCell(5, activation="tanh", prefix="r_")
+    data = mx.sym.Variable("data")
+    outs, _ = cell.unroll(2, inputs=data, merge_outputs=True)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((3, 2, 4)).astype(np.float32)
+    wi = rng.standard_normal((5, 4)).astype(np.float32)
+    wh = rng.standard_normal((5, 5)).astype(np.float32)
+    bi = rng.standard_normal(5).astype(np.float32)
+    bh = rng.standard_normal(5).astype(np.float32)
+    exe = outs.bind(mx.cpu(), args={
+        "data": mx.nd.array(x), "r_i2h_weight": mx.nd.array(wi),
+        "r_i2h_bias": mx.nd.array(bi), "r_h2h_weight": mx.nd.array(wh),
+        "r_h2h_bias": mx.nd.array(bh)},
+        grad_req={n: "null" for n in outs.list_arguments()})
+    got = exe.forward()[0].asnumpy()
+    h = np.zeros((3, 5), np.float32)
+    expect = []
+    for t in range(2):
+        h = np.tanh(x[:, t] @ wi.T + bi + h @ wh.T + bh)
+        expect.append(h)
+    np.testing.assert_allclose(got, np.stack(expect, 1), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_fused_cell_unroll():
+    cell = mrnn.FusedRNNCell(12, num_layers=2, mode="lstm", prefix="f_")
+    data = mx.sym.Variable("data")
+    outs, _ = cell.unroll(4, inputs=data, merge_outputs=True)
+    _, out_shapes, _ = outs.infer_shape(data=(2, 4, 6))
+    assert out_shapes[0] == (2, 4, 12)
+
+
+def test_encode_sentences_and_bucket_iter():
+    corpus = [["a", "b", "c"], ["a", "b"], ["c", "b", "a", "a"],
+              ["b", "a"], ["a", "c", "b"], ["c", "a"]]
+    coded, vocab = mrnn.encode_sentences(corpus, start_label=1)
+    assert len(vocab) >= 4  # 3 tokens + invalid
+    it = mrnn.BucketSentenceIter(coded, batch_size=2, buckets=[2, 3, 4],
+                                 invalid_label=0)
+    seen = set()
+    for b in it:
+        assert b.data[0].shape[0] == 2
+        assert b.data[0].shape[1] == b.bucket_key
+        seen.add(b.bucket_key)
+        lab = b.label[0].asnumpy()
+        dat = b.data[0].asnumpy()
+        np.testing.assert_allclose(lab[:, :-1], dat[:, 1:])
+    assert len(seen) >= 2
+
+
+def test_bucketing_lm_trains_and_bounded_compiles():
+    """Toy LM: next-token prediction on a deterministic cyclic language;
+    perplexity must drop and each bucket compiles exactly one fused
+    train-step program (SURVEY §7 hard part (c))."""
+    rng = np.random.default_rng(0)
+    vocab_size = 8
+    sentences = []
+    for _ in range(160):
+        ln = int(rng.choice([4, 6]))
+        start = int(rng.integers(1, vocab_size))
+        # deterministic successor language: next = cur % (V-1) + 1
+        s = [start]
+        for _ in range(ln - 1):
+            s.append(s[-1] % (vocab_size - 1) + 1)
+        sentences.append(s)
+
+    it = mrnn.BucketSentenceIter(sentences, batch_size=16, buckets=[4, 6],
+                                 invalid_label=0)
+    cell = mrnn.LSTMCell(32, prefix="lm_")
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size, output_dim=16,
+                                 name="embed")
+        outputs, _ = cell.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, 32))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size, name="fc")
+        label_f = mx.sym.Reshape(label, shape=(-1,))
+        out = mx.sym.SoftmaxOutput(pred, label_f, name="softmax",
+                                   use_ignore=True, ignore_label=0,
+                                   normalization="valid")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=mx.cpu())
+    ppl = mx.metric.Perplexity(ignore_label=0)
+    mod.fit(it, num_epoch=1, eval_metric=ppl, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 5.0})
+    first_ppl = ppl.get()[1]
+    mod.fit(it, num_epoch=14, eval_metric=ppl, force_init=False,
+            force_rebind=False, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 5.0})
+    final_ppl = ppl.get()[1]
+    assert final_ppl < first_ppl, (first_ppl, final_ppl)
+    assert final_ppl < 2.0, final_ppl  # deterministic language: low ppl
+
+    # compile-count bound: one fused fwd+bwd program per bucket
+    assert set(mod._buckets) >= {4, 6}
+    for key, m in mod._buckets.items():
+        exe = m._exec
+        n_programs = len(exe._fwd_bwd_jit) + len(exe._fwd_jit)
+        assert n_programs <= 2, (key, n_programs)
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    cell = mrnn.LSTMCell(8, prefix="ck_")
+    data = mx.sym.Variable("data")
+    outs, _ = cell.unroll(2, inputs=data, merge_outputs=True)
+    arg = {"ck_i2h_weight": mx.nd.ones((32, 4))}
+    prefix = str(tmp_path / "rnnck")
+    mrnn.save_rnn_checkpoint(cell, prefix, 3, outs, arg, {})
+    sym2, arg2, aux2 = mrnn.load_rnn_checkpoint(cell, prefix, 3)
+    np.testing.assert_allclose(arg2["ck_i2h_weight"].asnumpy(), 1.0)
