@@ -15,6 +15,8 @@ from . import context
 from . import random
 from . import autograd
 from . import ops
+from . import operator  # registers the Custom op before namespaces build
+ops.BUILTIN_OPS = frozenset(ops.registry._REGISTRY)  # pre-runtime snapshot
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
@@ -46,6 +48,7 @@ from . import profiler
 from . import monitor
 from .monitor import Monitor
 from . import rnn
+from . import rtc
 from . import visualization
 from . import visualization as viz
 from . import test_utils

@@ -1,0 +1,205 @@
+"""CustomOp — operators written in Python, runnable under jit.
+
+Reference: python/mxnet/operator.py (CustomOp:~450, CustomOpProp:~520,
+register:~600) + src/operator/custom/custom.cc:50-160 (callback
+marshalling through a dedicated worker so frontend code never blocks the
+engine).
+
+TPU-native redesign: the C callback bridge becomes `jax.pure_callback` —
+the host Python forward/backward run as ordinary callbacks inside the
+compiled XLA program, with `jax.custom_vjp` wiring the user's backward.
+The op composes with jit/vmap-free graphs, the symbol executor, and
+autograd exactly like a native op.  (The reference's dedicated worker
+thread is unnecessary: XLA's callback machinery already runs host work off
+the device stream.)
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .base import MXNetError
+from .ops.registry import OpDef, register_opdef
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
+
+_PROPS = {}
+
+
+class CustomOp(object):
+    """User compute kernel (operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Honor the write/add/null request (operator.py CustomOp.assign)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError("unknown req %r" % req)
+
+
+class CustomOpProp(object):
+    """Metadata provider (operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def get_prop(op_type):
+    if op_type not in _PROPS:
+        raise MXNetError("custom op %r is not registered (known: %s)"
+                         % (op_type, sorted(_PROPS)))
+    return _PROPS[op_type]
+
+
+class _HostArray(np.ndarray):
+    """numpy view with the tiny NDArray-ish surface CustomOp kernels use
+    (asnumpy, shape, dtype, [:] assignment)."""
+
+    def asnumpy(self):
+        return np.asarray(self)
+
+
+def _host(arrs):
+    return [np.asarray(a).view(_HostArray) for a in arrs]
+
+
+class _CustomOpDef(OpDef):
+    """`Custom` registry entry: free-form string attrs + prop-driven
+    shape inference + pure_callback execution."""
+
+    def __init__(self):
+        super().__init__("Custom", self._impl, params={}, nin=1, nout=1,
+                         mode_dependent=True)
+
+    # arbitrary user kwargs ride through untouched (reference passes all
+    # Custom kwargs as strings to the prop constructor)
+    def normalize(self, attrs):
+        a = dict(attrs or {})
+        if "op_type" not in a:
+            raise MXNetError("Custom requires op_type=")
+        get_prop(a["op_type"])  # fail fast on unknown op
+        return a
+
+    def _make_prop(self, attrs):
+        kwargs = {k: v for k, v in attrs.items()
+                  if k != "op_type" and not k.startswith("_")}
+        return get_prop(attrs["op_type"])(**kwargs)
+
+    def input_names(self, attrs=None, num_inputs=None):
+        if attrs and "op_type" in attrs:
+            p = self._make_prop(attrs)
+            return list(p.list_arguments()) + list(p.list_auxiliary_states())
+        return super().input_names(attrs, num_inputs)
+
+    def num_outputs(self, attrs=None):
+        if attrs and "op_type" in attrs:
+            return len(self._make_prop(attrs).list_outputs())
+        return 1
+
+    def infer(self, attrs, in_shapes, in_dtypes):
+        prop = self._make_prop(attrs)
+        in_s, out_s, _aux = prop.infer_shape([list(s) for s in in_shapes])
+        dt = in_dtypes[0] if in_dtypes and in_dtypes[0] is not None \
+            else np.float32
+        _, out_t, _ = prop.infer_type([dt] * len(in_s))
+        return ([tuple(s) for s in in_s], [tuple(s) for s in out_s],
+                list(out_t))
+
+    def _impl(self, attrs, *inputs):
+        import jax
+        prop = self._make_prop(attrs)
+        training = bool(attrs.get("_training", False))
+        in_shapes = [tuple(x.shape) for x in inputs]
+        _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+        _, out_types, _ = prop.infer_type([x.dtype for x in inputs])
+        out_struct = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                           for s, t in zip(out_shapes, out_types))
+        op = prop.create_operator(None, in_shapes,
+                                  [x.dtype for x in inputs])
+        n_out = len(out_shapes)
+
+        def host_fwd(*arrs):
+            in_data = _host(arrs)
+            out_data = [np.zeros(tuple(s), t).view(_HostArray)
+                        for s, t in zip(out_shapes, out_types)]
+            op.forward(training, ["write"] * n_out, in_data, out_data, [])
+            return tuple(np.asarray(o) for o in out_data)
+
+        def host_bwd(*arrs):
+            k = len(inputs)
+            outs = _host(arrs[:n_out])
+            ins = _host(arrs[n_out:n_out + k])
+            grads = _host(arrs[n_out + k:])
+            in_grad = [np.zeros_like(np.asarray(x)).view(_HostArray)
+                       for x in ins]
+            op.backward(["write"] * k, grads, ins, outs, in_grad, [])
+            return tuple(np.asarray(g) for g in in_grad)
+
+        @jax.custom_vjp
+        def run(*xs):
+            out = jax.pure_callback(host_fwd, out_struct, *xs)
+            return out if len(out) > 1 else out[0]
+
+        def run_fwd(*xs):
+            out = jax.pure_callback(host_fwd, out_struct, *xs)
+            return (out if len(out) > 1 else out[0]), (xs, out)
+
+        def run_bwd(res, cts):
+            xs, outs = res
+            if not isinstance(cts, tuple):
+                cts = (cts,)
+            in_struct = tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+                              for x in xs)
+            grads = jax.pure_callback(host_bwd, in_struct,
+                                      *outs, *xs, *cts)
+            return grads
+
+        run.defvjp(run_fwd, run_bwd)
+        return run(*inputs)
+
+
+def register(op_type):
+    """Decorator registering a CustomOpProp subclass under a name
+    (operator.py register)."""
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _PROPS[op_type] = prop_cls
+        return prop_cls
+    return deco
+
+
+# one registry entry serves every custom op (custom.cc single 'Custom' op)
+register_opdef(_CustomOpDef(), aliases=["custom"])

@@ -5,7 +5,7 @@ Importing this package registers all ops.  Frontends (`mxnet_tpu.ndarray`,
 the same single-source-of-truth layout as the reference's NNVM registry
 shared by GraphExecutor and Imperative (SURVEY §1).
 """
-from .registry import (OpDef, register, register_opdef, get_op, list_ops,
+from .registry import (P, OpDef, register, register_opdef, get_op, list_ops,
                        alias_map, invoke_jax)
 
 from . import elemwise      # noqa: F401

@@ -1,0 +1,88 @@
+"""Runtime kernel registration — the RTC analog.
+
+Reference: src/common/rtc.cc:31-60 (NVRTC compile → PTX → CudaModule) and
+python/mxnet/rtc.py (CudaModule/Kernel user API): user-supplied kernel
+source compiled at runtime and launched on device.
+
+TPU-native redesign: runtime-authored kernels are **Pallas** (or plain
+jax) functions registered into the operator registry at runtime —
+`register_kernel_op` is the `CudaModule.get_kernel` analog.  Once
+registered, the kernel is a first-class op: usable from `mx.nd.<name>`,
+the symbol API, autograd (via jax or an explicit vjp pair), jit, and
+sharded executors.  `pallas_call` is re-exported for kernel authors; on
+non-TPU backends Pallas kernels run through its interpreter mode.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ops.registry import OpDef, register_opdef
+
+__all__ = ["register_kernel_op", "pallas_call", "CudaModule"]
+
+
+def pallas_call(*args, **kwargs):
+    """Re-export of jax.experimental.pallas.pallas_call (lazy import)."""
+    from jax.experimental import pallas as pl
+    return pl.pallas_call(*args, **kwargs)
+
+
+def register_kernel_op(name, fn, nin=1, nout=1, input_names=None,
+                       params=None, vjp=None, aliases=()):
+    """Register a runtime-authored kernel as an operator.
+
+    fn(*inputs, **attrs) -> output(s): a jax/Pallas function.  ``params``
+    declares typed attrs ({name: ops.P(...)}).  ``vjp``: optional
+    (fwd_res_fn, bwd_fn) pair wired through jax.custom_vjp when the kernel
+    is not jax-differentiable (e.g. hand-written Pallas backward).
+    Returns the OpDef.  Reference: rtc.py CudaModule.get_kernel → launch.
+    """
+    import jax
+
+    if vjp is not None:
+        fwd_fn, bwd_fn = vjp
+
+        def make_impl():
+            def impl(attrs, *inputs):
+                a = {k: v for k, v in attrs.items() if not k.startswith("_")}
+
+                @jax.custom_vjp
+                def run(*xs):
+                    return fn(*xs, **a)
+
+                def run_f(*xs):
+                    return fwd_fn(*xs, **a)
+
+                def run_b(res, ct):
+                    return bwd_fn(res, ct, **a)
+                run.defvjp(run_f, run_b)
+                return run(*inputs)
+            return impl
+        impl = make_impl()
+    else:
+        def impl(attrs, *inputs):
+            a = {k: v for k, v in attrs.items() if not k.startswith("_")}
+            return fn(*inputs, **a)
+
+    opdef = OpDef(name, impl, params=params or {}, nin=nin, nout=nout,
+                  input_names=input_names)
+    register_opdef(opdef, aliases=aliases)
+    # refresh the generated frontend namespaces so mx.nd.<name> /
+    # mx.sym.<name> pick up the new op immediately
+    from . import ndarray as _nd
+    from . import symbol as _sym
+    from .ndarray.register import make_op_func
+    setattr(_nd, name, make_op_func(opdef, name))
+    from .symbol.register import make_sym_func
+    setattr(_sym, name, make_sym_func(opdef, name))
+    return opdef
+
+
+class CudaModule(object):
+    """Reference API marker (python/mxnet/rtc.py:CudaModule): CUDA source
+    cannot run on TPU — point users at the Pallas path."""
+
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(
+            "CudaModule compiles CUDA C, which has no TPU backend. "
+            "Write the kernel as a Pallas/jax function and register it "
+            "with mxnet_tpu.rtc.register_kernel_op (see pallas_call).")

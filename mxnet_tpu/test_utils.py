@@ -229,8 +229,8 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-4,
     arg_names = sym.list_arguments()
     if isinstance(location, (list, tuple)):
         location = dict(zip(arg_names, location))
-    location = {k: np.asarray(v, np.float32) for k, v in location.items()}
-    aux_states = {k: np.asarray(v, np.float32)
+    location = {k: np.array(v, np.float32) for k, v in location.items()}
+    aux_states = {k: np.array(v, np.float32)
                   for k, v in (aux_states or {}).items()}
     if grad_nodes is None:
         grad_nodes = [n for n in arg_names

@@ -1,0 +1,220 @@
+"""CustomOp escape hatch, runtime kernel registration, sparse surface.
+
+Reference: python/mxnet/operator.py + src/operator/custom/custom.cc
+(CustomOp through the callback bridge), src/common/rtc.cc (runtime
+kernels), tests/python/unittest/test_sparse_operator.py (cast_storage /
+retain / sparse dot semantics).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.operator as mop
+from mxnet_tpu.ndarray import sparse as sp
+
+
+# ---------------------------------------------------------------------------
+# CustomOp
+# ---------------------------------------------------------------------------
+
+@mop.register("scaled_square")
+class ScaledSquareProp(mop.CustomOpProp):
+    def __init__(self, scale="1.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return ScaledSquare(self.scale)
+
+
+class ScaledSquare(mop.CustomOp):
+    def __init__(self, scale):
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], self.scale * x * x)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        x = in_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], 2.0 * self.scale * x * g)
+
+
+def test_custom_op_forward_eager():
+    x = mx.nd.array(np.array([[1.0, -2.0], [3.0, 0.5]], np.float32))
+    y = mx.nd.Custom(x, op_type="scaled_square", scale="3.0")
+    np.testing.assert_allclose(y.asnumpy(), 3.0 * x.asnumpy() ** 2,
+                               rtol=1e-6)
+
+
+def test_custom_op_under_jit_and_grad():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import invoke_jax
+
+    def f(x):
+        return invoke_jax("Custom", {"op_type": "scaled_square",
+                                     "scale": "2.0"}, x)[0].sum()
+
+    x = jnp.asarray(np.array([1.0, 2.0, -3.0], np.float32))
+    val = jax.jit(f)(x)  # pure_callback inside jit
+    np.testing.assert_allclose(float(val), 2.0 * (1 + 4 + 9), rtol=1e-6)
+    g = jax.grad(f)(x)   # custom_vjp through the host backward
+    np.testing.assert_allclose(np.asarray(g), 4.0 * np.asarray(x),
+                               rtol=1e-6)
+
+
+def test_custom_op_symbol_training():
+    """Custom op inside a symbol graph: Module trains through it."""
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = mx.sym.Custom(h, op_type="scaled_square", scale="0.5", name="sq")
+    net = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((32, 4)).astype(np.float32)
+    # radial task — natural for the squaring activation
+    r2 = (X ** 2).sum(axis=1)
+    Y = (r2 > np.median(r2)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    import logging
+    logging.disable(logging.CRITICAL)
+    mod.fit(it, num_epoch=40, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1})
+    acc = mx.metric.Accuracy()
+    mod.score(it, acc)
+    assert acc.get()[1] > 0.8, acc.get()
+
+
+def test_custom_op_unknown_type_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.nd.Custom(mx.nd.ones((2,)), op_type="nope")
+
+
+# ---------------------------------------------------------------------------
+# runtime kernel registration (RTC analog)
+# ---------------------------------------------------------------------------
+
+def test_register_kernel_op_and_symbol_use():
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import _REGISTRY
+
+    if "swish_rt" not in _REGISTRY:
+        mx.rtc.register_kernel_op(
+            "swish_rt",
+            lambda x, beta=1.0: x * (1 / (1 + jnp.exp(-beta * x))),
+            params={"beta": mx.ops.P(float, 1.0)})
+    x = mx.nd.array(np.linspace(-2, 2, 5).astype(np.float32))
+    y = mx.nd.swish_rt(x, beta=2.0)
+    xe = x.asnumpy()
+    np.testing.assert_allclose(y.asnumpy(), xe / (1 + np.exp(-2 * xe)),
+                               rtol=1e-5)
+    # symbol path + autodiff through the registered kernel
+    data = mx.sym.Variable("data")
+    out = mx.sym.swish_rt(data, beta=1.0)
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    check_numeric_gradient(out, {"data": xe.reshape(1, 5)},
+                           numeric_eps=1e-3, rtol=5e-2, atol=1e-2)
+
+
+def test_register_pallas_kernel():
+    """An actual pallas_call kernel registered as an op (interpret mode on
+    CPU — same code targets TPU vector units)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from mxnet_tpu.ops.registry import _REGISTRY
+
+    def add_one_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + 1.0
+
+    def add_one(x):
+        return pl.pallas_call(
+            add_one_kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=jax.devices()[0].platform != "tpu")(x)
+
+    if "pallas_add_one" not in _REGISTRY:
+        mx.rtc.register_kernel_op("pallas_add_one", add_one)
+    x = mx.nd.ones((8, 128))
+    np.testing.assert_allclose(mx.nd.pallas_add_one(x).asnumpy(), 2.0)
+
+
+def test_cuda_module_points_to_pallas():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.CudaModule("__global__ void k() {}")
+
+
+# ---------------------------------------------------------------------------
+# sparse surface
+# ---------------------------------------------------------------------------
+
+def _rand_sparse_np(shape, density, rng):
+    a = rng.standard_normal(shape).astype(np.float32)
+    a[rng.random(shape) > density] = 0.0
+    return a
+
+
+def test_cast_storage_roundtrips():
+    rng = np.random.default_rng(0)
+    a = _rand_sparse_np((6, 5), 0.4, rng)
+    dense = mx.nd.array(a)
+    for stype in ("row_sparse", "csr"):
+        s = sp.cast_storage(dense, stype)
+        assert s.stype == stype
+        np.testing.assert_allclose(s.tostype("default").asnumpy(), a)
+        back = sp.cast_storage(s, "default")
+        np.testing.assert_allclose(back.asnumpy(), a)
+
+
+def test_sparse_retain():
+    rng = np.random.default_rng(1)
+    a = np.zeros((6, 3), np.float32)
+    a[[1, 3, 5]] = rng.standard_normal((3, 3))
+    rsp = sp.cast_storage(mx.nd.array(a), "row_sparse")
+    kept = sp.retain(rsp, mx.nd.array(np.array([1, 5], np.float32)))
+    expect = np.zeros_like(a)
+    expect[[1, 5]] = a[[1, 5]]
+    np.testing.assert_allclose(kept.tostype("default").asnumpy(), expect)
+
+
+def test_sparse_dot_matches_dense():
+    rng = np.random.default_rng(2)
+    a = _rand_sparse_np((5, 7), 0.3, rng)
+    b = rng.standard_normal((7, 4)).astype(np.float32)
+    csr = sp.cast_storage(mx.nd.array(a), "csr")
+    out = sp.dot(csr, mx.nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5, atol=1e-5)
+    # transpose_a: (7,4) result from A^T (5,7)^T @ ... -> (7,5)x(5,4)? ref:
+    # dot(csr^T, dense) contracts over rows
+    b2 = rng.standard_normal((5, 4)).astype(np.float32)
+    out_t = sp.dot(csr, mx.nd.array(b2), transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(), a.T @ b2, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_rowsparse_kvstore_roundtrip():
+    """row_sparse values through the local kvstore (sparse consumer)."""
+    kv = mx.kv.create("local")
+    rng = np.random.default_rng(3)
+    a = np.zeros((8, 2), np.float32)
+    a[[0, 4, 6]] = rng.standard_normal((3, 2))
+    kv.init("w", mx.nd.zeros((8, 2)))
+    kv.push("w", mx.nd.array(a))
+    out = mx.nd.zeros((8, 2)).tostype("row_sparse")
+    kv.row_sparse_pull("w", out=out,
+                       row_ids=mx.nd.array(np.array([0, 6], np.float32)))
+    dense = out.tostype("default").asnumpy()
+    np.testing.assert_allclose(dense[[0, 6]], a[[0, 6]])
+    np.testing.assert_allclose(dense[[1, 2, 3, 4, 5, 7]], 0.0)

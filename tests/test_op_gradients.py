@@ -330,11 +330,18 @@ SKIPS = {
     "_contrib_MultiBoxPrior": "anchor generation from static shapes",
     "_contrib_MultiBoxTarget": "stop-gradient target assignment",
     "_contrib_MultiBoxDetection": "stop-gradient NMS post-processing",
+    # escape hatches
+    "Custom": "user-defined host callback; gradient is the user's "
+              "backward, canary-tested in test_custom_sparse.py",
+    "_begin_state": "zero-state constructor (zero gradient by design)",
 }
 
 
 def _canonical_names():
-    return sorted(set(op.name for op in _REGISTRY.values()))
+    import mxnet_tpu
+    builtin = mxnet_tpu.ops.BUILTIN_OPS
+    return sorted(set(op.name for name, op in _REGISTRY.items()
+                      if name in builtin))
 
 
 def test_sweep_is_exhaustive():
