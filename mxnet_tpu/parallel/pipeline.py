@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import functools
 
-__all__ = ["pipeline_shard_map", "pipeline_stage_fn"]
+__all__ = ["pipeline_shard_map", "pipeline_stage_fn",
+           "pipeline_train_step", "PipelineModule"]
 
 
 def pipeline_stage_fn(stage_fn, axis_name="pp"):
@@ -99,3 +100,166 @@ def pipeline_shard_map(stage_fn, mesh, stage_params, x, n_microbatch,
         check_vma=False)
     out = fn(stage_params, xm)
     return out.reshape((b,) + out.shape[2:])
+
+
+def pipeline_train_step(stage_fn, loss_fn, mesh, n_microbatch,
+                        axis_name="pp", optimizer=None):
+    """Build a jitted GPipe TRAINING step with full backward.
+
+    The forward pipeline (scan over ticks + ppermute hops) is a pure
+    differentiable function, so its `jax.grad` transpose IS the reverse
+    pipeline schedule — microbatch cotangents flow stage P-1 → 0 through
+    the transposed ppermutes, with the scan storing/rematerializing
+    activations.  No hand-written backward schedule exists to get out of
+    sync with the forward (the failure mode hand-rolled GPipe
+    implementations have).
+
+    stage_fn(params, x) -> y            one stage's forward
+    loss_fn(y, labels) -> scalar        applied to final-stage outputs
+    optimizer(p, g) -> p'               default: SGD(lr=0.01) leafwise
+
+    Returns step(stage_params, x, labels) -> (loss, new_stage_params)
+    where stage_params leaves carry a leading stage axis of size P.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if optimizer is None:
+        def optimizer(p, g):
+            return p - 0.01 * g
+
+    def forward_loss(stage_params, x, labels):
+        out = pipeline_shard_map(stage_fn, mesh, stage_params, x,
+                                 n_microbatch, axis_name)
+        return loss_fn(out, labels)
+
+    @jax.jit
+    def step(stage_params, x, labels):
+        loss, grads = jax.value_and_grad(forward_loss)(stage_params, x,
+                                                       labels)
+        new_params = jax.tree_util.tree_map(optimizer, stage_params, grads)
+        return loss, new_params
+
+    return step
+
+
+class PipelineModule(object):
+    """Module-style training driver for a homogeneous stage pipeline.
+
+    Takes ONE stage symbol (input Variable 'data' -> output of the SAME
+    shape, the scan-over-layers pattern used for transformer blocks) and
+    replicates it across `n_stages` pipeline stages with per-stage
+    parameters, plus a softmax cross-entropy head on the final stage.
+    The bind/init_params/init_optimizer/forward_backward/update surface
+    mirrors Module so training loops port over unchanged.
+
+    Heterogeneous stages (different activation shapes per stage) are out
+    of scope: the ppermute state has one shape by construction.
+    """
+
+    def __init__(self, stage_symbol, n_stages, n_microbatch, mesh=None,
+                 axis_name="pp", logger=None):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+        self._sym = stage_symbol
+        self._n_stages = n_stages
+        self._n_micro = n_microbatch
+        self._axis = axis_name
+        if mesh is None:
+            devs = np.array(jax.devices()[:n_stages])
+            assert devs.size == n_stages, \
+                "need %d devices for %d stages" % (n_stages, n_stages)
+            mesh = Mesh(devs, (axis_name,))
+        self._mesh = mesh
+        self._step = None
+        self._params = None
+        self._arg_names = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             **_ignored):
+        from ..executor import build_graph_fn
+        self._data_shape = tuple(data_shapes[0][1])
+        self._arg_names = self._sym.list_arguments()
+        self._aux_names = self._sym.list_auxiliary_states()
+        assert not self._aux_names, \
+            "PipelineModule stages must be aux-free (no BatchNorm stats)"
+        self._graph_fn = build_graph_fn(self._sym, self._arg_names,
+                                        self._aux_names)
+        mb = self._data_shape[0] // self._n_micro
+        shapes = {"data": (mb,) + self._data_shape[1:]}
+        arg_shapes, out_shapes, _ = self._sym.infer_shape(**shapes)
+        assert tuple(out_shapes[0]) == shapes["data"], \
+            "stage output shape %s != input %s (homogeneous stages only)" \
+            % (out_shapes[0], shapes["data"])
+        self._param_shapes = {n: tuple(s) for n, s in
+                              zip(self._arg_names, arg_shapes)
+                              if n != "data"}
+        self.binded = True
+
+    def init_params(self, initializer=None, seed=0):
+        import jax.numpy as jnp
+        import numpy as np
+        from ..initializer import Uniform
+        from .. import ndarray as nd
+        initializer = initializer or Uniform(0.07)
+        from ..initializer import InitDesc
+        params = {}
+        for name, shape in self._param_shapes.items():
+            stages = []
+            for s in range(self._n_stages):
+                arr = nd.zeros(shape)
+                initializer(InitDesc("stage%d_%s" % (s, name)), arr)
+                stages.append(arr.asnumpy())
+            params[name] = jnp.asarray(np.stack(stages))
+        self._params = params
+        self.params_initialized = True
+
+    def init_optimizer(self, learning_rate=0.01, **_ignored):
+        import jax.numpy as jnp
+        lr = learning_rate
+        data_pos = self._arg_names.index("data")
+        pnames = [n for n in self._arg_names if n != "data"]
+
+        def stage_fn(params, x):
+            args = []
+            for n in self._arg_names:
+                args.append(x if n == "data" else params[n])
+            outs, _ = self._graph_fn(tuple(args), (), None, True)
+            return outs[0]
+
+        def loss_fn(out, labels):
+            import jax
+            logits = out.reshape(out.shape[0], -1)
+            logp = jax.nn.log_softmax(logits)
+            lab = labels.astype(jnp.int32)
+            return -logp[jnp.arange(logits.shape[0]), lab].mean()
+
+        self._train_step = pipeline_train_step(
+            stage_fn, loss_fn, self._mesh, self._n_micro, self._axis,
+            optimizer=lambda p, g: p - lr * g)
+        self.optimizer_initialized = True
+        self._loss = None
+
+    def forward_backward(self, data_batch):
+        import jax.numpy as jnp
+        x = jnp.asarray(data_batch.data[0].asnumpy())
+        y = jnp.asarray(data_batch.label[0].asnumpy())
+        self._pending = (x, y)
+
+    def update(self):
+        x, y = self._pending
+        self._loss, self._params = self._train_step(self._params, x, y)
+        return self._loss
+
+    @property
+    def loss(self):
+        import numpy as np
+        return float(np.asarray(self._loss)) if self._loss is not None \
+            else None
+
+    def get_params(self):
+        return self._params

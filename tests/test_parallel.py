@@ -222,3 +222,84 @@ def test_two_bit_compression_error_feedback():
     # second push quantizes residual+g2 = [0.6, -0.1, 0.6, 0] -> [0.5,0,0.5,0]
     # store overwrites (no updater): holds the last quantized push
     np.testing.assert_allclose(out.asnumpy(), [0.5, 0.0, 0.5, 0.0])
+
+
+def test_pipeline_training_matches_unpipelined():
+    """GPipe backward: a 4-stage pipeline's loss trajectory must match the
+    same stack trained unpipelined on one device (VERDICT r2 task 9)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel import pipeline_train_step
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("pp",))
+    rng = np.random.default_rng(0)
+    D = 8
+    Ws = jnp.asarray(rng.standard_normal((4, D, D)).astype(np.float32) * 0.3)
+    X = jnp.asarray(rng.standard_normal((16, D)).astype(np.float32))
+    Y = jnp.asarray((np.arange(16) % D).astype(np.float32))
+
+    def stage(w, x):
+        return jnp.tanh(x @ w)
+
+    def loss_fn(out, labels):
+        logp = jax.nn.log_softmax(out)
+        return -logp[jnp.arange(out.shape[0]),
+                     labels.astype(jnp.int32)].mean()
+
+    step = pipeline_train_step(stage, loss_fn, mesh, n_microbatch=4,
+                               optimizer=lambda p, g: p - 0.5 * g)
+    params = Ws
+    piped_losses = []
+    for _ in range(5):
+        loss, params = step(params, X, Y)
+        piped_losses.append(float(loss))
+
+    # unpipelined reference: same math, plain composition + grad
+    def forward_loss(ws, x, labels):
+        h = x
+        for i in range(4):
+            h = stage(ws[i], h)
+        return loss_fn(h, labels)
+
+    ref = Ws
+    ref_losses = []
+    gfn = jax.jit(jax.value_and_grad(forward_loss))
+    for _ in range(5):
+        loss, g = gfn(ref, X, Y)
+        ref_losses.append(float(loss))
+        ref = ref - 0.5 * g
+
+    np.testing.assert_allclose(piped_losses, ref_losses, rtol=1e-4,
+                               atol=1e-5)
+    assert piped_losses[-1] < piped_losses[0]  # actually learning
+    np.testing.assert_allclose(np.asarray(params), np.asarray(ref),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_pipeline_module_trains():
+    """PipelineModule: symbol-defined stage, Module-style driving."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import PipelineModule
+    from mxnet_tpu.io import DataBatch
+
+    stage = mx.sym.Activation(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                              no_bias=True, name="w"), act_type="tanh")
+    pm = PipelineModule(stage, n_stages=4, n_microbatch=4)
+    pm.bind(data_shapes=[("data", (16, 8))])
+    # wide init: a deep tanh chain with near-zero weights has vanishing
+    # gradients, which would test patience rather than the pipeline
+    pm.init_params(initializer=mx.init.Uniform(0.6))
+    pm.init_optimizer(learning_rate=1.0)
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((16, 8)).astype(np.float32)
+    Y = (np.arange(16) % 8).astype(np.float32)
+    losses = []
+    for _ in range(25):
+        pm.forward_backward(DataBatch(data=[mx.nd.array(X)],
+                                      label=[mx.nd.array(Y)]))
+        pm.update()
+        losses.append(pm.loss)
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
