@@ -217,13 +217,27 @@ def create(name="local"):
     """Factory (kvstore.cc:38-76): local | device | nccl | dist_sync |
     dist_device_sync | dist_async.  On TPU, device==local (sharded-mesh
     reduction happens inside the compiled step), nccl==device, and dist_*
-    map to the multi-host collective store."""
+    map to the multi-host collective store.
+
+    ``dist_async`` DECISION (SURVEY §7 hard part (d)): collectives have no
+    straggler-tolerant async analog — every worker participates in each
+    reduction.  Requesting dist_async therefore runs SYNCHRONOUSLY and
+    warns once; workloads depending on the reference's stale-gradient PS
+    semantics (kvstore_dist_server.h:266) should re-tune hyperparameters
+    for sync updates rather than expect async behavior.
+    """
     if not isinstance(name, string_types):
         raise TypeError("name must be a string")
     if name in ("local", "local_update_cpu", "local_allreduce_cpu",
                 "local_allreduce_device", "device", "nccl"):
         return KVStore(name)
     if name.startswith("dist"):
+        if "async" in name:
+            import warnings
+            warnings.warn(
+                "dist_async runs with synchronous collective semantics on "
+                "TPU (no parameter-server stragglers); see "
+                "mxnet_tpu.kvstore.create docstring", stacklevel=2)
         from .kvstore_dist import KVStoreDist
         return KVStoreDist(name)
     raise MXNetError("unknown kvstore type %r" % name)

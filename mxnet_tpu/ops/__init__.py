@@ -17,3 +17,4 @@ from . import random_ops    # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn           # noqa: F401
 from . import contrib_det   # noqa: F401
+from . import contrib_misc  # noqa: F401

@@ -1,0 +1,191 @@
+"""Contrib long-tail ops: CTCLoss, fft/ifft, quantize/dequantize,
+count_sketch.
+
+Reference: src/operator/contrib/ctc_loss.cc:127 (warp-ctc semantics,
+blank_label first/last, 0/-1 label padding), fft-inl.h (real input →
+interleaved re/im output), quantize.cc:31 / dequantize.cc:31 (affine int8
+quantization against a [min, max] range), count_sketch-inl.h (signed hash
+projection).
+
+TPU-native notes: CTC is the textbook log-alpha recursion as a
+`lax.scan` over time — jax autodiff through the scan yields exactly the
+CTC gradient (no hand-written backward to maintain); fft lowers to XLA's
+native FFT; quantize/dequantize are elementwise affine maps that fuse
+into their neighbors.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, P
+
+_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# CTC loss
+# ---------------------------------------------------------------------------
+
+def _ctc_single(logp, labels, in_len, lab_len, blank):
+    """CTC negative log-likelihood for one sequence.
+
+    logp: (T, C) log-probabilities; labels: (L,) int ids (already
+    blank-free); in_len, lab_len: actual lengths.
+    """
+    T, C = logp.shape
+    L = labels.shape[0]
+    S = 2 * L + 1
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((S,), blank, jnp.int32)
+    ext = ext.at[1::2].set(labels.astype(jnp.int32))
+    valid_s = jnp.arange(S) < (2 * lab_len + 1)
+
+    # allowed skip transition s-2 -> s: ext[s] != blank and != ext[s-2]
+    ext_prev2 = jnp.concatenate([jnp.full((2,), -1, jnp.int32), ext[:-2]])
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    alpha0 = jnp.full((S,), _NEG)
+    alpha0 = alpha0.at[0].set(logp[0, ext[0]])
+    alpha0 = alpha0.at[1].set(jnp.where(lab_len > 0, logp[0, ext[1]], _NEG))
+
+    def step(alpha, t):
+        stay = alpha
+        prev1 = jnp.concatenate([jnp.full((1,), _NEG), alpha[:-1]])
+        prev2 = jnp.concatenate([jnp.full((2,), _NEG), alpha[:-2]])
+        prev2 = jnp.where(can_skip, prev2, _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        new = merged + logp[t, ext]
+        new = jnp.where(valid_s, new, _NEG)
+        # sequences shorter than T freeze after their last frame
+        return jnp.where(t < in_len, new, alpha), None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    end = 2 * lab_len  # final blank position
+    last = alpha[end]
+    second = jnp.where(lab_len > 0, alpha[jnp.maximum(end - 1, 0)], _NEG)
+    return -jnp.logaddexp(last, second)
+
+
+@register("_contrib_CTCLoss", aliases=["contrib_CTCLoss", "CTCLoss",
+                                       "ctc_loss"],
+          nin=2, input_names=["data", "label"],
+          params={"use_data_lengths": P(bool, False),
+                  "use_label_lengths": P(bool, False),
+                  "blank_label": P(str, "first",
+                                   choices=["first", "last"])})
+def ctc_loss(attrs, data, label):
+    """Connectionist temporal classification loss (ctc_loss.cc:127).
+
+    data: (T, B, C) unnormalized activations (softmax applied inside,
+    like the reference's warp-ctc); label: (B, L) padded with 0
+    (blank_label='first') or -1 ('last').  Output: (B,) losses.
+    """
+    T, B, C = data.shape
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=2)
+    lab = label.astype(jnp.int32)
+    if attrs["blank_label"] == "first":
+        blank = 0
+        pad = 0
+        ids = lab  # labels are 1-based; 0 is padding AND blank id
+        lab_valid = lab != pad
+    else:
+        blank = C - 1
+        pad = -1
+        ids = lab
+        lab_valid = lab != pad
+    lab_len = lab_valid.sum(axis=1)
+    in_len = jnp.full((B,), T, jnp.int32)
+    # compact labels to the front (padding may be interleaved only at the
+    # tail per the reference contract, so a stable sort by validity keeps
+    # order)
+    order = jnp.argsort(~lab_valid, axis=1)  # jax argsort is stable
+    ids = jnp.take_along_axis(ids, order, axis=1)
+
+    f = lambda lp, l, il, ll: _ctc_single(lp, l, il, ll, blank)
+    losses = jax.vmap(f)(jnp.moveaxis(logp, 1, 0), ids, in_len, lab_len)
+    return losses.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# fft / ifft
+# ---------------------------------------------------------------------------
+
+@register("_contrib_fft", aliases=["contrib_fft"],
+          params={"compute_size": P(int, 128)})
+def contrib_fft(attrs, data):
+    """Real input (..., d) -> interleaved re/im (..., 2d) (fft-inl.h)."""
+    c = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([c.real, c.imag], axis=-1).reshape(
+        data.shape[:-1] + (2 * data.shape[-1],))
+    return out.astype(data.dtype)
+
+
+@register("_contrib_ifft", aliases=["contrib_ifft"],
+          params={"compute_size": P(int, 128)})
+def contrib_ifft(attrs, data):
+    """Interleaved re/im (..., 2d) -> real (..., d); the reference does NOT
+    normalize by d (fft-inl.h backward pairing), so neither do we."""
+    d = data.shape[-1] // 2
+    ri = data.astype(jnp.float32).reshape(data.shape[:-1] + (d, 2))
+    c = lax.complex(ri[..., 0], ri[..., 1])
+    out = jnp.fft.ifft(c, axis=-1).real * d
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize
+# ---------------------------------------------------------------------------
+
+@register("_contrib_quantize", aliases=["contrib_quantize"],
+          nin=3, nout=3, input_names=["data", "min_range", "max_range"],
+          params={"out_type": P(str, "uint8", choices=["uint8", "int8"])})
+def contrib_quantize(attrs, data, min_range, max_range):
+    """Affine quantization to (u)int8 against [min, max] (quantize.cc:31).
+    Returns (quantized, min_range, max_range)."""
+    if attrs["out_type"] == "uint8":
+        qmin, qmax, dt = 0.0, 255.0, jnp.uint8
+    else:
+        qmin, qmax, dt = -127.0, 127.0, jnp.int8
+    lo = min_range.reshape(()).astype(jnp.float32)
+    hi = max_range.reshape(()).astype(jnp.float32)
+    scale = (qmax - qmin) / jnp.maximum(hi - lo, 1e-20)
+    q = jnp.clip(jnp.round((data.astype(jnp.float32) - lo) * scale + qmin),
+                 qmin, qmax)
+    return q.astype(dt), min_range, max_range
+
+
+@register("_contrib_dequantize", aliases=["contrib_dequantize"],
+          nin=3, input_names=["data", "min_range", "max_range"],
+          params={"out_type": P(str, "float32")})
+def contrib_dequantize(attrs, data, min_range, max_range):
+    """Inverse of _contrib_quantize (dequantize.cc:31)."""
+    if data.dtype == jnp.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    lo = min_range.reshape(()).astype(jnp.float32)
+    hi = max_range.reshape(()).astype(jnp.float32)
+    scale = jnp.maximum(hi - lo, 1e-20) / (qmax - qmin)
+    return ((data.astype(jnp.float32) - qmin) * scale + lo) \
+        .astype(np.dtype(attrs["out_type"]))
+
+
+# ---------------------------------------------------------------------------
+# count sketch
+# ---------------------------------------------------------------------------
+
+@register("_contrib_count_sketch", aliases=["contrib_count_sketch"],
+          nin=3, input_names=["data", "h", "s"],
+          params={"out_dim": P(int),
+                  "processing_batch_size": P(int, 32)})
+def contrib_count_sketch(attrs, data, h, s):
+    """Count-sketch projection (count_sketch-inl.h): out[:, h[i]] +=
+    s[i] * data[:, i].  h: (1, in_dim) hash buckets, s: (1, in_dim) signs."""
+    out_dim = attrs["out_dim"]
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(jnp.float32)
+    contrib = data.astype(jnp.float32) * sign[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), jnp.float32)
+    out = out.at[:, idx].add(contrib)
+    return out.astype(data.dtype)

@@ -1,0 +1,115 @@
+"""Contrib long-tail op tests: CTC (vs brute-force path enumeration),
+fft/ifft roundtrip, quantize/dequantize, count_sketch."""
+import itertools
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import invoke_jax
+import jax.numpy as jnp
+
+
+def _ctc_brute(logp, labels, blank=0):
+    """Sum over all alignments by enumeration (tiny T/C only)."""
+    T, C = logp.shape
+    p_total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: remove repeats then blanks
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev:
+                collapsed.append(s)
+            prev = s
+        collapsed = [s for s in collapsed if s != blank]
+        if collapsed == list(labels):
+            p_total += np.exp(sum(logp[t, path[t]] for t in range(T)))
+    return -np.log(p_total)
+
+
+def test_ctc_loss_matches_bruteforce():
+    rng = np.random.default_rng(0)
+    T, B, C = 4, 2, 3
+    acts = rng.standard_normal((T, B, C)).astype(np.float32)
+    # labels 1-based (blank_label='first'), padded with 0
+    label = np.array([[1, 2], [2, 0]], np.float32)
+    out = np.asarray(invoke_jax("_contrib_CTCLoss", {},
+                                jnp.asarray(acts), jnp.asarray(label))[0])
+    logp = np.log(np.exp(acts) / np.exp(acts).sum(2, keepdims=True)
+                  + 1e-30)
+    for b, lab in enumerate([[1, 2], [2]]):
+        expect = _ctc_brute(logp[:, b], lab, blank=0)
+        np.testing.assert_allclose(out[b], expect, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_blank_last():
+    rng = np.random.default_rng(1)
+    T, B, C = 3, 1, 3
+    acts = rng.standard_normal((T, B, C)).astype(np.float32)
+    label = np.array([[0, -1]], np.float32)  # single label id 0, padded -1
+    out = np.asarray(invoke_jax("_contrib_CTCLoss", {"blank_label": "last"},
+                                jnp.asarray(acts), jnp.asarray(label))[0])
+    logp = np.log(np.exp(acts) / np.exp(acts).sum(2, keepdims=True))
+    expect = _ctc_brute(logp[:, 0], [0], blank=C - 1)
+    np.testing.assert_allclose(out[0], expect, rtol=1e-4)
+
+
+def test_ctc_loss_differentiable():
+    import jax
+    rng = np.random.default_rng(2)
+    acts = rng.standard_normal((5, 1, 4)).astype(np.float32)
+    label = np.array([[1, 3]], np.float32)
+
+    def f(a):
+        return invoke_jax("_contrib_CTCLoss", {}, a,
+                          jnp.asarray(label))[0].sum()
+    g = np.asarray(jax.grad(f)(jnp.asarray(acts)))
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    f = np.asarray(invoke_jax("_contrib_fft", {}, jnp.asarray(x))[0])
+    assert f.shape == (4, 16)
+    # interleaved re/im vs numpy fft
+    c = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f[:, 0::2], c.real, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(f[:, 1::2], c.imag, rtol=1e-4, atol=1e-4)
+    # reference pairing: ifft(fft(x)) == d * x ... our ifft multiplies by d
+    # to mirror the unnormalized reference; roundtrip recovers d*x/d = x*d/d
+    back = np.asarray(invoke_jax("_contrib_ifft", {}, jnp.asarray(f))[0])
+    np.testing.assert_allclose(back, x * 8, rtol=1e-4, atol=1e-4)
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.default_rng(4)
+    x = rng.uniform(-3, 5, (6, 6)).astype(np.float32)
+    lo = jnp.asarray(np.float32(-3)); hi = jnp.asarray(np.float32(5))
+    q, qlo, qhi = invoke_jax("_contrib_quantize", {"out_type": "uint8"},
+                             jnp.asarray(x), lo, hi)
+    q = np.asarray(q)
+    assert q.dtype == np.uint8
+    deq = np.asarray(invoke_jax("_contrib_dequantize", {},
+                                jnp.asarray(q), lo, hi)[0])
+    step = 8.0 / 255
+    assert np.abs(deq - x).max() <= step * 0.51 + 1e-6
+
+
+def test_quantize_int8():
+    x = np.array([[-1.0, 0.0, 1.0]], np.float32)
+    q, _, _ = invoke_jax("_contrib_quantize", {"out_type": "int8"},
+                         jnp.asarray(x), jnp.asarray(np.float32(-1)),
+                         jnp.asarray(np.float32(1)))
+    np.testing.assert_array_equal(np.asarray(q)[0], [-127, 0, 127])
+
+
+def test_count_sketch():
+    x = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+    h = np.array([[0, 1, 0, 2]], np.float32)
+    s = np.array([[1, -1, 1, 1]], np.float32)
+    out = np.asarray(invoke_jax("_contrib_count_sketch", {"out_dim": 3},
+                                jnp.asarray(x), jnp.asarray(h),
+                                jnp.asarray(s))[0])
+    np.testing.assert_allclose(out[0], [1 + 3, -2, 4])

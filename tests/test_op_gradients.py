@@ -259,6 +259,18 @@ SPECS["SpatialTransformer"] = S(
         [[1, 0, 0, 0, 1, 0]], dtype=np.float64)],
     {"transform_type": "affine", "sampler_type": "bilinear",
      "target_shape": (4, 4)}, rtol=1e-2, atol=1e-3)
+# CTC/fft compute in f32 internally — coarser steps/tolerances like BN
+SPECS["_contrib_CTCLoss"] = S(
+    lambda: [_u(4, 2, 3), np.array([[1., 2.], [2., 0.]])], wrt=[0],
+    eps=3e-3, rtol=5e-2, atol=5e-3)
+SPECS["_contrib_fft"] = S(lambda: [_u(2, 4)], eps=3e-3, rtol=3e-2,
+                          atol=3e-3)
+SPECS["_contrib_ifft"] = S(lambda: [_u(2, 8)], eps=3e-3, rtol=3e-2,
+                           atol=3e-3)
+SPECS["_contrib_count_sketch"] = S(
+    lambda: [_u(2, 4), np.array([[0., 1., 0., 2.]]),
+             np.array([[1., -1., 1., 1.]])],
+    {"out_dim": 3}, wrt=[0], eps=3e-3, rtol=3e-2, atol=3e-3)
 SPECS["ROIPooling"] = S(
     lambda: [_distinct(1, 2, 5, 5),
              np.array([[0, 0, 0, 4, 4], [0, 1, 1, 3, 3]], np.float64)],
@@ -334,6 +346,11 @@ SKIPS = {
     "Custom": "user-defined host callback; gradient is the user's "
               "backward, canary-tested in test_custom_sparse.py",
     "_begin_state": "zero-state constructor (zero gradient by design)",
+    # quantization: discrete outputs (straight-through estimators are a
+    # user choice, not an op contract)
+    "_contrib_quantize": "integer-quantized output",
+    "_contrib_dequantize": "inverse of a discrete map (zero a.e. grad "
+                           "wrt ranges; int data input)",
 }
 
 
