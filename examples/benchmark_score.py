@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Inference throughput sweep over the model zoo.
+
+Reference: example/image-classification/benchmark_score.py (forward-only
+img/s for each network at several batch sizes).
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def score(network, batch_size, num_batches, image):
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import get_resnet_symbol, get_lenet
+
+    if network.startswith("resnet"):
+        depth = int(network.split("-")[1])
+        shape = (3, image, image)
+        net = get_resnet_symbol(num_classes=1000, num_layers=depth,
+                                image_shape=shape, layout="NHWC")
+        dshape = (batch_size, image, image, 3)
+    elif network == "lenet":
+        net = get_lenet()
+        dshape = (batch_size, 1, 28, 28)
+    else:
+        raise ValueError(network)
+
+    rng = np.random.default_rng(0)
+    arg_shapes, _, aux_shapes = net.infer_shape(
+        data=dshape, softmax_label=(batch_size,))
+    args = {n: mx.nd.array(rng.uniform(-0.05, 0.05, s).astype(np.float32))
+            for n, s in zip(net.list_arguments(), arg_shapes)}
+    aux = {n: mx.nd.array(np.zeros(s, np.float32) if "mean" in n
+                          else np.ones(s, np.float32))
+           for n, s in zip(net.list_auxiliary_states(), aux_shapes)}
+    exe = net.bind(mx.gpu() if mx.num_gpus() else mx.cpu(), args=args,
+                   aux_states=aux or None,
+                   grad_req={n: "null" for n in net.list_arguments()})
+    out = exe.forward(is_train=False)[0]
+    out.wait_to_read()  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(num_batches):
+        out = exe.forward(is_train=False)[0]
+    out.wait_to_read()
+    dt = time.perf_counter() - t0
+    return batch_size * num_batches / dt
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--networks", default="resnet-50",
+                   help="comma list: resnet-18/-50/-152, lenet")
+    p.add_argument("--batch-sizes", default="1,32,128")
+    p.add_argument("--image", type=int, default=224)
+    p.add_argument("--num-batches", type=int, default=10)
+    args = p.parse_args()
+    for net in args.networks.split(","):
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            ips = score(net, b, args.num_batches, args.image)
+            print("network: %-12s batch %4d  %10.1f images/sec"
+                  % (net, b, ips))
+
+
+if __name__ == "__main__":
+    main()

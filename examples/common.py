@@ -1,0 +1,73 @@
+"""Shared CLI plumbing for the example suite.
+
+Reference: example/image-classification/common/fit.py (arg groups,
+kvstore creation, lr scheduling, checkpoint/resume wiring) — condensed to
+the knobs that exist TPU-side.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def add_fit_args(parser):
+    parser.add_argument("--network", default="resnet-50")
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=1e-4)
+    parser.add_argument("--kv-store", default="local",
+                        help="local | device | dist_sync | dist_async")
+    parser.add_argument("--optimizer", default="sgd")
+    parser.add_argument("--lr-factor", type=float, default=0.1)
+    parser.add_argument("--lr-step-epochs", default="",
+                        help="e.g. 30,60 (epochs at which lr decays)")
+    parser.add_argument("--model-prefix", default=None,
+                        help="checkpoint path prefix")
+    parser.add_argument("--load-epoch", type=int, default=None,
+                        help="resume from this checkpoint epoch")
+    parser.add_argument("--disp-batches", type=int, default=20)
+    parser.add_argument("--dtype", default="float32")
+    return parser
+
+
+def fit(args, module, train_iter, val_iter=None, batches_per_epoch=None):
+    """The common/fit.py:113 loop: kvstore, lr schedule, checkpointing."""
+    import mxnet_tpu as mx
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(message)s")
+    kv = args.kv_store
+    lr_sched = None
+    if args.lr_step_epochs and batches_per_epoch:
+        steps = [int(e) * batches_per_epoch
+                 for e in args.lr_step_epochs.split(",")]
+        lr_sched = mx.lr_scheduler.MultiFactorScheduler(
+            step=steps, factor=args.lr_factor)
+    opt_params = {"learning_rate": args.lr, "wd": args.wd}
+    if args.optimizer == "sgd":
+        opt_params["momentum"] = args.momentum
+    if lr_sched is not None:
+        opt_params["lr_scheduler"] = lr_sched
+
+    arg_params = aux_params = None
+    begin = 0
+    if args.load_epoch is not None and args.model_prefix:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        begin = args.load_epoch
+    cb = []
+    if args.model_prefix:
+        cb.append(mx.callback.do_checkpoint(args.model_prefix))
+    module.fit(train_iter, eval_data=val_iter,
+               num_epoch=args.num_epochs, begin_epoch=begin,
+               arg_params=arg_params, aux_params=aux_params,
+               kvstore=kv, optimizer=args.optimizer,
+               optimizer_params=opt_params,
+               initializer=__import__("mxnet_tpu").init.Xavier(),
+               batch_end_callback=mx.callback.Speedometer(
+                   args.batch_size, args.disp_batches),
+               epoch_end_callback=cb or None)
+    return module

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Bucketed LSTM language model (PTB-style word-level LM).
+
+Reference: example/rnn/lstm_bucketing.py — reads a whitespace-tokenized
+corpus (one sentence per line, e.g. PTB's ptb.train.txt), buckets by
+length, trains an LSTM LM through BucketingModule.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def read_corpus(path, vocab=None):
+    from mxnet_tpu.rnn import encode_sentences
+    with open(path) as f:
+        sentences = [line.split() + ["<eos>"] for line in f
+                     if line.strip()]
+    return encode_sentences(sentences, vocab=vocab, invalid_label=0,
+                            start_label=1)
+
+
+def synthetic_corpus(n=2000, vocab_size=64, seed=0):
+    """Zero-egress stand-in: a Markov-chain language."""
+    rng = np.random.default_rng(seed)
+    trans = rng.dirichlet(np.ones(vocab_size - 1) * 0.1,
+                          size=vocab_size - 1)
+    out = []
+    for _ in range(n):
+        ln = int(rng.integers(6, 30))
+        s = [int(rng.integers(1, vocab_size))]
+        for _ in range(ln - 1):
+            s.append(int(rng.choice(vocab_size - 1,
+                                    p=trans[s[-1] - 1])) + 1)
+        out.append(s)
+    return out, {i: i for i in range(vocab_size)}
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train-data", default=None,
+                   help="tokenized text (e.g. ptb.train.txt); synthetic "
+                        "corpus when absent")
+    p.add_argument("--num-hidden", type=int, default=200)
+    p.add_argument("--num-embed", type=int, default=200)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-epochs", type=int, default=10)
+    p.add_argument("--lr", type=float, default=1.0)
+    p.add_argument("--buckets", default="10,20,30,40")
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import rnn as mrnn
+
+    if args.train_data:
+        sentences, vocab = read_corpus(args.train_data)
+        vocab_size = max(max(s) for s in sentences) + 1
+    else:
+        sentences, vocab = synthetic_corpus()
+        vocab_size = 64
+    buckets = [int(b) for b in args.buckets.split(",")]
+    it = mrnn.BucketSentenceIter(sentences, args.batch_size,
+                                 buckets=buckets, invalid_label=0)
+
+    stack = mrnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mrnn.LSTMCell(args.num_hidden, prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        outputs, _ = stack.unroll(seq_len, inputs=embed,
+                                  merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
+                                     name="pred")
+        label_f = mx.sym.Reshape(label, shape=(-1,))
+        out = mx.sym.SoftmaxOutput(pred, label_f, name="softmax",
+                                   use_ignore=True, ignore_label=0,
+                                   normalization="valid")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=mx.gpu())
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    mod.fit(it, num_epoch=args.num_epochs,
+            eval_metric=mx.metric.Perplexity(ignore_label=0),
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": args.lr},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+
+
+if __name__ == "__main__":
+    main()

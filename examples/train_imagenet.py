@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""ImageNet-style training from RecordIO files.
+
+Reference: example/image-classification/train_imagenet.py (ImageRecordIter
+data config + fit).  Pack your dataset first:
+
+    python tools/im2rec.py data/train /path/to/imagenet --list --recursive
+    python tools/im2rec.py data/train /path/to/imagenet --resize 256 \\
+        --num-thread 16
+    # fastest input path on few-core hosts (raw pixels, no JPEG decode):
+    # add `--encoding raw --resize 256 --center-crop`
+
+Run (single chip):
+    python examples/train_imagenet.py --data-train data/train.rec \\
+        --network resnet-50 --batch-size 256
+Multi-host (per worker, under tools/launch.py):
+    python tools/launch.py -n 8 --launcher ssh -H hosts \\
+        python examples/train_imagenet.py --kv-store dist_sync ...
+"""
+import argparse
+
+from common import add_fit_args, fit
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    add_fit_args(p)
+    p.add_argument("--data-train", required=True)
+    p.add_argument("--data-val", default=None)
+    p.add_argument("--image-shape", default="3,224,224")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--num-examples", type=int, default=1281167)
+    p.add_argument("--data-nthreads", type=int, default=8)
+    p.add_argument("--raw-shape", default=None,
+                   help="H,W,C when the rec holds raw pixels "
+                        "(im2rec --encoding raw)")
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import get_resnet_symbol
+
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    depth = int(args.network.split("-")[1]) if "-" in args.network else 50
+    # NHWC: the TPU-preferred layout end to end (conv + input pipeline)
+    net = get_resnet_symbol(num_classes=args.num_classes, num_layers=depth,
+                            image_shape=shape, layout="NHWC")
+
+    common_iter = dict(
+        data_shape=shape, batch_size=args.batch_size,
+        preprocess_threads=args.data_nthreads, layout="NHWC",
+        mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        std_r=58.393, std_g=57.12, std_b=57.375)
+    if args.raw_shape:
+        common_iter["raw_shape"] = tuple(
+            int(x) for x in args.raw_shape.split(","))
+    # dist sharding: each worker reads its slice of the record file
+    kv = mx.kv.create(args.kv_store) if "dist" in args.kv_store else None
+    if kv is not None:
+        common_iter.update(num_parts=kv.num_workers, part_index=kv.rank)
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, shuffle=True,
+        rand_crop=True, rand_mirror=True, **common_iter)
+    val = None
+    if args.data_val:
+        val = mx.io.ImageRecordIter(path_imgrec=args.data_val,
+                                    **common_iter)
+
+    mod = mx.mod.Module(net, context=mx.gpu())
+    fit(args, mod, train, val,
+        batches_per_epoch=args.num_examples // args.batch_size)
+
+
+if __name__ == "__main__":
+    main()

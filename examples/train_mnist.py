@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""MNIST training (example/image-classification/train_mnist.py).
+
+Uses the MNISTIter over idx-format files when --data-dir holds them, else
+a synthetic stand-in so the example runs anywhere (zero egress).
+"""
+import argparse
+import os
+
+import numpy as np
+
+from common import add_fit_args, fit
+
+
+def get_iters(args):
+    import mxnet_tpu as mx
+    files = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+             "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+    if args.data_dir and all(os.path.exists(os.path.join(args.data_dir, f))
+                             for f in files):
+        train = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, files[0]),
+            label=os.path.join(args.data_dir, files[1]),
+            batch_size=args.batch_size, shuffle=True, flat=False)
+        val = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, files[2]),
+            label=os.path.join(args.data_dir, files[3]),
+            batch_size=args.batch_size, flat=False)
+        return train, val
+    print("no MNIST files under %r — using a synthetic stand-in"
+          % args.data_dir)
+    rng = np.random.default_rng(0)
+    protos = [np.kron(rng.random((7, 7)).astype(np.float32),
+                      np.ones((4, 4), np.float32)) for _ in range(10)]
+    X, Y = [], []
+    for k, pr in enumerate(protos):
+        for _ in range(200):
+            X.append(np.clip(pr + rng.normal(0, 0.25, (28, 28)), 0, 1))
+            Y.append(k)
+    X = np.stack(X)[:, None].astype(np.float32) - 0.5
+    Y = np.asarray(Y, np.float32)
+    order = rng.permutation(len(Y))
+    X, Y = X[order], Y[order]
+    n = int(len(Y) * 0.9)
+    train = mx.io.NDArrayIter(X[:n], Y[:n], args.batch_size, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(X[n:], Y[n:], args.batch_size,
+                            label_name="softmax_label")
+    return train, val
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    add_fit_args(p)
+    p.add_argument("--data-dir", default="data/mnist")
+    p.set_defaults(network="lenet", num_epochs=5, lr=0.05, batch_size=64)
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import get_lenet, get_mlp
+    net = get_mlp() if args.network == "mlp" else get_lenet()
+    train, val = get_iters(args)
+    mod = mx.mod.Module(net, context=mx.gpu())
+    fit(args, mod, train, val)
+    acc = mx.metric.Accuracy()
+    val.reset()
+    mod.score(val, acc)
+    print("final validation %s: %.4f" % acc.get())
+
+
+if __name__ == "__main__":
+    main()

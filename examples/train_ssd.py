@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""SSD detection training from detection RecordIO files.
+
+Reference: example/ssd/train.py (+ dataset packing via the detection
+label convention — see mxnet_tpu.image.detection.pack_det_label).
+"""
+import argparse
+
+from common import add_fit_args, fit
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    add_fit_args(p)
+    p.add_argument("--data-train", required=True)
+    p.add_argument("--data-shape", type=int, default=300)
+    p.add_argument("--num-classes", type=int, default=20)
+    p.add_argument("--label-pad", type=int, default=24)
+    p.set_defaults(network="vgg16_reduced", lr=0.004, batch_size=32)
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import get_ssd_symbol
+    from mxnet_tpu.image.detection import ImageDetRecordIterImpl
+
+    net = get_ssd_symbol(args.network, num_classes=args.num_classes,
+                         mode="train")
+    train = ImageDetRecordIterImpl(
+        path_imgrec=args.data_train,
+        data_shape=(3, args.data_shape, args.data_shape),
+        batch_size=args.batch_size, label_pad_count=args.label_pad,
+        rand_mirror=True, rand_crop_prob=0.5, shuffle=True,
+        mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        data_name="data", label_name="label")
+    mod = mx.mod.Module(net, context=mx.gpu(), data_names=("data",),
+                        label_names=("label",))
+    fit(args, mod, train)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,8 @@ from . import monitor
 from .monitor import Monitor
 from . import rnn
 from . import rtc
+from . import predict
+from .predict import Predictor
 from . import visualization
 from . import visualization as viz
 from . import test_utils

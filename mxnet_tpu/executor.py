@@ -293,9 +293,16 @@ class Executor:
             if isinstance(v, NDArray):
                 v = v._data
             if sh is None:
-                self.arg_dict[k]._data = v if hasattr(v, "sharding") \
-                    else jax.device_put(_np.asarray(v),
-                                        self._ctx.jax_device())
+                dev = self._ctx.jax_device()
+                if hasattr(v, "sharding"):
+                    # host-pipeline batches arrive on the CPU backend; move
+                    # them onto the executor's device when they differ
+                    if v.sharding.device_set != {dev}:
+                        v = jax.device_put(v, dev)
+                    self.arg_dict[k]._data = v
+                else:
+                    self.arg_dict[k]._data = jax.device_put(
+                        _np.asarray(v), dev)
             else:
                 # batch feed: local slice on multi-process meshes
                 self.arg_dict[k]._data = self._place_local(v, sh)

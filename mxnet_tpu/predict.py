@@ -1,0 +1,125 @@
+"""Predictor — the standalone inference runtime.
+
+Reference: include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc
+(MXPredCreate:78 from symbol JSON + param blob, MXPredSetInput:144,
+MXPredForward:153, MXPredGetOutput:179, PartialOut variant) — the minimal
+ABI used by the amalgamation/mobile builds: no autograd, no kvstore, no
+training state.
+
+TPU-native: a Predictor is one inference-only compiled program (donated
+buffers, no gradient graph ever traced) built from the same checkpoint
+format Module writes (`prefix-symbol.json` + `prefix-%04d.params`).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import cpu
+from . import ndarray as nd
+from . import symbol as sym
+
+__all__ = ["Predictor", "load_checkpoint_predictor"]
+
+
+class Predictor(object):
+    """Forward-only executor over a frozen graph (c_predict_api.cc)."""
+
+    def __init__(self, symbol, arg_params, aux_params, data_shapes,
+                 ctx=None, output_names=None):
+        if isinstance(symbol, (str, bytes)):
+            symbol = sym.load_json(symbol)
+        if output_names is not None:
+            # PartialOut: expose chosen internal outputs
+            internals = symbol.get_internals()
+            symbol = sym.Group([internals[n] for n in output_names])
+        self._sym = symbol
+        self._ctx = ctx or cpu()
+        data_shapes = dict(data_shapes)
+        self._data_names = list(data_shapes)
+
+        arg_names = symbol.list_arguments()
+        missing = [n for n in arg_names
+                   if n not in arg_params and n not in data_shapes]
+        # loss-head label inputs get dummy zeros: inference never reads
+        # them (c_predict_api.cc binds heads with placeholder labels)
+        labels = [n for n in missing
+                  if n.endswith("_label") or n == "label"]
+        missing = [n for n in missing if n not in labels]
+        if missing:
+            raise MXNetError("Predictor: params missing for %s" % missing)
+        label_shapes = {}
+        if labels:
+            arg_shapes, _, _ = symbol.infer_shape(**data_shapes)
+            label_shapes = {n: tuple(s) for n, s in
+                            zip(arg_names, arg_shapes) if n in labels}
+        args = {}
+        for n in arg_names:
+            if n in data_shapes:
+                args[n] = nd.zeros(data_shapes[n], ctx=self._ctx)
+            elif n in label_shapes:
+                args[n] = nd.zeros(label_shapes[n], ctx=self._ctx)
+            else:
+                args[n] = arg_params[n].as_in_context(self._ctx)
+        aux = {n: aux_params[n].as_in_context(self._ctx)
+               for n in symbol.list_auxiliary_states()}
+        self._exec = symbol.bind(
+            self._ctx, args=args, aux_states=aux or None,
+            grad_req={n: "null" for n in arg_names})
+        self._outputs = None
+
+    def set_input(self, name=None, value=None, **named):
+        """Stage input(s) (MXPredSetInput)."""
+        feeds = dict(named)
+        if name is not None:
+            feeds[name] = value
+        for k, v in feeds.items():
+            if k not in self._data_names:
+                raise MXNetError("unknown input %r (inputs: %s)"
+                                 % (k, self._data_names))
+            arr = v if isinstance(v, nd.NDArray) else nd.array(
+                np.asarray(v), ctx=self._ctx)
+            arr.copyto(self._exec.arg_dict[k])
+        return self
+
+    def forward(self, **feeds):
+        """Run inference (MXPredForward); returns self for chaining."""
+        if feeds:
+            self.set_input(**feeds)
+        self._outputs = self._exec.forward(is_train=False)
+        return self
+
+    def get_output(self, index=0):
+        """Fetch an output as numpy (MXPredGetOutput)."""
+        if self._outputs is None:
+            raise MXNetError("forward() has not run")
+        return self._outputs[index].asnumpy()
+
+    @property
+    def output_shapes(self):
+        shapes = {d: s for d, s in
+                  zip(self._data_names,
+                      (self._exec.arg_dict[n].shape
+                       for n in self._data_names))}
+        _, out_shapes, _ = self._sym.infer_shape(**shapes)
+        return [tuple(s) for s in out_shapes]
+
+    def reshape(self, data_shapes):
+        """Rebuild for new input shapes (MXPredReshape)."""
+        arg_params = {n: self._exec.arg_dict[n]
+                      for n in self._sym.list_arguments()
+                      if n not in self._data_names
+                      and not (n.endswith("_label") or n == "label")}
+        aux_params = dict(self._exec.aux_dict)
+        return Predictor(self._sym, arg_params, aux_params, data_shapes,
+                         ctx=self._ctx)
+
+
+def load_checkpoint_predictor(prefix, epoch, data_shapes, ctx=None,
+                              output_names=None):
+    """Build a Predictor from a Module checkpoint
+    (prefix-symbol.json + prefix-%04d.params)."""
+    from .model import load_checkpoint
+    symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+    return Predictor(symbol, arg_params, aux_params, data_shapes, ctx=ctx,
+                     output_names=output_names)

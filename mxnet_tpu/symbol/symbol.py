@@ -397,7 +397,11 @@ def _infer_graph(heads, known_shapes, known_dtypes, partial=False):
             elif "__dtype__" in n.attrs:
                 dtypes[(id(n), 0)] = _np.dtype(n.attrs["__dtype__"])
 
-    for _ in range(3):  # fixed point (params fill in on later passes)
+    # fixed point: params fill in on later passes.  Bounded by the topo
+    # length (information flows at least one node per pass); the historical
+    # cap of 3 could silently under-infer deep fill-chains.
+    max_passes = max(3, len(topo))
+    for _pass in range(max_passes):
         progressed = False
         for n in topo:
             if n.op is None:

@@ -1,0 +1,62 @@
+"""Predictor (inference-only runtime) tests.
+
+Reference: include/mxnet/c_predict_api.h contract — build from checkpoint
+artifacts, set input, forward, get output; partial outputs; reshape.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _train_and_checkpoint(tmp_path):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((32, 6)).astype(np.float32)
+    W = rng.standard_normal((3, 6)).astype(np.float32)
+    Y = (X @ W.T).argmax(1).astype(np.float32)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    import logging
+    logging.disable(logging.CRITICAL)
+    mod.fit(it, num_epoch=20, optimizer_params={"learning_rate": 0.2},
+            epoch_end_callback=mx.callback.do_checkpoint(
+                str(tmp_path / "m")))
+    acc = mx.metric.Accuracy()
+    mod.score(it, acc)
+    return X, Y, acc.get()[1]
+
+
+def test_predictor_from_checkpoint(tmp_path):
+    X, Y, train_acc = _train_and_checkpoint(tmp_path)
+    assert train_acc > 0.8
+    pred = mx.predict.load_checkpoint_predictor(
+        str(tmp_path / "m"), 20, {"data": (8, 6)}, ctx=mx.cpu())
+    correct = 0
+    for i in range(0, 32, 8):
+        out = pred.forward(data=X[i:i + 8]).get_output(0)
+        correct += (out.argmax(1) == Y[i:i + 8]).sum()
+    assert correct / 32 >= train_acc - 1e-6  # same predictions as Module
+
+
+def test_predictor_partial_out(tmp_path):
+    _train_and_checkpoint(tmp_path)
+    pred = mx.predict.load_checkpoint_predictor(
+        str(tmp_path / "m"), 20, {"data": (4, 6)}, ctx=mx.cpu(),
+        output_names=["relu1_output"])
+    out = pred.forward(data=np.zeros((4, 6), np.float32)).get_output(0)
+    assert out.shape == (4, 16)
+
+
+def test_predictor_reshape(tmp_path):
+    X, _, _ = _train_and_checkpoint(tmp_path)
+    pred = mx.predict.load_checkpoint_predictor(
+        str(tmp_path / "m"), 20, {"data": (8, 6)}, ctx=mx.cpu())
+    big = pred.reshape({"data": (16, 6)})
+    out = big.forward(data=X[:16]).get_output(0)
+    assert out.shape == (16, 3)
+    ref = pred.forward(data=X[:8]).get_output(0)
+    np.testing.assert_allclose(out[:8], ref, rtol=1e-5, atol=1e-6)
