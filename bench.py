@@ -88,6 +88,9 @@ def main():
     # labels/loss/batch-norm stats stay f32
     dtype = jnp.float32 if on_cpu else jnp.bfloat16
 
+    # standard stem: the s2d reformulation (stem="s2d") measured SLOWER on
+    # v5e-1 (93.9 vs ~75 ms/step) — the input relayout + stride-1 conv cost
+    # more than the C=3 lane waste they remove; see PROFILE_r03.md
     net = get_resnet_symbol(num_classes=1000, num_layers=50,
                             image_shape=(3, image, image), layout="NHWC")
     arg_names = net.list_arguments()

@@ -58,8 +58,44 @@ def _residual_unit(data, num_filter, stride, dim_match, name,
     return conv2 + shortcut
 
 
+def _s2d_stem(data, num_filter, height, layout):
+    """The 7x7/s2 stem as an EXACT space-to-depth reformulation.
+
+    The C=3 input wastes 125/128 MXU lanes (PROFILE_r03.md lever 1; the
+    MLPerf ResNet trick).  Identity used: pad the kernel's 7x7 taps to
+    8x8 (one zero row/col in front), space-to-depth both kernel and image
+    by 2, and the conv becomes 4x4/s1 over 12 channels — identical math
+    (2y+i-3 = 2(y+a)+b with i+1 = 2A+b), so conv0_weight keeps its
+    reference shape/values and checkpoints are interchangeable.  Output
+    113x113 is cropped to the 112x112 the strided original produces.
+    """
+    assert layout == "NHWC", "s2d stem is channels-last only"
+    b_sym = 0  # batch placeholder in reshape specs
+    h2 = height // 2
+    # image: (B, H, W, 3) -> (B, H/2, W/2, 12); channel order (di, dj, c)
+    z = sym.Reshape(data, shape=(b_sym, h2, 2, h2, 2, 3))
+    z = sym.transpose(z, axes=(0, 1, 3, 2, 4, 5))
+    z = sym.Reshape(z, shape=(b_sym, h2, h2, 12), name="stem_s2d")
+    # kernel: (64, 7, 7, 3) --pad front--> (64, 8, 8, 3) -> (64, 4, 4, 12)
+    w = sym.Variable("conv0_weight", shape=(num_filter, 7, 7, 3))
+    w8 = sym.transpose(w, axes=(0, 3, 1, 2))          # (64, 3, 7, 7)
+    w8 = sym.Pad(w8, mode="constant",
+                 pad_width=(0, 0, 0, 0, 1, 0, 1, 0))  # front-pad taps
+    w8 = sym.transpose(w8, axes=(0, 2, 3, 1))          # (64, 8, 8, 3)
+    ws = sym.Reshape(w8, shape=(num_filter, 4, 2, 4, 2, 3))
+    ws = sym.transpose(ws, axes=(0, 1, 3, 2, 4, 5))
+    ws = sym.Reshape(ws, shape=(num_filter, 4, 4, 12))
+    y = sym.Convolution(z, weight=ws, num_filter=num_filter, kernel=(4, 4),
+                        stride=(1, 1), pad=(2, 2), no_bias=True,
+                        name="conv0", layout="NHWC")
+    # pad 2 symmetric gives H/2+1 rows; the original (pad 3, stride 2)
+    # needs rows [0, H/2): drop the trailing one
+    y = sym.slice_axis(y, axis=1, begin=0, end=h2)
+    return sym.slice_axis(y, axis=2, begin=0, end=h2)
+
+
 def _resnet(units, num_stages, filter_list, num_classes, image_shape,
-            bottle_neck=True, bn_mom=0.9, layout="NCHW"):
+            bottle_neck=True, bn_mom=0.9, layout="NCHW", stem="conv7"):
     """symbols/resnet.py resnet()."""
     bn_axis = 3 if layout == "NHWC" else 1
     data = sym.Variable("data")
@@ -71,9 +107,12 @@ def _resnet(units, num_stages, filter_list, num_classes, image_shape,
                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
                                no_bias=True, name="conv0", layout=layout)
     else:  # imagenet stem
-        body = sym.Convolution(data, num_filter=filter_list[0],
-                               kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                               no_bias=True, name="conv0", layout=layout)
+        if stem == "s2d":
+            body = _s2d_stem(data, filter_list[0], height, layout)
+        else:
+            body = sym.Convolution(data, num_filter=filter_list[0],
+                                   kernel=(7, 7), stride=(2, 2), pad=(3, 3),
+                                   no_bias=True, name="conv0", layout=layout)
         body = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
                              name="bn0", axis=bn_axis)
         body = sym.Activation(body, act_type="relu", name="relu0")
@@ -111,8 +150,13 @@ _SPECS = {
 
 
 def get_resnet_symbol(num_classes=1000, num_layers=50,
-                      image_shape=(3, 224, 224), layout="NCHW"):
-    """Build a ResNet symbol (symbols/resnet.py get_symbol)."""
+                      image_shape=(3, 224, 224), layout="NCHW",
+                      stem="conv7"):
+    """Build a ResNet symbol (symbols/resnet.py get_symbol).
+
+    stem='s2d' (NHWC only): exact space-to-depth reformulation of the
+    7x7/s2 stem — same parameters, same outputs, ~4x better MXU lane
+    utilization on the C=3 input (see _s2d_stem)."""
     nchannel, height, _ = image_shape
     if height <= 28:
         num_stages = 3
@@ -137,4 +181,4 @@ def get_resnet_symbol(num_classes=1000, num_layers=50,
             raise ValueError("no experiments done on num_layers %d" % num_layers)
         units, bottle_neck = _SPECS[num_layers]
     return _resnet(units, num_stages, filter_list, num_classes, image_shape,
-                   bottle_neck, layout=layout)
+                   bottle_neck, layout=layout, stem=stem)

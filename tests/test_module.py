@@ -226,3 +226,34 @@ def test_sequential_module_duplicate_param_rejected():
     import pytest
     with pytest.raises(mx.MXNetError):
         seq.init_params()
+
+
+def test_resnet_s2d_stem_exact_equivalence():
+    """stem='s2d' is a pure reformulation: same conv0_weight shape, same
+    outputs as the 7x7/s2 stem (models/resnet.py _s2d_stem)."""
+    import numpy as np
+    from mxnet_tpu.models import get_resnet_symbol
+    rng = np.random.default_rng(0)
+    B, H = 2, 64
+    x = rng.standard_normal((B, H, H, 3)).astype(np.float32)
+    outs = {}
+    for stem in ("conv7", "s2d"):
+        net = get_resnet_symbol(num_classes=10, num_layers=18,
+                                image_shape=(3, H, H), layout="NHWC",
+                                stem=stem)
+        arg_shapes, _, aux_shapes = net.infer_shape(
+            data=(B, H, H, 3), softmax_label=(B,))
+        names = net.list_arguments()
+        rng2 = np.random.default_rng(1)
+        args = {n: mx.nd.array(
+            rng2.standard_normal(s).astype(np.float32) * 0.1)
+            for n, s in zip(names, arg_shapes)}
+        args["data"] = mx.nd.array(x)
+        aux = {n: mx.nd.array(np.zeros(s, np.float32) if "mean" in n
+                              else np.ones(s, np.float32))
+               for n, s in zip(net.list_auxiliary_states(), aux_shapes)}
+        assert dict(zip(names, arg_shapes))["conv0_weight"] == (64, 7, 7, 3)
+        exe = net.bind(mx.cpu(), args=args, aux_states=aux,
+                       grad_req={n: "null" for n in names})
+        outs[stem] = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(outs["conv7"], outs["s2d"], atol=2e-4)
