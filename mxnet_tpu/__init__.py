@@ -9,6 +9,7 @@ jax.jit over sharded meshes; KVStore modes are mesh collectives.
 __version__ = "0.12.0.tpu1"
 
 from .base import MXNetError
+from . import config
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import base
 from . import context

@@ -1,0 +1,85 @@
+"""Runtime configuration — the MXNET_* environment-variable tier.
+
+Reference: ~40 `MXNET_*` vars read via dmlc::GetEnv across the runtime
+(docs/faq/env_var.md; engine type/threads src/engine/engine.cc:33,
+threaded_engine_perdevice.cc:92-96, executor flags graph_executor.cc:40,
+MXNET_BACKWARD_DO_MIRROR graph_executor.cc:282, profiler autostart
+src/engine/profiler.cc:66, kvstore bigarray bound).
+
+TPU-native redesign: one typed registry declares every variable with its
+type, default, and doc (the dmlc::Parameter discipline applied to env
+vars); `describe()` regenerates the env-var documentation so it can never
+drift from the code.  Vars whose machinery collapsed into XLA (engine
+type, thread pools per device, storage pools) are intentionally absent —
+the table below IS the supported surface.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get", "describe", "VARIABLES"]
+
+
+class _Var(object):
+    __slots__ = ("name", "vtype", "default", "doc")
+
+    def __init__(self, name, vtype, default, doc):
+        self.name = name
+        self.vtype = vtype
+        self.default = default
+        self.doc = doc
+
+    def read(self):
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return self.default
+        if self.vtype is bool:
+            return raw.strip().lower() not in ("", "0", "false", "no")
+        return self.vtype(raw)
+
+
+VARIABLES = {v.name: v for v in [
+    _Var("MXNET_BACKWARD_DO_MIRROR", bool, False,
+         "Trade FLOPs for memory: rematerialize forward activations "
+         "during backward instead of storing them (the reference's "
+         "mirror pass, graph_executor.cc:282; here jax.checkpoint around "
+         "the fused step's forward)."),
+    _Var("MXNET_CPU_WORKER_NTHREADS", int, 4,
+         "Default worker-thread count for host-side pipelines "
+         "(ImageRecordIter preprocess_threads default; the reference's "
+         "engine CPU worker pool knob, threaded_engine_perdevice.cc:92)."),
+    _Var("MXNET_PROFILER_AUTOSTART", bool, False,
+         "Start the profiler at import and dump on exit "
+         "(src/engine/profiler.cc:66)."),
+    _Var("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+         "Arrays at least this large log a hint when pushed through the "
+         "per-key kvstore veneer instead of the fused sharded step "
+         "(the reference sharded such arrays across servers)."),
+    _Var("MXNET_ENFORCE_DETERMINISM", bool, False,
+         "Fold a fixed seed into stochastic ops when no seed was set "
+         "(reference MXNET_ENFORCE_DETERMINISM)."),
+    _Var("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
+         "Accepted for API parity; execution is always one fused XLA "
+         "program (the engine bulking machinery this toggled does not "
+         "exist)."),
+]}
+
+
+def get(name):
+    """Typed read of a registered MXNET_* variable."""
+    if name not in VARIABLES:
+        raise KeyError("unknown config variable %r (known: %s)"
+                       % (name, sorted(VARIABLES)))
+    return VARIABLES[name].read()
+
+
+def describe():
+    """Markdown table of every supported env var (docs generated from the
+    registry, dmlc::Parameter-style)."""
+    lines = ["| variable | type | default | description |",
+             "|---|---|---|---|"]
+    for name in sorted(VARIABLES):
+        v = VARIABLES[name]
+        lines.append("| %s | %s | %r | %s |"
+                     % (name, v.vtype.__name__, v.default, v.doc))
+    return "\n".join(lines)

@@ -243,6 +243,9 @@ class Executor:
             grad_shards = [self._sharding.get(n) if self._sharding else None
                            for n in grad_names]
 
+            from . import config
+            mirror = config.get("MXNET_BACKWARD_DO_MIRROR")
+
             def fwd_bwd(arg_vals, aux_vals, key, head_grads, old_grads):
                 def f(*wrt):
                     av = list(arg_vals)
@@ -250,6 +253,11 @@ class Executor:
                         av[i] = w
                     outs, new_aux = g(tuple(av), aux_vals, key, True)
                     return outs, new_aux
+                if mirror:
+                    # MXNET_BACKWARD_DO_MIRROR ≡ rematerialization: recompute
+                    # forward activations in backward instead of storing
+                    # them (graph_executor.cc:282 mirror pass → jax.checkpoint)
+                    f = jax.checkpoint(f)
                 wrt_vals = tuple(arg_vals[i] for i in gidx)
                 outs, vjp, new_aux = jax.vjp(f, *wrt_vals, has_aux=True)
                 if head_grads is None:

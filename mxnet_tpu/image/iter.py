@@ -42,7 +42,7 @@ class ImageRecordIterImpl(DataIter):
                  path_imgidx=None, label_width=1,
                  shuffle=False, seed=0,
                  num_parts=1, part_index=0,
-                 preprocess_threads=4, prefetch_buffer=4,
+                 preprocess_threads=None, prefetch_buffer=4,
                  round_batch=True,
                  # augmentation (image_aug_default.cc)
                  resize=-1, rand_crop=False, rand_resize=False,
@@ -146,6 +146,9 @@ class ImageRecordIterImpl(DataIter):
 
         # --- worker pool: each thread owns a record reader (independent
         # seeks), created lazily in thread-local storage
+        if preprocess_threads is None:
+            from .. import config
+            preprocess_threads = config.get("MXNET_CPU_WORKER_NTHREADS")
         self._tls = threading.local()
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(1, preprocess_threads),

@@ -169,6 +169,11 @@ def stop_xla_trace():
 
 
 # autostart parity: MXNET_PROFILER_AUTOSTART=1 (profiler.cc:66)
-if os.environ.get("MXNET_PROFILER_AUTOSTART") == "1":
-    profiler_set_state("run")
-    atexit.register(dump_profile)
+def _maybe_autostart():
+    from . import config
+    if config.get("MXNET_PROFILER_AUTOSTART"):
+        profiler_set_state("run")
+        atexit.register(dump_profile)
+
+
+_maybe_autostart()

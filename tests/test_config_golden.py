@@ -1,0 +1,112 @@
+"""Config env-var tier + golden serialization fixtures.
+
+Reference: the MXNET_* env tier (docs/faq/env_var.md; SURVEY §5 config
+system) and the committed-serialization back-compat pattern
+(tests/python/unittest legacy_ndarray.v0 / save_000800.json fixtures).
+The golden files in tests/fixtures/ were written once and committed —
+loading them must keep working forever.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def test_config_defaults_and_types():
+    assert config.get("MXNET_BACKWARD_DO_MIRROR") is False
+    assert isinstance(config.get("MXNET_CPU_WORKER_NTHREADS"), int)
+    with pytest.raises(KeyError):
+        config.get("MXNET_NOT_A_THING")
+
+
+def test_config_env_override(monkeypatch):
+    monkeypatch.setenv("MXNET_CPU_WORKER_NTHREADS", "9")
+    assert config.get("MXNET_CPU_WORKER_NTHREADS") == 9
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    assert config.get("MXNET_BACKWARD_DO_MIRROR") is True
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "false")
+    assert config.get("MXNET_BACKWARD_DO_MIRROR") is False
+
+
+def test_config_docs_generated():
+    doc = config.describe()
+    for name in config.VARIABLES:
+        assert name in doc
+
+
+def test_mirror_remat_same_results():
+    """MXNET_BACKWARD_DO_MIRROR=1 (jax.checkpoint remat) must change
+    memory, not math: gradients identical to the stored-activation path.
+    Run in a subprocess because the flag is read at executor build."""
+    code = r"""
+import os
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+
+def grads(mirror):
+    os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1" if mirror else "0"
+    import mxnet_tpu as mx
+    rng = np.random.default_rng(0)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=5,
+                                name="fc")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    args = {"data": mx.nd.array(rng.standard_normal((4, 3)).astype("float32")),
+            "fc_weight": mx.nd.array(rng.standard_normal((5, 3)).astype("float32")),
+            "fc_bias": mx.nd.zeros((5,)),
+            "softmax_label": mx.nd.array(np.array([0, 1, 2, 3], "float32"))}
+    exe = net.bind(mx.cpu(), args=args,
+                   grad_req={"fc_weight": "write", "fc_bias": "write",
+                             "data": "null", "softmax_label": "null"})
+    exe.forward(is_train=True)
+    exe.backward()
+    return exe.grad_dict["fc_weight"].asnumpy()
+
+a = grads(False)
+b = grads(True)
+np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+print("MIRROR_MATCH")
+"""
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    env = dict(os.environ, PYTHONPATH=repo)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert "MIRROR_MATCH" in out.stdout, out.stdout + out.stderr
+
+
+def test_golden_checkpoint_loads():
+    """The committed checkpoint must load byte-for-byte forever."""
+    sym, arg, aux = mx.model.load_checkpoint(
+        os.path.join(FIXDIR, "golden"), 1)
+    assert sym.list_arguments() == ["data", "fc_weight", "fc_bias",
+                                    "softmax_label"]
+    np.testing.assert_allclose(
+        arg["fc_weight"].asnumpy(),
+        np.arange(24, dtype=np.float32).reshape(4, 6) / 10)
+    np.testing.assert_allclose(arg["fc_bias"].asnumpy(),
+                               [0.5, -0.5, 1.0, 0.0])
+    # and it must still run
+    pred = mx.predict.Predictor(sym, arg, aux, {"data": (2, 6)},
+                                ctx=mx.cpu())
+    out = pred.forward(data=np.ones((2, 6), np.float32)).get_output(0)
+    logits = np.ones(6) @ (np.arange(24).reshape(4, 6) / 10).T \
+        + np.array([0.5, -0.5, 1.0, 0.0])
+    e = np.exp(logits - logits.max())
+    np.testing.assert_allclose(out[0], e / e.sum(), rtol=1e-5)
+
+
+def test_golden_symbol_json_structure():
+    """The JSON graph format itself is frozen (nodes/arg_nodes/heads)."""
+    import json
+    doc = json.load(open(os.path.join(FIXDIR, "golden-symbol.json")))
+    assert set(doc) >= {"nodes", "arg_nodes", "heads"}
+    ops = [n["op"] for n in doc["nodes"]]
+    assert "FullyConnected" in ops and "SoftmaxOutput" in ops
