@@ -1,0 +1,117 @@
+"""Expert parallelism: switch-style MoE with all_to_all dispatch over an
+'ep' mesh axis.
+
+Absent in the reference (SURVEY §2.3 EP row — the framework predates
+MoE); built TPU-natively: each device owns one expert's parameters,
+tokens are routed top-1 by a gate, and two `lax.all_to_all` collectives
+(dispatch + combine) move token blocks across the ICI ring — the whole
+layer is one XLA program inside shard_map.
+
+Capacity semantics: each expert accepts at most `capacity` tokens per
+source device; overflow tokens are dropped (output zeros), the standard
+switch-transformer contract.  Set capacity >= tokens-per-device for
+lossless routing.
+"""
+from __future__ import annotations
+
+__all__ = ["moe_dispatch", "MoELayer"]
+
+
+def moe_dispatch(expert_fn, mesh, expert_params, x, gate_logits,
+                 capacity=None, axis_name="ep"):
+    """Route tokens to experts and back.
+
+    expert_fn(params, tokens) -> tokens : one expert's computation
+    expert_params: pytree, leaves with leading expert axis of size E
+    x: (n_global, d) tokens, sharded over 'ep' by the caller's spec
+    gate_logits: (n_global, E) routing scores
+    Returns (n_global, d) outputs (zeros for dropped tokens) and the
+    (n_global,) chosen expert ids.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    E = mesh.shape[axis_name]
+    n_global, d = x.shape
+    n_local = n_global // E
+    cap = capacity if capacity is not None else n_local
+
+    def body(params, xs, gs):
+        params = jax.tree_util.tree_map(lambda l: l[0], params)
+        n = xs.shape[0]
+        choice = jnp.argmax(gs, axis=1)                    # (n,)
+        gate = jax.nn.softmax(gs, axis=1)
+        gate_val = jnp.take_along_axis(gate, choice[:, None], 1)[:, 0]
+
+        # position of each token within its expert's quota (per source)
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)  # (n, E)
+        pos = jnp.cumsum(onehot, axis=0) * onehot            # 1-based
+        slot = jnp.sum(pos, axis=1) - 1                      # (n,)
+        keep = (slot >= 0) & (slot < cap)
+
+        # dispatch buffer: (E, cap, d) — block e goes to device e
+        send = jnp.zeros((E, cap, d), xs.dtype)
+        send = send.at[choice, jnp.clip(slot, 0, cap - 1)].add(
+            jnp.where(keep[:, None], xs, 0.0))
+        recv = lax.all_to_all(send, axis_name, split_axis=0,
+                              concat_axis=0, tiled=False)    # (E, cap, d)
+        out_tok = expert_fn(params, recv.reshape(E * cap, d))
+        back = lax.all_to_all(out_tok.reshape(E, cap, d), axis_name,
+                              split_axis=0, concat_axis=0, tiled=False)
+        # gather each token's result from its (expert block, slot)
+        mine = back[choice, jnp.clip(slot, 0, cap - 1)]
+        mine = jnp.where(keep[:, None], mine, 0.0)
+        return mine * gate_val[:, None], choice
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), expert_params)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(pspec, P(axis_name), P(axis_name)),
+                   out_specs=(P(axis_name), P(axis_name)),
+                   check_vma=False)
+    return fn(expert_params, x, gate_logits)
+
+
+class MoELayer(object):
+    """Gluon-flavored MoE feed-forward layer over an expert mesh.
+
+    y = gate-weighted expert MLP (top-1 switch routing); experts are
+    two-layer MLPs with per-expert parameters sharded over 'ep'.
+    """
+
+    def __init__(self, mesh, num_experts, d_model, d_hidden, axis_name="ep",
+                 capacity=None, seed=0):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        self._mesh = mesh
+        self._axis = axis_name
+        self._cap = capacity
+        rng = np.random.default_rng(seed)
+        s = 1.0 / np.sqrt(d_model)
+        self.params = {
+            "w1": jnp.asarray(rng.uniform(-s, s, (num_experts, d_model,
+                                                  d_hidden))
+                              .astype(np.float32)),
+            "w2": jnp.asarray(rng.uniform(-s, s, (num_experts, d_hidden,
+                                                  d_model))
+                              .astype(np.float32)),
+        }
+        self.wg = jnp.asarray(rng.uniform(-s, s, (d_model, num_experts))
+                              .astype(np.float32))
+
+    @staticmethod
+    def _expert(params, tokens):
+        import jax
+        import jax.numpy as jnp
+        h = jax.nn.relu(tokens @ params["w1"])
+        return h @ params["w2"]
+
+    def __call__(self, x):
+        gate_logits = x @ self.wg
+        out, choice = moe_dispatch(self._expert, self._mesh, self.params,
+                                   x, gate_logits, capacity=self._cap,
+                                   axis_name=self._axis)
+        return out, choice

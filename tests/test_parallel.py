@@ -303,3 +303,79 @@ def test_pipeline_module_trains():
         pm.update()
         losses.append(pm.loss)
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_moe_dispatch_matches_dense():
+    """Expert-parallel all_to_all routing == dense per-token computation
+    (capacity >= tokens: lossless)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel.moe import moe_dispatch
+
+    E = 4
+    mesh = Mesh(np.array(jax.devices()[:E]), ("ep",))
+    rng = np.random.default_rng(0)
+    n, d = 32, 8
+    x = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+    gl = jnp.asarray(rng.standard_normal((n, E)).astype(np.float32))
+    W = jnp.asarray(rng.standard_normal((E, d, d)).astype(np.float32) * 0.3)
+
+    def expert(w, toks):
+        return jnp.tanh(toks @ w)
+
+    out, choice = moe_dispatch(expert, mesh, W, x, gl, capacity=n)
+    out, choice = np.asarray(out), np.asarray(choice)
+
+    gate = np.asarray(jax.nn.softmax(gl, axis=1))
+    expect = np.zeros((n, d), np.float32)
+    for i in range(n):
+        e = int(np.argmax(np.asarray(gl)[i]))
+        assert choice[i] == e
+        expect[i] = np.tanh(np.asarray(x)[i] @ np.asarray(W)[e]) * gate[i, e]
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_capacity_drops_overflow():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel.moe import moe_dispatch
+
+    E = 2
+    mesh = Mesh(np.array(jax.devices()[:E]), ("ep",))
+    n, d = 8, 4
+    x = jnp.ones((n, d), jnp.float32)
+    # every token picks expert 0
+    gl = jnp.tile(jnp.asarray([[5.0, -5.0]], jnp.float32), (n, 1))
+    W = jnp.ones((E, d, d), jnp.float32)
+
+    out, _ = moe_dispatch(lambda w, t: t @ w, mesh, W, x, gl, capacity=1)
+    out = np.asarray(out)
+    # per source device (4 tokens each), only 1 fits expert 0's quota
+    nz = (np.abs(out).sum(1) > 0).reshape(E, n // E)
+    assert (nz.sum(axis=1) == 1).all()
+
+
+def test_moe_layer_trains():
+    """MoELayer is differentiable end-to-end (grads reach expert params)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel.moe import MoELayer
+
+    E = 4
+    mesh = Mesh(np.array(jax.devices()[:E]), ("ep",))
+    layer = MoELayer(mesh, num_experts=E, d_model=8, d_hidden=16)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((16, 8)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((16, 8)).astype(np.float32))
+
+    def loss(params):
+        layer.params = params
+        out, _ = layer(x)
+        return jnp.mean((out - y) ** 2)
+
+    g = jax.grad(loss)(layer.params)
+    for k in ("w1", "w2"):
+        assert float(jnp.abs(g[k]).sum()) > 0, k
