@@ -18,3 +18,4 @@ from . import optimizer_ops  # noqa: F401
 from . import rnn           # noqa: F401
 from . import contrib_det   # noqa: F401
 from . import contrib_misc  # noqa: F401
+from . import contrib_rcnn  # noqa: F401

@@ -1,0 +1,280 @@
+"""R-CNN-family contrib ops: Correlation, Proposal/MultiProposal,
+PSROIPooling.
+
+Reference: src/operator/correlation.cc (CorrelationForward — displacement
+-window patch correlation), src/operator/contrib/proposal.cc (RPN:
+GenerateAnchors + BBoxTransformInv + greedy NMS + top-k), contrib/
+multi_proposal.cc (batched variant), contrib/psroi_pooling.cc
+(position-sensitive average ROI pooling).
+
+TPU-native: the displacement loop becomes a stack of shifted elementwise
+products reduced per window (all static shapes); RPN proposal selection is
+sort + masked greedy NMS (one fori_loop) exactly like
+ops/contrib_det.py's detection head; PSROIPooling reuses the bin-mask
+trick of ROIPooling with per-bin channel gathering.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, P
+from .contrib_det import _iou_matrix
+
+_BIG_NEG = -1e9
+
+
+# ---------------------------------------------------------------------------
+# Correlation
+# ---------------------------------------------------------------------------
+
+@register("Correlation", aliases=["correlation"], nin=2,
+          input_names=["data1", "data2"],
+          params={"kernel_size": P(int, 1),
+                  "max_displacement": P(int, 1),
+                  "stride1": P(int, 1), "stride2": P(int, 1),
+                  "pad_size": P(int, 0),
+                  "is_multiply": P(bool, True)})
+def correlation(attrs, data1, data2):
+    """Patch correlation over a displacement grid (correlation.cc).
+
+    data1/data2: (N, C, H, W).  Output (N, G*G, TH, TW) with
+    G = 2*(max_displacement//stride2) + 1; each channel is the kernel-
+    window correlation of data1 around (y1,x1) with data2 displaced by
+    (s2p, s2o), normalized by kernel_size^2 * C.
+    """
+    k = attrs["kernel_size"]
+    md = attrs["max_displacement"]
+    s1, s2 = attrs["stride1"], attrs["stride2"]
+    pad = attrs["pad_size"]
+    mul = attrs["is_multiply"]
+    kr = (k - 1) // 2
+    border = md + kr
+    n, c, h, w = data1.shape
+    ph, pw = h + 2 * pad, w + 2 * pad
+    th = int(np.ceil((ph - border * 2) / float(s1)))
+    tw = int(np.ceil((pw - border * 2) / float(s1)))
+    gr = md // s2
+    gw = 2 * gr + 1
+    x1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    x2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    sumelems = k * k * c
+
+    outs = []
+    for dyi in range(-gr, gr + 1):
+        for dxi in range(-gr, gr + 1):
+            s2p, s2o = dyi * s2, dxi * s2
+            acc = 0.0
+            for hh in range(k):
+                for ww in range(k):
+                    # window top-left is (y1, x1) itself — the reference
+                    # indexes tmp[y1+h][x1+w], not a centered window
+                    a = lax.dynamic_slice(
+                        x1, (0, 0, md + hh, md + ww),
+                        (n, c, th * s1, tw * s1))[:, :, ::s1, ::s1]
+                    b = lax.dynamic_slice(
+                        x2, (0, 0, md + hh + s2p, md + ww + s2o),
+                        (n, c, th * s1, tw * s1))[:, :, ::s1, ::s1]
+                    acc = acc + (a * b if mul else jnp.abs(a - b))
+            outs.append(jnp.sum(acc, axis=1) / sumelems)   # (N, TH, TW)
+    return jnp.stack(outs, axis=1).astype(data1.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Proposal (RPN)
+# ---------------------------------------------------------------------------
+
+def _generate_base_anchors(base_size, scales, ratios):
+    """The classic generate_anchors (proposal.cc GenerateAnchors)."""
+    base = np.array([0, 0, base_size - 1, base_size - 1], np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        size_r = size / r
+        ws = np.round(np.sqrt(size_r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                            cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return np.asarray(anchors, np.float32)
+
+
+def _proposal_one(scores, deltas, im_info, base_anchors, feature_stride,
+                  pre_nms, post_nms, threshold, min_size):
+    """One image's RPN proposals.
+
+    scores (A, H, W) foreground scores, deltas (A*4, H, W), im_info
+    (3,) = [height, width, scale].  Returns (post_nms, 5) rois and
+    (post_nms,) scores (suppressed rows: score -1, box zeros).
+    """
+    A, H, W = scores.shape
+    shift_x = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)                  # (H, W)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)            # (H, W, 4)
+    anchors = shifts[:, :, None, :] + base_anchors[None, None]  # (H,W,A,4)
+    anchors = anchors.reshape(-1, 4)
+    d = jnp.transpose(deltas.reshape(A, 4, H, W),
+                      (2, 3, 0, 1)).reshape(-1, 4)           # (H*W*A, 4)
+    sc = jnp.transpose(scores, (1, 2, 0)).reshape(-1)        # (H*W*A,)
+
+    # BBoxTransformInv (+1-based widths, reference convention)
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + 0.5 * (aw - 1.0)
+    ay = anchors[:, 1] + 0.5 * (ah - 1.0)
+    px = d[:, 0] * aw + ax
+    py = d[:, 1] * ah + ay
+    pw = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+    phh = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+    imh, imw = im_info[0], im_info[1]
+    x1 = jnp.clip(px - 0.5 * (pw - 1.0), 0.0, imw - 1.0)
+    y1 = jnp.clip(py - 0.5 * (phh - 1.0), 0.0, imh - 1.0)
+    x2 = jnp.clip(px + 0.5 * (pw - 1.0), 0.0, imw - 1.0)
+    y2 = jnp.clip(py + 0.5 * (phh - 1.0), 0.0, imh - 1.0)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+
+    # min-size filter (scaled by im_info[2] like the reference)
+    ms = min_size * im_info[2]
+    keep_size = ((x2 - x1 + 1.0) >= ms) & ((y2 - y1 + 1.0) >= ms)
+    sc = jnp.where(keep_size, sc, _BIG_NEG)
+
+    n_total = sc.shape[0]
+    pre = min(pre_nms, n_total) if pre_nms > 0 else n_total
+    post = min(post_nms, pre)
+    order = jnp.argsort(-sc)
+    boxes, sc = boxes[order], sc[order]
+    in_pre = jnp.arange(n_total) < pre
+    valid = in_pre & (sc > _BIG_NEG / 2)
+
+    iou = _iou_matrix(boxes, boxes)
+    lower = jnp.arange(n_total)[:, None] < jnp.arange(n_total)[None, :]
+    suppress = (iou > threshold) & lower
+    keep = valid
+
+    def nms_round(i, keep):
+        row = suppress[i] & keep[i]
+        return keep & ~row
+
+    keep = lax.fori_loop(0, pre, nms_round, keep)
+    # compact the kept rows to the front in score order, cap at post_nms
+    rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    rois = jnp.zeros((post, 4), jnp.float32)
+    take = keep & (rank < post)
+    rois = rois.at[jnp.clip(rank, 0, post - 1)].add(
+        jnp.where(take[:, None], boxes, 0.0))
+    out_sc = jnp.full((post,), -1.0, jnp.float32)
+    out_sc = out_sc.at[jnp.clip(rank, 0, post - 1)].max(
+        jnp.where(take, sc, -1.0))
+    return rois, out_sc
+
+
+_PROPOSAL_PARAMS = {
+    "rpn_pre_nms_top_n": P(int, 6000), "rpn_post_nms_top_n": P(int, 300),
+    "threshold": P(float, 0.7), "rpn_min_size": P(int, 16),
+    "scales": P("float_tuple", (4.0, 8.0, 16.0, 32.0)),
+    "ratios": P("float_tuple", (0.5, 1.0, 2.0)),
+    "feature_stride": P(int, 16), "output_score": P(bool, False),
+    "iou_loss": P(bool, False),
+}
+
+
+def _proposal_impl(attrs, cls_prob, bbox_pred, im_info):
+    A = len(attrs["scales"]) * len(attrs["ratios"])
+    base = jnp.asarray(_generate_base_anchors(
+        16, attrs["scales"], attrs["ratios"]))
+    fg = cls_prob[:, A:, :, :]   # (N, A, H, W) foreground scores
+    f = lambda s, d, info: _proposal_one(
+        s, d, info, base, attrs["feature_stride"],
+        attrs["rpn_pre_nms_top_n"], attrs["rpn_post_nms_top_n"],
+        attrs["threshold"], attrs["rpn_min_size"])
+    rois, scores = jax.vmap(f)(fg.astype(jnp.float32),
+                               bbox_pred.astype(jnp.float32),
+                               im_info.astype(jnp.float32))
+    n, post = rois.shape[0], rois.shape[1]
+    batch_idx = jnp.tile(jnp.arange(n, dtype=jnp.float32)[:, None],
+                         (1, post))
+    out = jnp.concatenate([batch_idx[..., None], rois], axis=2) \
+        .reshape(n * post, 5)
+    out = lax.stop_gradient(out.astype(cls_prob.dtype))
+    if attrs["output_score"]:
+        return out, lax.stop_gradient(
+            scores.reshape(n * post, 1).astype(cls_prob.dtype))
+    return out
+
+
+# single + batched registrations share the implementation (the reference's
+# Proposal assumes batch 1; MultiProposal vmaps — here both vmap)
+register("_contrib_Proposal", aliases=["contrib_Proposal"], nin=3,
+         nout=lambda attrs: 2 if (attrs or {}).get("output_score") else 1,
+         input_names=["cls_prob", "bbox_pred", "im_info"],
+         params=_PROPOSAL_PARAMS)(_proposal_impl)
+register("_contrib_MultiProposal", aliases=["contrib_MultiProposal"], nin=3,
+         nout=lambda attrs: 2 if (attrs or {}).get("output_score") else 1,
+         input_names=["cls_prob", "bbox_pred", "im_info"],
+         params=_PROPOSAL_PARAMS)(_proposal_impl)
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling
+# ---------------------------------------------------------------------------
+
+@register("_contrib_PSROIPooling", aliases=["contrib_PSROIPooling"], nin=2,
+          input_names=["data", "rois"],
+          params={"spatial_scale": P(float), "output_dim": P(int),
+                  "pooled_size": P(int), "group_size": P(int, 0)})
+def psroi_pooling(attrs, data, rois):
+    """Position-sensitive ROI average pooling (psroi_pooling.cc).
+
+    data (N, output_dim*group^2, H, W); rois (R, 5).  Bin (ph, pw) of
+    output channel c averages input channel (c*group + ph)*group + pw
+    over the bin's region.
+    """
+    p = attrs["pooled_size"]
+    g = attrs["group_size"] or p
+    od = attrs["output_dim"]
+    scale = attrs["spatial_scale"]
+    n, cin, h, w = data.shape
+    rois = rois.astype(jnp.float32)
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    x1 = jnp.round(rois[:, 1]) * scale
+    y1 = jnp.round(rois[:, 2]) * scale
+    x2 = (jnp.round(rois[:, 3]) + 1.0) * scale
+    y2 = (jnp.round(rois[:, 4]) + 1.0) * scale
+    roi_h = jnp.maximum(y2 - y1, 0.1)
+    roi_w = jnp.maximum(x2 - x1, 0.1)
+    bin_h = roi_h / p
+    bin_w = roi_w / p
+
+    def masks(start, bin_sz, size):
+        q = jnp.arange(p, dtype=jnp.float32)
+        lo = jnp.floor(start[:, None] + q[None, :] * bin_sz[:, None])
+        hi = jnp.ceil(start[:, None] + (q[None, :] + 1) * bin_sz[:, None])
+        lo = jnp.clip(lo, 0, size)
+        hi = jnp.clip(hi, 0, size)
+        i = jnp.arange(size, dtype=jnp.float32)
+        m = (i[None, None, :] >= lo[:, :, None]) \
+            & (i[None, None, :] < hi[:, :, None])
+        return m.astype(jnp.float32)                     # (R, p, size)
+
+    rowm = masks(y1, bin_h, h)
+    colm = masks(x1, bin_w, w)
+    x = data[batch_idx].astype(jnp.float32)              # (R, cin, H, W)
+    # per-bin sums via two einsums (separable bin masks)
+    t = jnp.einsum("rchw,rqw->rchq", x, colm)            # (R, cin, H, p)
+    sums = jnp.einsum("rchq,rph->rcpq", t, rowm)         # (R, cin, p, p)
+    counts = jnp.einsum("rph,rqw->rpq", rowm, colm)      # (R, p, p)
+    avg = sums / jnp.maximum(counts[:, None], 1.0)
+    # position-sensitive channel gather: output bin (ph, pw) of channel c
+    # reads input channel (c*g + gh)*g + gw, gh = floor(ph*g/p)
+    avg = avg.reshape(x.shape[0], od, g, g, p, p)
+    bins = jnp.arange(p)
+    gcell = jnp.clip((bins * g) // p, 0, g - 1)
+    out = avg[:, :, gcell[:, None], gcell[None, :],
+              bins[:, None], bins[None, :]]
+    return out.astype(data.dtype)

