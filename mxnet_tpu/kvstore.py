@@ -202,6 +202,22 @@ class KVStore(object):
         from .ndarray import waitall
         waitall()
 
+    def get_num_dead_node(self, node_id=0):
+        """Fault-tolerance parity (kvstore.h:338 via ps heartbeats).
+
+        Collectives are FAIL-STOP: a dead worker aborts the job rather
+        than being detected and routed around, so a running job has by
+        definition zero dead nodes.  Recovery is checkpoint+resume
+        (`fit(begin_epoch=...)` + `--load-epoch`), the same story the
+        reference's training layer uses (SURVEY §5 failure detection).
+        """
+        return 0
+
+    def set_barrier_before_exit(self, barrier_before_exit=True):
+        """kvstore.h:290 parity: with collectives every rank exits through
+        the same program; the extra exit barrier is implicit."""
+        self._barrier_before_exit = barrier_before_exit
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "Cannot save states for distributed training"
         with open(fname, "wb") as fout:

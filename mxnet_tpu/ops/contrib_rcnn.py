@@ -65,16 +65,20 @@ def correlation(attrs, data1, data2):
         for dxi in range(-gr, gr + 1):
             s2p, s2o = dyi * s2, dxi * s2
             acc = 0.0
+            # slice exactly the strided sample extent ((t-1)*s1 + 1):
+            # th*s1 could overflow the padded array when the ceil in th
+            # rounds up, and dynamic_slice would silently clamp+shift
+            eh, ew = (th - 1) * s1 + 1, (tw - 1) * s1 + 1
             for hh in range(k):
                 for ww in range(k):
                     # window top-left is (y1, x1) itself — the reference
                     # indexes tmp[y1+h][x1+w], not a centered window
                     a = lax.dynamic_slice(
                         x1, (0, 0, md + hh, md + ww),
-                        (n, c, th * s1, tw * s1))[:, :, ::s1, ::s1]
+                        (n, c, eh, ew))[:, :, ::s1, ::s1]
                     b = lax.dynamic_slice(
                         x2, (0, 0, md + hh + s2p, md + ww + s2o),
-                        (n, c, th * s1, tw * s1))[:, :, ::s1, ::s1]
+                        (n, c, eh, ew))[:, :, ::s1, ::s1]
                     acc = acc + (a * b if mul else jnp.abs(a - b))
             outs.append(jnp.sum(acc, axis=1) / sumelems)   # (N, TH, TW)
     return jnp.stack(outs, axis=1).astype(data1.dtype)
@@ -148,12 +152,22 @@ def _proposal_one(scores, deltas, im_info, base_anchors, feature_stride,
     pre = min(pre_nms, n_total) if pre_nms > 0 else n_total
     post = min(post_nms, pre)
     order = jnp.argsort(-sc)
-    boxes, sc = boxes[order], sc[order]
-    in_pre = jnp.arange(n_total) < pre
-    valid = in_pre & (sc > _BIG_NEG / 2)
+    # keep only the pre-NMS top-k BEFORE the pairwise IoU: the matrix is
+    # quadratic and a realistic RPN grid has tens of thousands of anchors
+    boxes, sc = boxes[order[:pre]], sc[order[:pre]]
+    valid = sc > _BIG_NEG / 2
 
-    iou = _iou_matrix(boxes, boxes)
-    lower = jnp.arange(n_total)[:, None] < jnp.arange(n_total)[None, :]
+    # IoU with the reference's +1-based widths (degenerate x2==x1 boxes
+    # are 1px wide there, not empty)
+    x1b, y1b, x2b, y2b = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = (x2b - x1b + 1.0) * (y2b - y1b + 1.0)
+    iw = jnp.maximum(jnp.minimum(x2b[:, None], x2b[None, :])
+                     - jnp.maximum(x1b[:, None], x1b[None, :]) + 1.0, 0.0)
+    ih = jnp.maximum(jnp.minimum(y2b[:, None], y2b[None, :])
+                     - jnp.maximum(y1b[:, None], y1b[None, :]) + 1.0, 0.0)
+    inter = iw * ih
+    iou = inter / (area[:, None] + area[None, :] - inter)
+    lower = jnp.arange(pre)[:, None] < jnp.arange(pre)[None, :]
     suppress = (iou > threshold) & lower
     keep = valid
 
@@ -185,6 +199,12 @@ _PROPOSAL_PARAMS = {
 
 
 def _proposal_impl(attrs, cls_prob, bbox_pred, im_info):
+    if attrs["iou_loss"]:
+        from ..base import MXNetError
+        raise MXNetError(
+            "iou_loss=True (the IoUTransformInv decode, proposal.cc) is "
+            "not implemented; train the RPN with the standard bbox "
+            "parameterization or file the gap")
     A = len(attrs["scales"]) * len(attrs["ratios"])
     base = jnp.asarray(_generate_base_anchors(
         16, attrs["scales"], attrs["ratios"]))

@@ -57,19 +57,20 @@ def test_correlation_matches_reference_loop(mul):
 
 
 def test_generate_base_anchors_classic_values():
-    """The canonical base-16 anchors (Girshick generate_anchors output)."""
-    a = _generate_base_anchors(16, (8.0,), (0.5, 1.0, 2.0))
-    expect = np.array([[-175.0, -87.0, 190.0, 102.0],
-                       [-119.5, -119.5, 134.5, 134.5],
-                       [-83.0, -171.0, 98.0, 186.0]], np.float32) / 2
-    # sanity rather than byte-parity: areas scale ~ (16*8)^2, ratios held
-    w = a[:, 2] - a[:, 0] + 1
-    h = a[:, 3] - a[:, 1] + 1
-    np.testing.assert_allclose(h / w, [0.5, 1.0, 2.0], rtol=0.05)
-    # ws/hs are rounded before scaling (classic generate_anchors), so
-    # areas land within ~8% of (base*scale)^2
-    np.testing.assert_allclose(w * h, (16 * 8) ** 2, rtol=0.1)
-    del expect
+    """Byte-parity with the canonical published generate_anchors output
+    (base 16, scales 8/16/32, ratios 0.5/1/2)."""
+    a = _generate_base_anchors(16, (8.0, 16.0, 32.0), (0.5, 1.0, 2.0))
+    expect = np.array([
+        [-84., -40., 99., 55.],
+        [-176., -88., 191., 103.],
+        [-360., -184., 375., 199.],
+        [-56., -56., 71., 71.],
+        [-120., -120., 135., 135.],
+        [-248., -248., 263., 263.],
+        [-36., -80., 51., 95.],
+        [-80., -168., 95., 183.],
+        [-168., -344., 183., 359.]], np.float32)
+    np.testing.assert_allclose(a, expect)
 
 
 def test_proposal_basic():
@@ -164,3 +165,20 @@ def test_correlation_differentiable():
                            "pad_size": 1}, a, b)[0].sum()
     g1, g2 = jax.grad(f, argnums=(0, 1))(d1, d2)
     assert float(jnp.abs(g1).sum()) > 0 and float(jnp.abs(g2).sum()) > 0
+
+
+@pytest.mark.parametrize("shape,k,s1", [((9, 9), 1, 2), ((9, 9), 3, 2),
+                                        ((7, 7), 1, 2)])
+def test_correlation_stride1_regression(shape, k, s1):
+    """stride1 > 1 with ceil'd output size must not clamp-shift the slices
+    (code-review r3 finding)."""
+    rng = np.random.default_rng(5)
+    h, w = shape
+    d1 = rng.standard_normal((1, 2, h, w)).astype(np.float32)
+    d2 = rng.standard_normal((1, 2, h, w)).astype(np.float32)
+    attrs = {"kernel_size": k, "max_displacement": 2, "stride1": s1,
+             "stride2": 2, "pad_size": k // 2}
+    out = np.asarray(invoke_jax("Correlation", attrs, jnp.asarray(d1),
+                                jnp.asarray(d2))[0])
+    ref = _corr_ref(d1, d2, k, 2, s1, 2, k // 2, True)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)

@@ -470,3 +470,23 @@ def test_symbol_level_numeric_gradient():
         net, {"data": R.uniform(-1, 1, (2, 4)).astype(np.float32),
               "w": R.uniform(-1, 1, (3, 4)).astype(np.float32)},
         numeric_eps=1e-3, rtol=5e-2, atol=1e-2)
+
+
+def test_deconvolution_nhwc_matches_nchw():
+    """layout='NHWC' deconvolution (ADVICE r2) == NCHW on the same weights."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import invoke_jax
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 5, 5)).astype(np.float32)
+    w = rng.standard_normal((3, 4, 3, 3)).astype(np.float32)  # (C, F, k, k)
+    ref = np.asarray(invoke_jax(
+        "Deconvolution", {"kernel": (3, 3), "num_filter": 4},
+        jnp.asarray(x), jnp.asarray(w))[0])
+    x_cl = np.transpose(x, (0, 2, 3, 1))
+    w_cl = np.transpose(w, (0, 2, 3, 1))  # (C, k, k, F)
+    out = np.asarray(invoke_jax(
+        "Deconvolution", {"kernel": (3, 3), "num_filter": 4,
+                          "layout": "NHWC"},
+        jnp.asarray(x_cl), jnp.asarray(w_cl))[0])
+    np.testing.assert_allclose(np.transpose(out, (0, 3, 1, 2)), ref,
+                               rtol=1e-4, atol=1e-5)
