@@ -1,0 +1,188 @@
+"""Deformable ops: DeformableConvolution, DeformablePSROIPooling.
+
+Reference: src/operator/contrib/deformable_convolution.cc (+ deformable
+im2col: bilinear sampling at per-tap learned offsets, zero outside),
+contrib/deformable_psroi_pooling.cc (per-bin learned translations,
+sample_per_part bilinear grid).
+
+TPU-native: the deformable im2col becomes one vectorized bilinear gather
+building a (N, C, k*k, H', W') sample tensor, contracted with the weights
+in a single einsum (MXU); everything is static-shaped and differentiable
+through the gathers (offsets receive gradients, as in the reference).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, P
+
+
+def _bilinear_gather(img, py, px):
+    """Sample img (C, H, W) at float coords py/px (...,) with zero padding
+    outside — the deformable-conv convention."""
+    H, W = img.shape[-2], img.shape[-1]
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy1 = py - y0
+    wx1 = px - x0
+    out = 0.0
+    for dy, wy in ((0, 1.0 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1.0 - wx1), (1, wx1)):
+            yi = (y0 + dy).astype(jnp.int32)
+            xi = (x0 + dx).astype(jnp.int32)
+            inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            v = img[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+            out = out + v * (wy * wx * inside)[None]
+    return out
+
+
+def _deform_fill(attrs, in_shapes):
+    out = list(in_shapes)
+    data = out[0]
+    if data is not None:
+        k = attrs["kernel"]
+        nf = attrs["num_filter"]
+        ng = attrs.get("num_group", 1)
+        if len(out) > 2 and out[2] is None:
+            out[2] = (nf, data[1] // ng) + tuple(k)
+        if len(out) > 3 and out[3] is None:
+            out[3] = (nf,)
+    return out
+
+
+@register("_contrib_DeformableConvolution",
+          aliases=["contrib_DeformableConvolution"],
+          nin=lambda attrs: 3 if (attrs or {}).get("no_bias") else 4,
+          input_names=["data", "offset", "weight", "bias"],
+          fill_shapes=_deform_fill,
+          params={"kernel": P("shape"), "stride": P("shape", ()),
+                  "dilate": P("shape", ()), "pad": P("shape", ()),
+                  "num_filter": P(int), "num_group": P(int, 1),
+                  "num_deformable_group": P(int, 1),
+                  "workspace": P(int, 1024), "no_bias": P(bool, False),
+                  "layout": P("str_or_none", None)})
+def deformable_convolution(attrs, data, offset, weight, bias=None):
+    """Deformable conv v1 (deformable_convolution.cc).
+
+    data (N, C, H, W); offset (N, 2*DG*kh*kw, H', W') ordered
+    [dg, (i,j), (y,x)]; weight (F, C/G, kh, kw).
+    """
+    kh, kw = attrs["kernel"]
+    nd = 2
+    stride = tuple(attrs["stride"]) or (1, 1)
+    dilate = tuple(attrs["dilate"]) or (1, 1)
+    pad = tuple(attrs["pad"]) or (0, 0)
+    G = attrs["num_group"]
+    DG = attrs["num_deformable_group"]
+    N, C, H, W = data.shape
+    Ho = (H + 2 * pad[0] - (dilate[0] * (kh - 1) + 1)) // stride[0] + 1
+    Wo = (W + 2 * pad[1] - (dilate[1] * (kw - 1) + 1)) // stride[1] + 1
+
+    ys = jnp.arange(Ho, dtype=jnp.float32) * stride[0] - pad[0]
+    xs = jnp.arange(Wo, dtype=jnp.float32) * stride[1] - pad[1]
+    # offsets: (N, DG, kh*kw, 2, Ho, Wo)
+    off = offset.astype(jnp.float32).reshape(N, DG, kh * kw, 2, Ho, Wo)
+
+    taps = []
+    for i in range(kh):
+        for j in range(kw):
+            t = i * kw + j
+            py = ys[None, None, :, None] + i * dilate[0] \
+                + off[:, :, t, 0]                       # (N, DG, Ho, Wo)
+            px = xs[None, None, None, :] + j * dilate[1] \
+                + off[:, :, t, 1]
+            # sample every channel of its deform group
+            def samp(img_nc, py_n, px_n):
+                # img_nc (C, H, W); py_n/px_n (DG, Ho, Wo)
+                cpg = C // DG
+                img_g = img_nc.reshape(DG, cpg, H, W)
+                f = jax.vmap(_bilinear_gather)        # over DG
+                return f(img_g, py_n, px_n)           # (DG, cpg, Ho, Wo)
+            s = jax.vmap(samp)(data.astype(jnp.float32), py, px)
+            taps.append(s.reshape(N, C, Ho, Wo))
+    col = jnp.stack(taps, axis=2)                      # (N, C, k*k, Ho, Wo)
+
+    F = attrs["num_filter"]
+    cpgrp = C // G
+    wmat = weight.astype(jnp.float32).reshape(G, F // G, cpgrp, kh * kw)
+    colg = col.reshape(N, G, cpgrp, kh * kw, Ho, Wo)
+    out = jnp.einsum("ngckhw,gfck->ngfhw", colg, wmat)
+    out = out.reshape(N, F, Ho, Wo)
+    if bias is not None and not attrs["no_bias"]:
+        out = out + bias.astype(jnp.float32).reshape(1, -1, 1, 1)
+    return out.astype(data.dtype)
+
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=["contrib_DeformablePSROIPooling"],
+          nin=lambda attrs: 2 if (attrs or {}).get("no_trans") else 3,
+          nout=2, num_visible_outputs=1,
+          input_names=["data", "rois", "trans"],
+          params={"spatial_scale": P(float), "output_dim": P(int),
+                  "group_size": P(int), "pooled_size": P(int),
+                  "part_size": P(int, 0), "sample_per_part": P(int, 1),
+                  "trans_std": P(float, 0.0), "no_trans": P(bool, False)})
+def deformable_psroi_pooling(attrs, data, rois, trans=None):
+    """Deformable position-sensitive ROI pooling
+    (deformable_psroi_pooling.cc).  Outputs (pooled, top_count)."""
+    p = attrs["pooled_size"]
+    g = attrs["group_size"]
+    od = attrs["output_dim"]
+    scale = attrs["spatial_scale"]
+    spp = attrs["sample_per_part"]
+    tstd = attrs["trans_std"]
+    part = attrs["part_size"] or p
+    n, cin, H, W = data.shape
+    R = rois.shape[0]
+    rois = rois.astype(jnp.float32)
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    x1 = jnp.round(rois[:, 1]) * scale - 0.5
+    y1 = jnp.round(rois[:, 2]) * scale - 0.5
+    x2 = (jnp.round(rois[:, 3]) + 1.0) * scale - 0.5
+    y2 = (jnp.round(rois[:, 4]) + 1.0) * scale - 0.5
+    roi_w = jnp.maximum(x2 - x1, 0.1)
+    roi_h = jnp.maximum(y2 - y1, 0.1)
+    bin_w = roi_w / p
+    bin_h = roi_h / p
+
+    if trans is None or attrs["no_trans"]:
+        t = jnp.zeros((R, 2, part, part), jnp.float32)
+    else:
+        t = trans.astype(jnp.float32)[:R]
+
+    ph = jnp.arange(p)
+    pw = jnp.arange(p)
+    # per-bin translation from the (part x part) grid
+    pidx_h = jnp.clip((ph * part) // p, 0, part - 1)
+    pidx_w = jnp.clip((pw * part) // p, 0, part - 1)
+    dy = t[:, 0][:, pidx_h][:, :, pidx_w] * tstd    # (R, p, p)
+    dx = t[:, 1][:, pidx_h][:, :, pidx_w] * tstd
+
+    # sampling grid, indexed (roi, bin_y, bin_x, sub_y, sub_x)
+    sub = (jnp.arange(spp, dtype=jnp.float32) + 0.5) / spp
+    base_y = y1[:, None] + ph[None, :] * bin_h[:, None]        # (R, p)
+    base_x = x1[:, None] + pw[None, :] * bin_w[:, None]        # (R, p)
+    sy = (base_y[:, :, None, None, None]
+          + sub[None, None, None, :, None] * bin_h[:, None, None, None, None]
+          + (dy * roi_h[:, None, None])[:, :, :, None, None])
+    sx = (base_x[:, None, :, None, None]
+          + sub[None, None, None, None, :] * bin_w[:, None, None, None, None]
+          + (dx * roi_w[:, None, None])[:, :, :, None, None])
+    sy = jnp.broadcast_to(sy, (R, p, p, spp, spp))
+    sx = jnp.broadcast_to(sx, (R, p, p, spp, spp))
+
+    # gather: channel (c*g + gh)*g + gw per bin
+    x = data[batch_idx].astype(jnp.float32)         # (R, cin, H, W)
+
+    def sample_roi(img, yy, xx):
+        return _bilinear_gather(img, yy.reshape(-1), xx.reshape(-1)) \
+            .reshape(cin, p, p, spp, spp)
+    samples = jax.vmap(sample_roi)(x, sy, sx)       # (R, cin, p, p, s, s)
+    pooled_all = samples.mean(axis=(-2, -1))        # (R, cin, p, p)
+    avg = pooled_all.reshape(R, od, g, g, p, p)
+    bins = jnp.arange(p)
+    gc = jnp.clip((bins * g) // p, 0, g - 1)
+    out = avg[:, :, gc[:, None], gc[None, :], bins[:, None], bins[None, :]]
+    count = jnp.full((R, od, p, p), float(spp * spp), jnp.float32)
+    return out.astype(data.dtype), count

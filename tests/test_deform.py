@@ -1,0 +1,136 @@
+"""Deformable op tests.
+
+Key identity: with ZERO offsets, DeformableConvolution must equal plain
+Convolution (the reference's own sanity property), and
+DeformablePSROIPooling with no_trans must equal average-pooled PSROI
+sampling.  Nonzero integer offsets shift the sampled window exactly.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import invoke_jax
+import jax.numpy as jnp
+
+
+def _conv_ref(x, w, stride, pad, dilate):
+    from jax import lax
+    return np.asarray(lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), stride,
+        [(pad[0], pad[0]), (pad[1], pad[1])], rhs_dilation=dilate,
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
+
+
+@pytest.mark.parametrize("stride,pad,dilate", [((1, 1), (1, 1), (1, 1)),
+                                               ((2, 2), (0, 0), (1, 1)),
+                                               ((1, 1), (2, 2), (2, 2))])
+def test_deformable_conv_zero_offset_equals_conv(stride, pad, dilate):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 4, 7, 7)).astype(np.float32)
+    w = rng.standard_normal((3, 4, 3, 3)).astype(np.float32)
+    kh = kw = 3
+    Ho = (7 + 2 * pad[0] - (dilate[0] * 2 + 1)) // stride[0] + 1
+    Wo = (7 + 2 * pad[1] - (dilate[1] * 2 + 1)) // stride[1] + 1
+    off = np.zeros((2, 2 * kh * kw, Ho, Wo), np.float32)
+    out = np.asarray(invoke_jax(
+        "_contrib_DeformableConvolution",
+        {"kernel": (3, 3), "num_filter": 3, "stride": stride, "pad": pad,
+         "dilate": dilate, "no_bias": True},
+        jnp.asarray(x), jnp.asarray(off), jnp.asarray(w))[0])
+    ref = _conv_ref(x, w, stride, pad, dilate)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_integer_offset_shifts():
+    """Constant integer offset (dy=0, dx=1) == conv over x shifted by 1."""
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    w = rng.standard_normal((2, 2, 1, 1)).astype(np.float32)
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[:, 1] = 1.0  # x-offset +1 for the single tap
+    out = np.asarray(invoke_jax(
+        "_contrib_DeformableConvolution",
+        {"kernel": (1, 1), "num_filter": 2, "no_bias": True},
+        jnp.asarray(x), jnp.asarray(off), jnp.asarray(w))[0])
+    shifted = np.zeros_like(x)
+    shifted[:, :, :, :-1] = x[:, :, :, 1:]  # sample at x+1, zero at border
+    ref = _conv_ref(shifted, w, (1, 1), (0, 0), (1, 1))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_gradients_flow_to_offsets():
+    import jax
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((1, 2, 5, 5)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((2, 2, 3, 3)).astype(np.float32))
+    off = jnp.asarray(
+        (rng.standard_normal((1, 18, 3, 3)) * 0.3).astype(np.float32))
+
+    def f(x_, off_, w_):
+        return invoke_jax("_contrib_DeformableConvolution",
+                          {"kernel": (3, 3), "num_filter": 2,
+                           "no_bias": True},
+                          x_, off_, w_)[0].sum()
+    gx, go, gw = jax.grad(f, argnums=(0, 1, 2))(x, off, w)
+    for g in (gx, go, gw):
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).sum()) > 0
+
+
+def test_deformable_conv_groups():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((1, 4, 5, 5)).astype(np.float32)
+    w = rng.standard_normal((4, 2, 3, 3)).astype(np.float32)  # G=2
+    off = np.zeros((1, 2 * 9 * 2, 3, 3), np.float32)          # DG=2
+    out = np.asarray(invoke_jax(
+        "_contrib_DeformableConvolution",
+        {"kernel": (3, 3), "num_filter": 4, "num_group": 2,
+         "num_deformable_group": 2, "no_bias": True},
+        jnp.asarray(x), jnp.asarray(off), jnp.asarray(w))[0])
+    from jax import lax
+    ref = np.asarray(lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), "VALID",
+        feature_group_count=2,
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroi_no_trans_matches_constant_planes():
+    od, g, p = 2, 2, 2
+    data = np.zeros((1, od * g * g, 8, 8), np.float32)
+    for ch in range(od * g * g):
+        data[0, ch] = ch + 1
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out, count = invoke_jax(
+        "_contrib_DeformablePSROIPooling",
+        {"spatial_scale": 1.0, "output_dim": od, "pooled_size": p,
+         "group_size": g, "sample_per_part": 2, "no_trans": True},
+        jnp.asarray(data), jnp.asarray(rois))
+    out = np.asarray(out)
+    assert out.shape == (1, od, p, p)
+    for c in range(od):
+        for a in range(p):
+            for b in range(p):
+                assert abs(out[0, c, a, b] - ((c * g + a) * g + b + 1)) < 1e-4
+
+
+def test_deformable_psroi_trans_shifts_samples():
+    """A translation moves the sampling window: values change accordingly."""
+    od, g, p = 1, 1, 1
+    data = np.zeros((1, 1, 8, 8), np.float32)
+    data[0, 0] = np.arange(64, dtype=np.float32).reshape(8, 8)
+    rois = np.array([[0, 1, 1, 4, 4]], np.float32)
+    base = np.asarray(invoke_jax(
+        "_contrib_DeformablePSROIPooling",
+        {"spatial_scale": 1.0, "output_dim": od, "pooled_size": p,
+         "group_size": g, "sample_per_part": 4, "no_trans": True},
+        jnp.asarray(data), jnp.asarray(rois))[0])
+    trans = np.zeros((1, 2, 1, 1), np.float32)
+    trans[0, 0] = 1.0  # dy
+    shifted = np.asarray(invoke_jax(
+        "_contrib_DeformablePSROIPooling",
+        {"spatial_scale": 1.0, "output_dim": od, "pooled_size": p,
+         "group_size": g, "sample_per_part": 4, "trans_std": 0.25},
+        jnp.asarray(data), jnp.asarray(rois), jnp.asarray(trans))[0])
+    # dy=1 * trans_std 0.25 * roi_h 4 = 1 row down = +8 in the ramp
+    assert abs((shifted - base).item() - 8.0) < 0.5

@@ -271,6 +271,19 @@ SPECS["_contrib_count_sketch"] = S(
     lambda: [_u(2, 4), np.array([[0., 1., 0., 2.]]),
              np.array([[1., -1., 1., 1.]])],
     {"out_dim": 3}, wrt=[0], eps=3e-3, rtol=3e-2, atol=3e-3)
+# bilinear sampling is piecewise-linear in the offsets (kinks at integer
+# coordinates, like relu at 0): keep sampled positions mid-cell
+SPECS["_contrib_DeformableConvolution"] = S(
+    lambda: [_u(1, 2, 5, 5), _pos(1, 18, 3, 3, lo=0.25, hi=0.6),
+             _u(2, 2, 3, 3)],
+    {"kernel": (3, 3), "num_filter": 2, "no_bias": True},
+    eps=3e-3, rtol=3e-2, atol=3e-3)
+SPECS["_contrib_DeformablePSROIPooling"] = S(
+    lambda: [_distinct(1, 4, 6, 6), np.array([[0, 1, 1, 4, 4]], np.float64),
+             _u(1, 2, 2, 2) * 0.3],
+    {"spatial_scale": 1.0, "output_dim": 1, "pooled_size": 2,
+     "group_size": 2, "sample_per_part": 2, "trans_std": 0.1},
+    wrt=[0, 2], eps=3e-3, rtol=3e-2, atol=3e-3)
 SPECS["Correlation"] = S(
     lambda: [_u(1, 2, 5, 5), _u(1, 2, 5, 5)],
     {"kernel_size": 1, "max_displacement": 1, "pad_size": 1},
