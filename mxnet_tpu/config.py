@@ -58,6 +58,13 @@ VARIABLES = {v.name: v for v in [
     _Var("MXNET_ENFORCE_DETERMINISM", bool, False,
          "Fold a fixed seed into stochastic ops when no seed was set "
          "(reference MXNET_ENFORCE_DETERMINISM)."),
+    _Var("MXNET_CONV_DOT_1X1", bool, False,
+         "Lower channels-last 1x1 convolutions (and their dgrad/wgrad "
+         "transposes) to explicit lax.dot_general MXU matmuls instead of "
+         "XLA's conv codegen.  Measured on v5e-1 (PROFILE_r04.md): SLOWER "
+         "for ResNet-50 (80.2 vs 75.9 ms biased / confirms on honest "
+         "protocol) because the step is HBM-bound and the dot forms fuse "
+         "worse, so the default stays off; kept as a measured experiment."),
     _Var("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
          "Accepted for API parity; execution is always one fused XLA "
          "program (the engine bulking machinery this toggled does not "

@@ -24,8 +24,25 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from jax.ad_checkpoint import checkpoint_name
+
 from .registry import register, P
 from ..base import MXNetError
+from .. import config
+
+# Activation-policy names: big layer outputs are tagged so a remat policy
+# (jax.checkpoint with save_only_these_names; exercised by
+# `perf/step_bench.py --remat names`) can store ONLY convolution outputs +
+# BN statistics and recompute the BatchNorm-normalize/ReLU elementwise
+# chains in backward.  Measured on v5e-1 ResNet-50 (PROFILE_r04.md): the
+# policy LOST (108.6 vs 94.7 ms/step) — the recompute chains do not fuse
+# into single reads — so nothing in the library applies it by default; the
+# tags stay because checkpoint_name is an identity outside jax.checkpoint
+# contexts and they make the experiment reproducible.
+CKPT_CONV = "conv_out"
+CKPT_STATS = "bn_stats"
+CKPT_POOL = "pool_out"
+CKPT_FC = "fc_out"
 
 
 # ---------------------------------------------------------------------------
@@ -59,7 +76,7 @@ def fully_connected(attrs, data, weight, bias=None):
     out = jnp.dot(x, weight.T, preferred_element_type=x.dtype)
     if bias is not None and not attrs["no_bias"]:
         out = out + bias
-    return out
+    return checkpoint_name(out, CKPT_FC)
 
 
 # ---------------------------------------------------------------------------
@@ -111,6 +128,75 @@ def _deconv_fill(attrs, in_shapes):
     return out
 
 
+# --- 1x1 convolution as an explicit MXU matmul -----------------------------
+#
+# XLA's conv codegen runs ResNet's 1x1 convs (and especially their wgrad
+# transposes at 7x7/14x14 spatial) far below MXU peak (PROFILE_r03.md).
+# A 1x1 stride-1 conv IS a matmul over the flattened batch*spatial dim, and
+# the strided variants are a subsample (fwd/wgrad) or interior-dilate (dgrad)
+# away, so route them through lax.dot_general with a custom VJP whose dgrad
+# and wgrad are also plain dots.  Channels-last only (the TPU layout).
+
+def _conv1x1_subsample(x, stride):
+    if any(s > 1 for s in stride):
+        idx = ((slice(None),)
+               + tuple(slice(None, None, s) for s in stride)
+               + (slice(None),))
+        return x[idx]
+    return x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _conv1x1_cl(x, w, stride, in_spatial):
+    xs = _conv1x1_subsample(x, stride)
+    co, ci = w.shape[0], w.shape[-1]
+    lead = xs.shape[:-1]
+    y = lax.dot_general(xs.reshape((-1, ci)), w.reshape((co, ci)),
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=xs.dtype)
+    return y.reshape(lead + (co,))
+
+
+def _conv1x1_cl_fwd(x, w, stride, in_spatial):
+    xs = _conv1x1_subsample(x, stride)
+    return _conv1x1_cl(x, w, stride, in_spatial), (xs, w)
+
+
+def _conv1x1_cl_bwd(stride, in_spatial, res, dy):
+    xs, w = res
+    co, ci = w.shape[0], w.shape[-1]
+    lead = dy.shape[:-1]
+    dy2 = dy.reshape((-1, co))
+    # wgrad: contract over every batch*spatial element — one MXU matmul
+    dw = lax.dot_general(dy2, xs.reshape((-1, ci)),
+                         (((0,), (0,)), ((), ())),
+                         preferred_element_type=dy.dtype)
+    dw = dw.reshape(w.shape)
+    dxs = lax.dot_general(dy2, w.reshape((co, ci)),
+                          (((1,), (0,)), ((), ())),
+                          preferred_element_type=dy.dtype)
+    dxs = dxs.reshape(lead + (ci,))
+    if any(s > 1 for s in stride):
+        # scatter back onto the strided input grid: interior + trailing pad
+        cfg = [(0, 0, 0)]
+        for s, isp, osp in zip(stride, in_spatial, dy.shape[1:-1]):
+            cfg.append((0, isp - ((osp - 1) * s + 1), s - 1))
+        cfg.append((0, 0, 0))
+        dxs = lax.pad(dxs, jnp.zeros((), dxs.dtype), cfg)
+    return dxs, dw
+
+
+_conv1x1_cl.defvjp(_conv1x1_cl_fwd, _conv1x1_cl_bwd)
+
+
+def _conv1x1_eligible(attrs, k, pad):
+    # no dilate check: dilating a 1x1 kernel is an identity
+    return (config.get("MXNET_CONV_DOT_1X1") and _channels_last(attrs)
+            and all(ki == 1 for ki in k)
+            and attrs["num_group"] == 1
+            and all(p == (0, 0) for p in pad))
+
+
 _CONV_PARAMS = {
     "kernel": P("shape"), "stride": P("shape", ()), "dilate": P("shape", ()),
     "pad": P("shape", ()), "num_filter": P(int), "num_group": P(int, 1),
@@ -134,9 +220,14 @@ def _conv_dims(attrs, ndim):
           input_names=["data", "weight", "bias"], fill_shapes=_conv_fill,
           params=_CONV_PARAMS)
 def convolution(attrs, data, weight, bias=None):
-    _, stride, dilate, pad = _conv_dims(attrs, data.ndim)
+    k, stride, dilate, pad = _conv_dims(attrs, data.ndim)
     nd = data.ndim - 2
     sp = "DHW"[3 - nd:]
+    if _conv1x1_eligible(attrs, k, pad):
+        out = _conv1x1_cl(data, weight, stride, tuple(data.shape[1:-1]))
+        if bias is not None and not attrs["no_bias"]:
+            out = out + bias.reshape((1,) * (data.ndim - 1) + (-1,))
+        return checkpoint_name(out, CKPT_CONV)
     if _channels_last(attrs):
         # channels-last (layout=NWC/NHWC/NDHWC): the TPU-preferred layout —
         # XLA tiles the trailing C dim straight onto the MXU lanes with no
@@ -157,7 +248,7 @@ def convolution(attrs, data, weight, bias=None):
         bshape = (1,) * (data.ndim - 1) + (-1,) if _channels_last(attrs) \
             else (1, -1) + (1,) * nd
         out = out + bias.reshape(bshape)
-    return out
+    return checkpoint_name(out, CKPT_CONV)
 
 
 @register("Deconvolution", aliases=["deconvolution"],
@@ -216,10 +307,13 @@ def pooling(attrs, data):
         else tuple(range(2, data.ndim))
     if attrs["global_pool"]:
         if attrs["pool_type"] == "max":
-            return jnp.max(data, axis=spatial, keepdims=True)
+            return checkpoint_name(
+                jnp.max(data, axis=spatial, keepdims=True), CKPT_POOL)
         if attrs["pool_type"] == "sum":
-            return jnp.sum(data, axis=spatial, keepdims=True)
-        return jnp.mean(data, axis=spatial, keepdims=True)
+            return checkpoint_name(
+                jnp.sum(data, axis=spatial, keepdims=True), CKPT_POOL)
+        return checkpoint_name(
+            jnp.mean(data, axis=spatial, keepdims=True), CKPT_POOL)
     k = tuple(attrs["kernel"])
     stride = tuple(attrs["stride"]) or (1,) * nd
     pad = tuple(attrs["pad"]) or (0,) * nd
@@ -247,14 +341,15 @@ def pooling(attrs, data):
     if pt == "max":
         init = -np.inf if jnp.issubdtype(data.dtype, jnp.floating) \
             else np.iinfo(np.dtype(data.dtype)).min
-        return lax.reduce_window(data, np.array(init, data.dtype), lax.max,
-                                 window, strides, pads)
+        return checkpoint_name(
+            lax.reduce_window(data, np.array(init, data.dtype), lax.max,
+                              window, strides, pads), CKPT_POOL)
     summed = lax.reduce_window(data, np.array(0, data.dtype), lax.add,
                                window, strides, pads)
     if pt == "sum":
-        return summed
+        return checkpoint_name(summed, CKPT_POOL)
     # avg: divide by window size counting padding (MXNet counts full window)
-    return summed / float(np.prod(k))
+    return checkpoint_name(summed / float(np.prod(k)), CKPT_POOL)
 
 
 # ---------------------------------------------------------------------------
@@ -294,7 +389,9 @@ def _bn_train_fwd(eps, red, bshape, x, gamma, beta):
     # on large-mean inputs, which would NaN the rsqrt
     var = jnp.maximum(
         jnp.mean(jnp.square(xf), axis=red) - jnp.square(mean), 0.0)
-    inv = lax.rsqrt(var + eps)
+    mean = checkpoint_name(mean, CKPT_STATS)
+    var = checkpoint_name(var, CKPT_STATS)
+    inv = checkpoint_name(lax.rsqrt(var + eps), CKPT_STATS)
     scale = gamma * inv
     shift = beta - mean * scale
     out = (xf * scale.reshape(bshape) + shift.reshape(bshape)) \

@@ -1,0 +1,182 @@
+"""Step-level experiment harness for the ResNet-50 training step.
+
+Same fused fwd+bwd+SGD step and marginal-timing protocol as bench.py, with
+experiment knobs so each PROFILE_r04 lever is one command:
+
+  python perf/step_bench.py --conv1x1 dot        # 1x1 convs as dot_general
+  python perf/step_bench.py --conv1x1 native     # XLA conv codegen baseline
+  python perf/step_bench.py --copt k=v [--copt ...]   # XLA compiler options
+  python perf/step_bench.py --trace /tmp/xp      # 3-step xplane capture
+  python perf/step_bench.py --batch 512
+
+Wall-clock per-call timing through the dev tunnel is unreliable for micro
+ops (identical calls appear to be served from a cache), but the full train
+step chains params call-to-call (donated), so the K2-K1 marginal on real
+75ms-scale steps is trustworthy — the protocol r1-r3 used.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--conv1x1", choices=["dot", "native"], default="dot")
+    ap.add_argument("--remat", choices=["none", "full", "names"],
+                    default="none",
+                    help="names = save only conv outputs/BN stats/pool, "
+                         "recompute BN-normalize+ReLU chains in backward")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--copt", action="append", default=[],
+                    help="XLA compiler option key=value")
+    ap.add_argument("--trace", default=None,
+                    help="capture a 3-step xplane trace into this logdir")
+    ap.add_argument("--k1", type=int, default=20)
+    ap.add_argument("--k2", type=int, default=100)
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--label", default="")
+    args = ap.parse_args()
+
+    os.environ["MXNET_CONV_DOT_1X1"] = "1" if args.conv1x1 == "dot" else "0"
+
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import get_resnet_symbol
+    from mxnet_tpu.executor import build_graph_fn
+
+    dev = jax.devices()[0]
+    on_cpu = dev.platform == "cpu"
+    batch = args.batch if not on_cpu else 8
+    image = args.image if not on_cpu else 64
+    dtype = jnp.float32 if on_cpu else jnp.bfloat16
+
+    net = get_resnet_symbol(num_classes=1000, num_layers=50,
+                            image_shape=(3, image, image), layout="NHWC")
+    arg_names = net.list_arguments()
+    aux_names = net.list_auxiliary_states()
+    graph_fn = build_graph_fn(net, arg_names, aux_names)
+    shapes = {"data": (batch, image, image, 3), "softmax_label": (batch,)}
+    arg_shapes, _, aux_shapes = net.infer_shape(**shapes)
+
+    rng = np.random.RandomState(0)
+    data_names = {"data", "softmax_label"}
+    grad_idx = [i for i, n in enumerate(arg_names) if n not in data_names]
+    params = tuple(jnp.asarray(
+        rng.uniform(-0.05, 0.05, arg_shapes[i]).astype(np.float32), dtype)
+        for i in grad_idx)
+    auxs = tuple(jnp.zeros(s, jnp.float32) if "mean" in n
+                 else jnp.ones(s, jnp.float32)
+                 for n, s in zip(aux_names, aux_shapes))
+    data_pos = arg_names.index("data")
+    label_pos = arg_names.index("softmax_label")
+    lr = 0.05
+
+    def train_step(data_u8, labels, params, auxs, key):
+        data = data_u8.astype(dtype) * jnp.asarray(1.0 / 255.0, dtype)
+
+        def loss_fn(*wrt):
+            av = [None] * len(arg_names)
+            av[data_pos] = data
+            av[label_pos] = labels
+            for i, w in zip(grad_idx, wrt):
+                av[i] = w
+            outs, new_aux = graph_fn(tuple(av), auxs, key, True)
+            probs = outs[0].astype(jnp.float32)
+            lab = labels.astype(jnp.int32)
+            ll = -jnp.mean(jnp.log(probs[jnp.arange(probs.shape[0]),
+                                         lab] + 1e-8))
+            return ll, new_aux
+
+        if args.remat == "full":
+            loss_fn = jax.checkpoint(loss_fn)
+        elif args.remat == "names":
+            loss_fn = jax.checkpoint(
+                loss_fn,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "conv_out", "bn_stats", "pool_out", "fc_out"))
+
+        (loss, new_aux), grads = jax.value_and_grad(
+            loss_fn, argnums=tuple(range(len(params))), has_aux=True)(*params)
+        new_params = tuple(p - jnp.asarray(lr, p.dtype) * g
+                           for p, g in zip(params, grads))
+        return loss, new_params, new_aux
+
+    copts = {}
+    for kv in args.copt:
+        k, _, v = kv.partition("=")
+        copts[k] = v
+    step = jax.jit(train_step, donate_argnums=(2,))
+    key = jax.random.PRNGKey(0)
+    data_u8 = jnp.asarray(rng.randint(0, 255, shapes["data"], dtype=np.uint8))
+    labels = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.float32))
+    t0 = time.perf_counter()
+    lowered = step.lower(data_u8, labels, params, auxs, key)
+    compiled = lowered.compile(compiler_options=copts) if copts \
+        else lowered.compile()
+    compile_s = time.perf_counter() - t0
+    try:
+        step_flops = compiled.cost_analysis().get("flops", 0.0)
+    except Exception:
+        step_flops = 0.0
+
+    # Warm up PAST the post-compile transient: the first ~10 calls through
+    # the tunnel run 2-2.5x slow, which silently deflated the r1-r3
+    # K2-K1 marginal (the slow calls inflate elapsed[k1]).  Measured
+    # 2026-07-30: K=10 right after compile averages 232 ms/step vs 93.8
+    # steady-state (PROFILE_r04.md).
+    for i in range(20):
+        loss, params, auxs = compiled(data_u8, labels, params, auxs,
+                                      jax.random.fold_in(key, 10_000 + i))
+    _ = float(np.asarray(loss))
+
+    if args.trace:
+        from mxnet_tpu import profiler
+        profiler.start_xla_trace(args.trace)
+        for i in range(3):
+            loss, params, auxs = compiled(data_u8, labels, params, auxs,
+                                          jax.random.fold_in(key, 1000 + i))
+        _ = float(np.asarray(loss))
+        profiler.stop_xla_trace()
+        print("trace written to", args.trace)
+
+    # Protocol (corrected r4): after the warmup, time REPS independent
+    # blocks of K steps each (params chain call-to-call, donated, so every
+    # step really executes) and take the minimum block average.  Unlike the
+    # r1-r3 K2-K1 subtraction this cannot be deflated by a stall landing in
+    # the short leg — block averages are lower-bounded by true device time.
+    K = args.k2 if not on_cpu else 6
+    averages = []
+    for rep in range(max(args.reps, 3)):
+        t0 = time.perf_counter()
+        for i in range(K):
+            loss, params, auxs = compiled(data_u8, labels, params, auxs,
+                                          jax.random.fold_in(key, i))
+        _ = float(np.asarray(loss))
+        averages.append((time.perf_counter() - t0) / K)
+    dt = min(averages)
+
+    peak = {"v5 lite": 197e12, "v5e": 197e12}.get(
+        next((kk for kk in ("v5 lite", "v5e")
+              if kk in getattr(dev, "device_kind", "").lower()), None))
+    mfu = step_flops / dt / peak if (peak and step_flops and not on_cpu) else 0
+    print(json.dumps({
+        "label": args.label or f"conv1x1={args.conv1x1}",
+        "step_ms": round(dt * 1e3, 2),
+        "images_per_sec": round(batch / dt, 1),
+        "mfu": round(mfu, 4),
+        "gflops_per_step": round(step_flops / 1e9, 1),
+        "batch": batch,
+        "compile_s": round(compile_s, 1),
+        "copts": copts,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Aggregate device-op time from an xplane trace.json.gz capture.
+
+Usage: python perf/trace_report.py /tmp/xp_base [--steps 3] [--top 40]
+
+Uses the 'XLA Ops' device lane and each event's long_name / hlo_category /
+model_flops / bytes_accessed metadata to print, per HLO op: ms/step,
+achieved TFLOP/s (and % of bf16 peak), achieved GB/s — then a rollup by
+category and a conv-only table grouped by window/shape so the worst conv
+codegen shapes are visible directly.
+"""
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+PEAK = 197e12
+HBM_GBS = 819.0  # v5e HBM bandwidth ceiling
+
+
+def load_ops(logdir):
+    paths = glob.glob(os.path.join(logdir, "plugins/profile/*/*.trace.json.gz"))
+    if not paths:
+        sys.exit(f"no trace.json.gz under {logdir}")
+    with gzip.open(sorted(paths)[-1], "rt") as f:
+        data = json.load(f)
+    ev = data["traceEvents"]
+    lanes = {}
+    for e in ev:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            lanes[(e["pid"], e["tid"])] = e["args"]["name"]
+    ops_lane = {k for k, v in lanes.items() if v == "XLA Ops"}
+    return [e for e in ev
+            if e.get("ph") == "X" and (e.get("pid"), e.get("tid")) in ops_lane]
+
+
+def classify(long_name, category):
+    if "convolution(" in long_name or "%convolution" in long_name:
+        return "conv"
+    if category:
+        return category
+    return "other"
+
+
+_WINDOW = re.compile(r"window={size=([\dx]+)[^}]*}")
+_SHAPE = re.compile(r"= ?\(?([a-z0-9]+\[[^\]]*\])")
+
+
+def conv_key(long_name):
+    m = _WINDOW.search(long_name)
+    win = m.group(1) if m else "1x1"
+    sm = _SHAPE.search(long_name)
+    out = sm.group(1) if sm else "?"
+    return f"win{win} -> {out}"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logdir")
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--convs", action="store_true", help="per-conv table")
+    args = ap.parse_args()
+    events = load_ops(args.logdir)
+    agg = {}
+    for e in events:
+        a = e.get("args", {})
+        name = e["name"]
+        r = agg.setdefault(name, dict(dur=0.0, flops=0, bytes=0, n=0,
+                                      long=a.get("long_name", ""),
+                                      cat=a.get("hlo_category", "")))
+        r["dur"] += e.get("dur", 0.0)          # us
+        r["flops"] += int(a.get("model_flops", 0) or 0)
+        r["bytes"] += int(a.get("raw_bytes_accessed", 0) or 0)
+        r["n"] += 1
+    S = args.steps
+    total = sum(r["dur"] for r in agg.values())
+    print(f"device op total: {total/1e3/S:.2f} ms/step "
+          f"({len(agg)} distinct ops)")
+
+    by_cat = collections.Counter()
+    cat_flops = collections.Counter()
+    for r in agg.values():
+        c = classify(r["long"], r["cat"])
+        # split conv into fwd (bf16 in/out from primal graph) vs transpose:
+        # transposes show input from cotangent chain; approximate by flops/dur
+        by_cat[c] += r["dur"]
+        cat_flops[c] += r["flops"]
+    print("\n== by category (ms/step, avg TFLOP/s, %peak) ==")
+    for c, d in by_cat.most_common():
+        fl = cat_flops[c] / S
+        tf = fl / (d / S / 1e6) / 1e12 if d else 0
+        print(f"{c:20s} {d/1e3/S:8.2f}  {tf:7.1f} TF/s  {tf*1e12/PEAK:5.1%}")
+
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]["dur"])
+    print(f"\n== top {args.top} ops ==")
+    print(f"{'ms/step':>8} {'TF/s':>7} {'%peak':>6} {'GB/s':>7} {'%hbm':>6}  name")
+    for name, r in rows[:args.top]:
+        d_us = r["dur"] / S
+        tf = (r["flops"] / S) / (d_us / 1e6) / 1e12 if d_us else 0
+        gbs = (r["bytes"] / S) / (d_us / 1e6) / 1e9 if d_us else 0
+        print(f"{d_us/1e3:8.3f} {tf:7.1f} {tf*1e12/PEAK:6.1%} {gbs:7.0f} "
+              f"{gbs/HBM_GBS:6.1%}  {name[:60]} [{classify(r['long'], r['cat'])}]")
+
+    if args.convs:
+        convs = collections.defaultdict(lambda: dict(dur=0.0, flops=0, n=0))
+        for r in agg.values():
+            if classify(r["long"], r["cat"]) != "conv":
+                continue
+            k = conv_key(r["long"])
+            convs[k]["dur"] += r["dur"]
+            convs[k]["flops"] += r["flops"]
+            convs[k]["n"] += r["n"]
+        print("\n== convs by window/output (ms/step, %peak) ==")
+        for k, r in sorted(convs.items(), key=lambda kv: -kv[1]["dur"]):
+            d_us = r["dur"] / S
+            tf = (r["flops"] / S) / (d_us / 1e6) / 1e12 if d_us else 0
+            print(f"{d_us/1e3:8.3f} {tf*1e12/PEAK:6.1%} x{r['n']//S:<3d} {k}")
+
+
+if __name__ == "__main__":
+    main()
