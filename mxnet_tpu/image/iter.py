@@ -60,7 +60,8 @@ class ImageRecordIterImpl(DataIter):
                  dtype="float32", layout="NCHW",
                  data_name="data", label_name="softmax_label",
                  verbose=False, aug_list=None,
-                 raw_shape=None, _raw_uint8=False):
+                 raw_shape=None, _raw_uint8=False,
+                 use_processes=False):
         super().__init__(batch_size)
         if not path_imgrec or not os.path.exists(path_imgrec):
             raise MXNetError("path_imgrec %r does not exist" % path_imgrec)
@@ -150,10 +151,27 @@ class ImageRecordIterImpl(DataIter):
             from .. import config
             preprocess_threads = config.get("MXNET_CPU_WORKER_NTHREADS")
         self._tls = threading.local()
-        self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=max(1, preprocess_threads),
-            thread_name_prefix="imgrec")
         self._depth = max(2, prefetch_buffer)
+        self._ppool = None
+        if use_processes:
+            # multiprocess decode pool (mp_iter.py): the reference's
+            # scale-with-cores C++ pool analog, shared-memory batch slots
+            if type(self)._produce is not ImageRecordIterImpl._produce:
+                # subclasses with a custom _produce (e.g. the detection
+                # iterator's box-label batches) never reach the worker-side
+                # producer — refuse rather than deliver wrong labels
+                raise MXNetError(
+                    "use_processes=True is not supported by %s (it overrides "
+                    "_produce); use the threaded pool"
+                    % type(self).__name__)
+            from .mp_iter import ProcessPool
+            self._pool = None
+            self._ppool = ProcessPool(self, max(1, preprocess_threads),
+                                      self._depth)
+        else:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(1, preprocess_threads),
+                thread_name_prefix="imgrec")
         self._futures = collections.deque()
         self._order = []          # key order for the current epoch
         self._next_batch = 0      # next batch index to submit
@@ -285,12 +303,19 @@ class ImageRecordIterImpl(DataIter):
             pad = self.batch_size - len(keys)
             if pad:  # last partial batch: wrap from the epoch head
                 keys = keys + self._order[:pad]
-            self._futures.append(
-                self._pool.submit(self._produce, b, keys, pad))
+            if self._ppool is not None:
+                self._futures.append(
+                    self._ppool.submit(self._epoch, b, keys, pad))
+            else:
+                self._futures.append(
+                    self._pool.submit(self._produce, b, keys, pad))
 
     def reset(self):
-        for f in self._futures:
-            f.cancel()
+        if self._ppool is not None:
+            self._ppool.discard(self._futures)
+        else:
+            for f in self._futures:
+                f.cancel()
         self._futures.clear()
         self._epoch += 1
         order = list(self._keys)
@@ -310,14 +335,19 @@ class ImageRecordIterImpl(DataIter):
             raise StopIteration
         fut = self._futures.popleft()
         self._submit()
+        if self._ppool is not None:
+            return self._ppool.to_batch(fut.result())
         return fut.result()
 
     def close(self):
-        self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._ppool is not None:
+            self._ppool.close()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
 
     def __del__(self):
         try:
-            self._pool.shutdown(wait=False, cancel_futures=True)
+            self.close()
         except Exception:
             pass
 

@@ -1,5 +1,12 @@
 """Per-shape conv probe: native XLA conv vs dot_general reformulation.
 
+WARNING: through the axon dev tunnel this probe's absolute timings are
+GARBAGE — repeated identical executable calls are served from a cache
+(PROFILE_r04.md, "Wall-clock microbenchmarks ... are invalid"), and the
+calls here are intentionally unchained.  On a directly-attached TPU the
+numbers are real.  Through the tunnel, use perf/step_bench.py (whole-step,
+donated params chaining) or xplane traces instead.
+
 For each distinct (fwd / dgrad / wgrad) conv in ResNet-50 (batch 256, NHWC,
 bf16) this times the lax.conv_general_dilated form XLA autodiff produces
 against an explicit MXU-matmul reformulation:

@@ -38,9 +38,10 @@ def main():
                     help="XLA compiler option key=value")
     ap.add_argument("--trace", default=None,
                     help="capture a 3-step xplane trace into this logdir")
-    ap.add_argument("--k1", type=int, default=20)
-    ap.add_argument("--k2", type=int, default=100)
-    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--k2", type=int, default=100,
+                    help="steps per timed block")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed blocks; result is the min block average")
     ap.add_argument("--label", default="")
     args = ap.parse_args()
 
@@ -97,10 +98,12 @@ def main():
         if args.remat == "full":
             loss_fn = jax.checkpoint(loss_fn)
         elif args.remat == "names":
+            from mxnet_tpu.ops.nn import (CKPT_CONV, CKPT_STATS, CKPT_POOL,
+                                          CKPT_FC)
             loss_fn = jax.checkpoint(
                 loss_fn,
                 policy=jax.checkpoint_policies.save_only_these_names(
-                    "conv_out", "bn_stats", "pool_out", "fc_out"))
+                    CKPT_CONV, CKPT_STATS, CKPT_POOL, CKPT_FC))
 
         (loss, new_aux), grads = jax.value_and_grad(
             loss_fn, argnums=tuple(range(len(params))), has_aux=True)(*params)
@@ -153,7 +156,7 @@ def main():
     # the short leg — block averages are lower-bounded by true device time.
     K = args.k2 if not on_cpu else 6
     averages = []
-    for rep in range(max(args.reps, 3)):
+    for rep in range(args.reps):
         t0 = time.perf_counter()
         for i in range(K):
             loss, params, auxs = compiled(data_u8, labels, params, auxs,
