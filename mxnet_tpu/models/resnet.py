@@ -99,9 +99,11 @@ def _resnet(units, num_stages, filter_list, num_classes, image_shape,
     """symbols/resnet.py resnet()."""
     bn_axis = 3 if layout == "NHWC" else 1
     data = sym.Variable("data")
-    data = sym.BatchNorm(data, fix_gamma=True, eps=2e-5, momentum=bn_mom,
-                         name="bn_data", axis=bn_axis)
     nchannel, height, _ = image_shape
+    fused_stem = stem == "fused" and height > 32
+    if not fused_stem:
+        data = sym.BatchNorm(data, fix_gamma=True, eps=2e-5, momentum=bn_mom,
+                             name="bn_data", axis=bn_axis)
     if height <= 32:  # cifar-style stem
         body = sym.Convolution(data, num_filter=filter_list[0],
                                kernel=(3, 3), stride=(1, 1), pad=(1, 1),
@@ -109,6 +111,22 @@ def _resnet(units, num_stages, filter_list, num_classes, image_shape,
     else:  # imagenet stem
         if stem == "s2d":
             body = _s2d_stem(data, filter_list[0], height, layout)
+        elif fused_stem:
+            # fused input-BN + stem conv: identical math, but backward
+            # computes bn_data's dbeta via rectangle sums instead of a full
+            # stem dgrad (ops/nn.py _contrib_BNStemConv; PROFILE_r04.md).
+            # Parameter/aux names match the unfused graph exactly, so
+            # checkpoints are interchangeable.
+            body = sym._contrib_BNStemConv(
+                data,
+                gamma=sym.Variable("bn_data_gamma"),
+                beta=sym.Variable("bn_data_beta"),
+                weight=sym.Variable("conv0_weight"),
+                moving_mean=sym.Variable("bn_data_moving_mean"),
+                moving_var=sym.Variable("bn_data_moving_var"),
+                eps=2e-5, momentum=bn_mom, fix_gamma=True,
+                num_filter=filter_list[0], kernel=(7, 7), stride=(2, 2),
+                pad=(3, 3), layout=layout, name="stem_fused")
         else:
             body = sym.Convolution(data, num_filter=filter_list[0],
                                    kernel=(7, 7), stride=(2, 2), pad=(3, 3),

@@ -378,17 +378,24 @@ def _bn_train_core(eps, red, bshape, x, gamma, beta):
     return _bn_train_fwd(eps, red, bshape, x, gamma, beta)[0][0]
 
 
-def _bn_train_fwd(eps, red, bshape, x, gamma, beta):
-    # stats in f32 regardless of activation dtype: bf16 accumulation over
-    # batch*spatial elements is numerically unusable; the converts fuse
-    # into the reduction loop (no extra HBM pass).  E[x] and E[x^2] come
-    # from ONE fused multi-output reduction (one activation read).
+def _bn_batch_stats(x, red):
+    """f32 batch mean/variance — the one implementation every BN-family op
+    shares.  Stats in f32 regardless of activation dtype: bf16 accumulation
+    over batch*spatial elements is numerically unusable; the converts fuse
+    into the reduction loop (no extra HBM pass).  E[x] and E[x^2] come from
+    ONE fused multi-output reduction (one activation read).  The clamp:
+    E[x^2]-E[x]^2 can go slightly negative from f32 cancellation on
+    large-mean inputs, which would NaN the rsqrt."""
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=red)
-    # clamp: E[x^2]-E[x]^2 can go slightly negative from f32 cancellation
-    # on large-mean inputs, which would NaN the rsqrt
     var = jnp.maximum(
         jnp.mean(jnp.square(xf), axis=red) - jnp.square(mean), 0.0)
+    return mean, var
+
+
+def _bn_train_fwd(eps, red, bshape, x, gamma, beta):
+    xf = x.astype(jnp.float32)
+    mean, var = _bn_batch_stats(x, red)
     mean = checkpoint_name(mean, CKPT_STATS)
     var = checkpoint_name(var, CKPT_STATS)
     inv = checkpoint_name(lax.rsqrt(var + eps), CKPT_STATS)
@@ -447,10 +454,9 @@ def _batch_norm_impl(attrs, data, gamma, beta, mov_mean, mov_var):
                              beta32)
         # stats for moving-average writeback and output_mean_var; XLA CSEs
         # this reduction with the one inside _bn_train_core (same operand)
-        xf = data.astype(jnp.float32)
-        mean = lax.stop_gradient(jnp.mean(xf, axis=red))
-        var = lax.stop_gradient(jnp.maximum(
-            jnp.mean(jnp.square(xf), axis=red) - jnp.square(mean), 0.0))
+        mean, var = _bn_batch_stats(data, red)
+        mean = lax.stop_gradient(mean)
+        var = lax.stop_gradient(var)
         m = attrs["momentum"]
         new_mean = m * mov_mean + (1 - m) * mean
         new_var = m * mov_var + (1 - m) * var
@@ -476,6 +482,177 @@ register("BatchNorm", aliases=["batch_norm", "BatchNorm_v1", "batch_norm_v1"],
          num_visible_outputs=lambda attrs: 3 if (attrs or {}).get("output_mean_var") else 1,
          mutate_aux={3: 3, 4: 4}, mode_dependent=True,
          fill_shapes=_bn_fill, params=_BN_PARAMS)(_batch_norm_impl)
+
+
+# ---------------------------------------------------------------------------
+# _contrib_BNStemConv — fused input-BatchNorm + stem convolution
+# ---------------------------------------------------------------------------
+#
+# The reference ResNet applies BatchNorm(fix_gamma=True) to the raw input
+# before the stem conv (symbols/resnet.py bn_data).  Under autodiff the only
+# live cotangent into that BN is dbeta = sum(dgrad of the stem conv), so the
+# graph pays a full stem dgrad (236 GFLOP at C=3 lane efficiency — 4.4 ms of
+# the 94.7 ms ResNet-50 step, PROFILE_r04.md) to produce a 3-vector.  This op
+# fuses BN+conv with a custom VJP that computes dbeta EXACTLY without the
+# dgrad conv:
+#
+#     sum_m dx[m] = sum_{kh,kw} W[kh,kw] * (sum of g over the output
+#                   positions whose window covers tap (kh,kw))
+#
+# i.e. per-tap rectangle sums of sum_n(g), computed from one prefix-sum
+# table — one cheap pass over g instead of a transposed convolution.
+# Contract: `data` is a graph INPUT (grad_req null, like the reference's
+# data); the op returns zero for d(data).  fix_gamma must be true (gamma
+# grads are zero; the reference's bn_data always fixes gamma).
+
+def _bn_stem_fill(attrs, in_shapes):
+    out = list(in_shapes)
+    data = out[0]
+    if data is not None:
+        cl = _channels_last(attrs)
+        cin = data[-1] if cl else data[1]
+        k = attrs["kernel"]
+        nf = attrs["num_filter"]
+        for i in (1, 2, 4, 5):
+            if len(out) > i and out[i] is None:
+                out[i] = (cin,)
+        if len(out) > 3 and out[3] is None:
+            out[3] = (nf,) + tuple(k) + (cin,) if cl \
+                else (nf, cin) + tuple(k)
+    return out
+
+
+def _stem_valid_range(k, pad, stride, in_size, out_size):
+    """Output positions whose window covers tap k: oh*s + k - pad in
+    [0, in_size)."""
+    lo = max(0, -(-(pad - k) // stride))          # ceil((pad-k)/stride)
+    hi = min(out_size - 1, (in_size - 1 + pad - k) // stride)
+    return lo, hi
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _bn_stem_core(cfg, data, beta, weight):
+    return _bn_stem_fwd_impl(cfg, data, beta, weight)[0]
+
+
+def _bn_stem_norm(cfg, data, beta, mean, inv):
+    eps, stride, pad, cl = cfg
+    ax = data.ndim - 1 if cl else 1
+    bshape = tuple(data.shape[ax] if i == ax else 1
+                   for i in range(data.ndim))
+    xf = data.astype(jnp.float32)
+    return ((xf - mean.reshape(bshape)) * inv.reshape(bshape)
+            + beta.astype(jnp.float32).reshape(bshape)).astype(data.dtype)
+
+
+def _bn_stem_conv(cfg, bn, weight):
+    eps, stride, pad, cl = cfg
+    spec = ("NHWC", "OHWI", "NHWC") if cl else ("NCHW", "OIHW", "NCHW")
+    return lax.conv_general_dilated(
+        bn, weight, window_strides=stride, padding=[(p, p) for p in pad],
+        dimension_numbers=spec, preferred_element_type=bn.dtype)
+
+
+def _bn_stem_fwd_impl(cfg, data, beta, weight):
+    eps, stride, pad, cl = cfg
+    ax = data.ndim - 1 if cl else 1
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    mean, var = _bn_batch_stats(data, red)
+    mean = checkpoint_name(mean, CKPT_STATS)
+    var = checkpoint_name(var, CKPT_STATS)
+    inv = checkpoint_name(lax.rsqrt(var + eps), CKPT_STATS)
+    bn = _bn_stem_norm(cfg, data, beta, mean, inv)
+    out = checkpoint_name(_bn_stem_conv(cfg, bn, weight), CKPT_CONV)
+    return out, mean, var, inv
+
+
+def _bn_stem_fwd_vjp(cfg, data, beta, weight):
+    out, mean, var, inv = _bn_stem_fwd_impl(cfg, data, beta, weight)
+    return out, (data, beta, weight, mean, inv)
+
+
+def _bn_stem_bwd(cfg, res, g):
+    eps, stride, pad, cl = cfg
+    data, beta, weight, mean, inv = res
+    # wgrad through the conv (bn recomputed from saved stats: the read is
+    # the same either way, the store is avoided)
+    bn = _bn_stem_norm(cfg, data, beta, mean, inv)
+    _, vjp_w = jax.vjp(lambda w: _bn_stem_conv(cfg, bn, w), weight)
+    dw = vjp_w(g)[0]
+    # dbeta without the dgrad conv: per-tap rectangle sums of sum_n g
+    if cl:
+        gh, gw = g.shape[1], g.shape[2]
+        gsum = jnp.sum(g.astype(jnp.float32), axis=0)          # (OH, OW, O)
+        kh_dim, kw_dim = weight.shape[1], weight.shape[2]
+        in_h, in_w = data.shape[1], data.shape[2]
+    else:
+        gh, gw = g.shape[2], g.shape[3]
+        gsum = jnp.sum(g.astype(jnp.float32), axis=0)          # (O, OH, OW)
+        gsum = jnp.moveaxis(gsum, 0, -1)                       # (OH, OW, O)
+        kh_dim, kw_dim = weight.shape[2], weight.shape[3]
+        in_h, in_w = data.shape[2], data.shape[3]
+    # integral image with a zero border: I[a, b] = sum gsum[:a, :b]
+    integ = jnp.cumsum(jnp.cumsum(gsum, axis=0), axis=1)
+    integ = jnp.pad(integ, ((1, 0), (1, 0), (0, 0)))
+    taps = []
+    for kh in range(kh_dim):
+        r0, r1 = _stem_valid_range(kh, pad[0], stride[0], in_h, gh)
+        for kw in range(kw_dim):
+            c0, c1 = _stem_valid_range(kw, pad[1], stride[1], in_w, gw)
+            if r0 > r1 or c0 > c1:
+                taps.append(jnp.zeros(gsum.shape[-1], jnp.float32))
+                continue
+            taps.append(integ[r1 + 1, c1 + 1] - integ[r0, c1 + 1]
+                        - integ[r1 + 1, c0] + integ[r0, c0])
+    t = jnp.stack(taps).reshape(kh_dim, kw_dim, -1)            # (KH, KW, O)
+    wf = weight.astype(jnp.float32)
+    if cl:
+        dbeta = jnp.einsum("hwo,ohwc->c", t, wf)
+    else:
+        dbeta = jnp.einsum("hwo,ochw->c", t, wf)
+    # data is a graph input by contract (reference grad_req null): zero
+    return jnp.zeros_like(data), dbeta.astype(beta.dtype), dw
+
+
+_bn_stem_core.defvjp(_bn_stem_fwd_vjp, _bn_stem_bwd)
+
+
+@register("_contrib_BNStemConv",
+          nin=6,
+          input_names=["data", "gamma", "beta", "weight",
+                       "moving_mean", "moving_var"],
+          aux_inputs=(4, 5), nout=1, mutate_aux={4: 1, 5: 2},
+          mode_dependent=True, fill_shapes=_bn_stem_fill,
+          params={"eps": P(float, 2e-5), "momentum": P(float, 0.9),
+                  "fix_gamma": P(bool, True),
+                  "num_filter": P(int), "kernel": P("shape"),
+                  "stride": P("shape", ()), "pad": P("shape", ()),
+                  "layout": P("str_or_none", None)})
+def bn_stem_conv(attrs, data, gamma, beta, weight, mov_mean, mov_var):
+    if not attrs["fix_gamma"]:
+        raise MXNetError("_contrib_BNStemConv requires fix_gamma=true "
+                         "(the reference bn_data contract); use separate "
+                         "BatchNorm + Convolution otherwise")
+    nd = data.ndim - 2
+    if nd != 2:
+        raise MXNetError("_contrib_BNStemConv supports 2D convs only")
+    stride = tuple(attrs["stride"]) or (1, 1)
+    pad = tuple(attrs["pad"]) or (0, 0)
+    cfg = (attrs["eps"], stride, pad, _channels_last(attrs))
+    training = attrs.get("_training", False)
+    if training:
+        out = _bn_stem_core(cfg, data, beta.astype(jnp.float32), weight)
+        ax = data.ndim - 1 if cfg[3] else 1
+        red = tuple(i for i in range(data.ndim) if i != ax)
+        mean, var = _bn_batch_stats(data, red)
+        mean = lax.stop_gradient(mean)
+        var = lax.stop_gradient(var)
+        m = attrs["momentum"]
+        return out, m * mov_mean + (1 - m) * mean, m * mov_var + (1 - m) * var
+    mean = mov_mean.astype(jnp.float32)
+    inv = lax.rsqrt(mov_var.astype(jnp.float32) + attrs["eps"])
+    bn = _bn_stem_norm(cfg, data, beta, mean, inv)
+    return _bn_stem_conv(cfg, bn, weight), mov_mean, mov_var
 
 
 @register("InstanceNorm", aliases=["instance_norm"],

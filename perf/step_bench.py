@@ -27,7 +27,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--conv1x1", choices=["dot", "native"], default="dot")
+    ap.add_argument("--conv1x1", choices=["dot", "native"],
+                    default="native")
+    ap.add_argument("--stem", choices=["conv7", "s2d", "fused"],
+                    default="conv7")
     ap.add_argument("--remat", choices=["none", "full", "names"],
                     default="none",
                     help="names = save only conv outputs/BN stats/pool, "
@@ -59,7 +62,8 @@ def main():
     dtype = jnp.float32 if on_cpu else jnp.bfloat16
 
     net = get_resnet_symbol(num_classes=1000, num_layers=50,
-                            image_shape=(3, image, image), layout="NHWC")
+                            image_shape=(3, image, image), layout="NHWC",
+                            stem=args.stem)
     arg_names = net.list_arguments()
     aux_names = net.list_auxiliary_states()
     graph_fn = build_graph_fn(net, arg_names, aux_names)

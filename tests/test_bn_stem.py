@@ -1,0 +1,149 @@
+"""_contrib_BNStemConv: fused input-BN + stem conv (ops/nn.py).
+
+Must be numerically identical to the unfused BatchNorm -> Convolution
+composition: forward output, conv-weight gradient, bn beta gradient
+(computed via the rectangle-sum shortcut instead of a stem dgrad), and
+moving-stat writebacks — across strides/pads/odd sizes that stress the
+per-tap valid-range arithmetic, in both layouts.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.nn import bn_stem_conv, _batch_norm_impl, convolution
+
+
+def _unfused(data, beta, weight, eps, stride, pad, cl, training=True):
+    c = data.shape[-1] if cl else data.shape[1]
+    gamma = jnp.ones((c,), jnp.float32)
+    bn_attrs = {"eps": eps, "momentum": 0.9, "fix_gamma": True,
+                "use_global_stats": False, "output_mean_var": False,
+                "axis": data.ndim - 1 if cl else 1, "_training": training}
+    out = _batch_norm_impl(bn_attrs, data, gamma, beta,
+                           jnp.zeros((c,), jnp.float32),
+                           jnp.ones((c,), jnp.float32))
+    bn = out[0]
+    conv_attrs = {"kernel": weight.shape[1:3] if cl else weight.shape[2:4],
+                  "stride": stride, "dilate": (), "pad": pad,
+                  "num_filter": weight.shape[0], "num_group": 1,
+                  "no_bias": True, "layout": "NHWC" if cl else None}
+    return convolution(conv_attrs, bn, weight), out[3], out[4]
+
+
+def _fused(data, gamma, beta, weight, eps, stride, pad, cl, training=True):
+    attrs = {"eps": eps, "momentum": 0.9, "fix_gamma": True,
+             "num_filter": weight.shape[0],
+             "kernel": weight.shape[1:3] if cl else weight.shape[2:4],
+             "stride": stride, "pad": pad,
+             "layout": "NHWC" if cl else None, "_training": training}
+    c = data.shape[-1] if cl else data.shape[1]
+    return bn_stem_conv(attrs, data, gamma, beta, weight,
+                        jnp.zeros((c,), jnp.float32),
+                        jnp.ones((c,), jnp.float32))
+
+
+CASES = [
+    # (H, W, k, stride, pad)
+    (12, 12, 7, (2, 2), (3, 3)),
+    (11, 13, 7, (2, 2), (3, 3)),   # odd sizes: tap ranges clip asymmetric
+    (10, 10, 3, (1, 1), (1, 1)),
+    (9, 9, 5, (3, 2), (0, 2)),     # no-pad rows, over-pad cols
+    (8, 8, 1, (1, 1), (0, 0)),
+]
+
+
+@pytest.mark.parametrize("cl", [True, False])
+@pytest.mark.parametrize("case", CASES)
+def test_fused_matches_unfused(cl, case):
+    h, w, k, stride, pad = case
+    rng = np.random.default_rng(hash(case) % 2**32)
+    shape = (3, h, w, 2) if cl else (3, 2, h, w)
+    data = jnp.asarray(rng.standard_normal(shape) * 2 + 1, jnp.float32)
+    wshape = (4, k, k, 2) if cl else (4, 2, k, k)
+    weight = jnp.asarray(rng.standard_normal(wshape) * 0.3, jnp.float32)
+    beta = jnp.asarray(rng.standard_normal(2), jnp.float32)
+    gamma = jnp.ones((2,), jnp.float32)
+    eps = 2e-5
+
+    out_f, mm_f, mv_f = _fused(data, gamma, beta, weight, eps, stride, pad, cl)
+    out_u, mm_u, mv_u = _unfused(data, beta, weight, eps, stride, pad, cl)
+    np.testing.assert_allclose(out_f, out_u, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(mm_f, mm_u, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(mv_f, mv_u, rtol=1e-6, atol=1e-6)
+
+    def loss_f(beta_, weight_):
+        return jnp.sum(jnp.tanh(
+            _fused(data, gamma, beta_, weight_, eps, stride, pad, cl)[0]))
+
+    def loss_u(beta_, weight_):
+        return jnp.sum(jnp.tanh(
+            _unfused(data, beta_, weight_, eps, stride, pad, cl)[0]))
+
+    gf = jax.grad(loss_f, argnums=(0, 1))(beta, weight)
+    gu = jax.grad(loss_u, argnums=(0, 1))(beta, weight)
+    np.testing.assert_allclose(gf[0], gu[0], rtol=1e-4, atol=1e-4)  # dbeta
+    np.testing.assert_allclose(gf[1], gu[1], rtol=1e-4, atol=1e-4)  # dweight
+
+
+def test_fused_eval_mode_matches():
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.standard_normal((2, 10, 10, 3)), jnp.float32)
+    weight = jnp.asarray(rng.standard_normal((4, 3, 3, 3)) * 0.3, jnp.float32)
+    beta = jnp.asarray(rng.standard_normal(3), jnp.float32)
+    gamma = jnp.ones((3,), jnp.float32)
+    out_f, _, _ = _fused(data, gamma, beta, weight, 2e-5, (1, 1), (1, 1),
+                         True, training=False)
+    out_u, _, _ = _unfused(data, beta, weight, 2e-5, (1, 1), (1, 1),
+                           True, training=False)
+    np.testing.assert_allclose(out_f, out_u, rtol=2e-5, atol=2e-5)
+
+
+def test_resnet_fused_stem_symbol_matches_default():
+    """get_resnet_symbol(stem='fused') trains like the standard graph:
+    identical loss+grads on the shared parameter names."""
+    from mxnet_tpu.models import get_resnet_symbol
+    rng = np.random.RandomState(0)
+    kw = dict(num_classes=10, num_layers=18, image_shape=(3, 40, 40),
+              layout="NHWC")
+    net_a = get_resnet_symbol(stem="conv7", **kw)
+    net_b = get_resnet_symbol(stem="fused", **kw)
+    batch = 4
+    shapes = {"data": (batch, 40, 40, 3), "softmax_label": (batch,)}
+    exe = {tag: net.simple_bind(mx.cpu(), **shapes)
+           for tag, net in (("std", net_a), ("fused", net_b))}
+    # identical init by name
+    init = {}
+    for name, arr in exe["std"].arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        init[name] = np.random.RandomState(abs(hash(name)) % 2**31) \
+            .uniform(-0.1, 0.1, arr.shape).astype(np.float32)
+    data = rng.uniform(0, 1, shapes["data"]).astype(np.float32)
+    label = rng.randint(0, 10, (batch,)).astype(np.float32)
+    outs = {}
+    grads = {}
+    for tag, ex in exe.items():
+        assert set(ex.arg_dict) == set(exe["std"].arg_dict), \
+            (tag, set(ex.arg_dict) ^ set(exe["std"].arg_dict))
+        for name, arr in ex.arg_dict.items():
+            if name == "data":
+                arr[:] = data
+            elif name == "softmax_label":
+                arr[:] = label
+            else:
+                arr[:] = init[name]
+        (y,) = ex.forward(is_train=True)
+        ex.backward()
+        outs[tag] = y.asnumpy()
+        grads[tag] = {n: g.asnumpy() for n, g in ex.grad_dict.items()
+                      if g is not None}
+    np.testing.assert_allclose(outs["fused"], outs["std"],
+                               rtol=1e-4, atol=1e-5)
+    for name in grads["std"]:
+        if name in ("data", "softmax_label"):
+            continue
+        np.testing.assert_allclose(
+            grads["fused"][name], grads["std"][name], rtol=1e-3, atol=1e-4,
+            err_msg=name)

@@ -243,6 +243,14 @@ SPECS["BatchNorm"] = S(
     lambda: [_u(2, 3, 4, 4), _pos(3), _u(3), np.zeros(3), np.ones(3)],
     {"fix_gamma": False}, wrt=[0, 1, 2], training=True,
     eps=3e-3, rtol=3e-2, atol=3e-3)
+# fused stem: d(data) is zero BY CONTRACT (graph input, reference grad_req
+# null) — wrt covers beta+weight; the rectangle-sum dbeta is also checked
+# against the unfused composition in tests/test_bn_stem.py
+SPECS["_contrib_BNStemConv"] = S(
+    lambda: [_u(2, 3, 6, 6), np.ones(3), _u(3), _u(4, 3, 3, 3),
+             np.zeros(3), np.ones(3)],
+    {"num_filter": 4, "kernel": (3, 3), "stride": (2, 2), "pad": (1, 1)},
+    wrt=[2, 3], training=True, eps=3e-3, rtol=3e-2, atol=3e-3)
 SPECS["LayerNorm"] = S(lambda: [_u(2, 5), _pos(5), _u(5)])
 SPECS["InstanceNorm"] = S(lambda: [_u(2, 3, 5), _pos(3), _u(3)],
                           rtol=5e-3, atol=1e-4)
