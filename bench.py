@@ -255,6 +255,35 @@ def main():
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
+    # ---- measurement 3: kvstore/allreduce bandwidth (SURVEY acceptance
+    # number, tools/bandwidth/README.md 11.1 GB/s/GPU baseline) ----
+    bw_kv = bw_psum8 = bw_err = None
+    try:
+        import re
+        import subprocess
+        here = os.path.dirname(os.path.abspath(__file__))
+        rx = re.compile(r"^(\S+)\s+([0-9.]+) GB/s/device\s+max_err\s+(\S+)",
+                        re.M)
+        out1 = subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "bandwidth.py"),
+             "--rounds", "3", "--sizes", "25e6,5e6"],
+            capture_output=True, text=True, timeout=300).stdout
+        for name, gbps, err in rx.findall(out1):
+            if name == "kvstore":
+                bw_kv, bw_err = float(gbps), float(err)
+        env8 = dict(os.environ,
+                    XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                    JAX_PLATFORMS="cpu")
+        out2 = subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "bandwidth.py"),
+             "--rounds", "3", "--sizes", "5e6,1e6", "--num-devices", "8"],
+            capture_output=True, text=True, timeout=300, env=env8).stdout
+        for name, gbps, err in rx.findall(out2):
+            if name.startswith("fused-psum"):
+                bw_psum8 = float(gbps)
+    except Exception as e:
+        print("bandwidth bench failed: %r" % e, file=sys.stderr)
+
     imgs_per_sec = batch / dt
     peak = _peak_for(dev)
     # MFU only against a known accelerator peak: CPU runs and unlisted
@@ -288,6 +317,16 @@ def main():
     if pipe_jpeg_f32:
         # r3's measurement for continuity (host-side float conversion)
         result["pipeline_jpeg_f32_images_per_sec"] = round(pipe_jpeg_f32, 2)
+    if bw_kv is not None:
+        # per-key push/pull on this bench device (the reference's
+        # kvstore-bandwidth acceptance metric; on one chip this measures
+        # the device-local store path, not a cross-device reduce)
+        result["kvstore_push_pull_gbps"] = round(bw_kv, 2)
+        result["kvstore_bandwidth_max_err"] = bw_err
+    if bw_psum8 is not None:
+        # compiled psum over the 8-device VIRTUAL cpu mesh (host-memory
+        # bound on this 1-core harness; on a real pod this path rides ICI)
+        result["allreduce_gbps_virtual8"] = round(bw_psum8, 3)
     print(json.dumps(result))
 
 

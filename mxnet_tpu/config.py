@@ -55,6 +55,15 @@ VARIABLES = {v.name: v for v in [
          "Arrays at least this large log a hint when pushed through the "
          "per-key kvstore veneer instead of the fused sharded step "
          "(the reference sharded such arrays across servers)."),
+    _Var("MXNET_KVSTORE_HEARTBEAT_INTERVAL", float, 0.0,
+         "Seconds between distributed-worker heartbeats (0 = off).  When "
+         "on, a worker that misses MXNET_KVSTORE_HEARTBEAT_MISS beats is "
+         "declared dead and every peer fail-stop aborts instead of "
+         "hanging in the next collective (the ps-lite heartbeat analog, "
+         "kvstore_dist.h:112-117; exposed via get_num_dead_node)."),
+    _Var("MXNET_KVSTORE_HEARTBEAT_MISS", int, 5,
+         "Missed-beat threshold before a distributed worker is declared "
+         "dead by the heartbeat watchdog."),
     _Var("MXNET_ENFORCE_DETERMINISM", bool, False,
          "Fold a fixed seed into stochastic ops when no seed was set "
          "(reference MXNET_ENFORCE_DETERMINISM)."),

@@ -287,7 +287,31 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _arg_vals(self):
-        return tuple(self.arg_dict[n]._data for n in self.arg_names)
+        return tuple(self._as_graph_value(self.arg_dict[n], n)
+                     for n in self.arg_names)
+
+    def _as_graph_value(self, arr, name):
+        """Dense args flow as jax arrays; sparse NDArrays flow as their
+        compressed pytree (FComputeEx dispatch — sparse-aware ops consume
+        them, others densify at the op boundary)."""
+        from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+        from .ops.sparse_vals import CSRValue, RSPValue
+        if isinstance(arr, CSRNDArray):
+            if self._grad_req.get(name, "null") != "null":
+                raise MXNetError(
+                    "grad_req must be null for csr argument %r" % name)
+            return CSRValue(arr._aux["data"]._data,
+                            arr._aux["indices"]._data.astype("int32"),
+                            arr._aux["indptr"]._data.astype("int32"),
+                            arr.shape)
+        if isinstance(arr, RowSparseNDArray):
+            if self._grad_req.get(name, "null") != "null":
+                raise MXNetError(
+                    "grad_req must be null for row_sparse argument %r" % name)
+            return RSPValue(arr._aux["data"]._data,
+                            arr._aux["indices"]._data.astype("int32"),
+                            arr.shape)
+        return arr._data
 
     def _aux_vals(self):
         return tuple(self.aux_dict[n]._data for n in self.aux_names)

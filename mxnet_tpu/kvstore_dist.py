@@ -22,7 +22,11 @@ Env contract (launch.py sets these; DMLC_* names kept for CLI compat):
 """
 from __future__ import annotations
 
+import atexit
+import logging
 import os
+import threading
+import time
 
 from .base import MXNetError
 from .kvstore import KVStore
@@ -31,6 +35,113 @@ from .ndarray import NDArray
 __all__ = ["KVStoreDist", "init_distributed"]
 
 _initialized = False
+
+
+class _Heartbeat(object):
+    """Worker failure detector over the jax.distributed coordination KV.
+
+    Reference: src/kvstore/kvstore_dist.h:112-117 — ps-lite heartbeats let
+    the scheduler detect dead nodes.  Collectives have no server to notice
+    a death: a killed worker leaves every peer BLOCKED inside the
+    allreduce forever.  This watchdog gives the fail-stop the docs promise:
+    each worker publishes a sequence of heartbeat keys; one checker thread
+    per peer waits for the next expected key with a bounded timeout and, on
+    a miss without a clean-shutdown marker, records the peer dead and
+    aborts the process (os._exit) so the job fails loudly instead of
+    hanging.  Enabled by MXNET_KVSTORE_HEARTBEAT_INTERVAL > 0.
+    """
+
+    def __init__(self, rank, size, interval, miss_limit=5, fail_stop=True):
+        from jax._src import distributed as _jaxdist
+        self._client = _jaxdist.global_state.client
+        self._rank = rank
+        self._size = size
+        self._interval = interval
+        self._miss = miss_limit
+        self._fail_stop = fail_stop
+        self.dead = set()
+        self._stop = threading.Event()
+        self._threads = []
+        t = threading.Thread(target=self._beat, daemon=True,
+                             name="kv-heartbeat")
+        t.start()
+        self._threads.append(t)
+        for peer in range(size):
+            if peer == rank:
+                continue
+            t = threading.Thread(target=self._watch, args=(peer,),
+                                 daemon=True, name="kv-watch-%d" % peer)
+            t.start()
+            self._threads.append(t)
+        atexit.register(self.close)
+
+    def _key(self, rank, seq):
+        return "mxkv_hb/%d/%d" % (rank, seq)
+
+    def _beat(self):
+        # retire beats older than the declare-dead window (+ bring-up
+        # grace) so the coordinator KV store stays bounded for the life of
+        # a multi-day job; watchers never lag that far behind a live peer
+        keep = max(4 * self._miss, int(60.0 / self._interval)) + 4
+        seq = 0
+        while not self._stop.is_set():
+            try:
+                self._client.key_value_set(self._key(self._rank, seq), "1")
+                if seq >= keep:
+                    try:
+                        self._client.key_value_delete(
+                            self._key(self._rank, seq - keep))
+                    except Exception:
+                        pass
+            except Exception:
+                return
+            seq += 1
+            self._stop.wait(self._interval)
+
+    def _watch(self, peer):
+        # short wait slices so this thread notices _stop within ~1s —
+        # a thread parked in a long native wait at interpreter shutdown
+        # aborts the process ("FATAL: exception not rethrown")
+        seq = 0
+        window = self._miss * self._interval
+        slice_ms = max(100, int(min(1.0, self._interval) * 1000))
+        deadline = time.monotonic() + max(window, 30.0)  # grace for bring-up
+        while not self._stop.is_set():
+            try:
+                self._client.blocking_key_value_get(self._key(peer, seq),
+                                                    slice_ms)
+                seq += 1
+                deadline = time.monotonic() + window
+                continue
+            except Exception:
+                if self._stop.is_set():
+                    return
+                try:  # clean shutdown marker?
+                    self._client.blocking_key_value_get(
+                        "mxkv_hb/%d/done" % peer, 50)
+                    return  # peer exited cleanly
+                except Exception:
+                    pass
+                if time.monotonic() < deadline:
+                    continue
+                self.dead.add(peer)
+                logging.error(
+                    "kvstore heartbeat: worker %d missed %d beats — "
+                    "declaring it dead; fail-stop abort", peer, self._miss)
+                if self._fail_stop:
+                    os._exit(42)
+                return
+
+    def close(self):
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        try:
+            self._client.key_value_set("mxkv_hb/%d/done" % self._rank, "1")
+        except Exception:
+            pass
+        for t in self._threads:
+            t.join(timeout=3.0)
 
 
 def init_distributed():
@@ -62,11 +173,27 @@ class KVStoreDist(KVStore):
         self._size = jax.process_count() if self._multi else 1
         self._psum_cache = {}
         self._mesh = None
+        self._heartbeat = None
         if self._multi:
             import numpy as np
             from jax.sharding import Mesh
             devs = np.array(jax.devices())
             self._mesh = Mesh(devs.reshape(self._size, -1), ("proc", "local"))
+            from . import config
+            interval = config.get("MXNET_KVSTORE_HEARTBEAT_INTERVAL")
+            if interval > 0:
+                self._heartbeat = _Heartbeat(
+                    self._rank, self._size, interval,
+                    miss_limit=config.get("MXNET_KVSTORE_HEARTBEAT_MISS"))
+
+    def get_num_dead_node(self, node_id=0):
+        """Real failure detection when the heartbeat watchdog is on
+        (MXNET_KVSTORE_HEARTBEAT_INTERVAL > 0); otherwise the fail-stop
+        contract of the base class holds (a hung/dead peer aborts the
+        job)."""
+        if self._heartbeat is not None:
+            return len(self._heartbeat.dead)
+        return super().get_num_dead_node(node_id)
 
     @property
     def rank(self):
@@ -108,6 +235,18 @@ class KVStoreDist(KVStore):
         if not self._multi:
             return merged
         from .ndarray.ndarray import _wrap
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(merged, RowSparseNDArray):
+            # cross-process rsp reduce: collectives need static shapes, so
+            # the WIRE is dense (an O(rows*cols) allreduce — a compressed
+            # variable-nnz union over DCN is future work), but the result
+            # re-compresses before the updater so the rsp lazy-update
+            # semantics (only touched rows move) stay IDENTICAL to the
+            # single-process path.  Note: a row summing exactly to zero
+            # across workers drops out of the union, like the reference's
+            # server-side retain of nonzero rows.
+            dense = self._allreduce(merged.tostype("default")._data)
+            return _wrap(dense, merged.context).tostype("row_sparse")
         return _wrap(self._allreduce(merged._data), merged._ctx)
 
     def init(self, key, value):
@@ -117,9 +256,17 @@ class KVStoreDist(KVStore):
         # when seeds differ, so ship rank0's values
         if self._multi:
             from jax.experimental import multihost_utils
+            from .ndarray.sparse import BaseSparseNDArray
             for k in (key if isinstance(key, (list, tuple)) else [key]):
                 v = self._store[k]
-                v._data = multihost_utils.broadcast_one_to_all(v._data)
+                if isinstance(v, BaseSparseNDArray):
+                    # broadcast the compressed aux arrays; the dense _data
+                    # setter is (rightly) forbidden on sparse storage
+                    for name, arr in v._aux.items():
+                        arr._data = multihost_utils.broadcast_one_to_all(
+                            arr._data)
+                else:
+                    v._data = multihost_utils.broadcast_one_to_all(v._data)
 
     def barrier(self):
         if self._multi:

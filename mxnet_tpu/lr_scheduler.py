@@ -43,11 +43,10 @@ class FactorScheduler(LRScheduler):
             self.base_lr *= self.factor
             if self.base_lr < self.stop_factor_lr:
                 self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e, "
-                             "will not change in the future", num_update,
-                             self.base_lr)
+                logging.info("lr floor reached at update %d: %0.5e (held "
+                             "from here on)", num_update, self.base_lr)
             else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
+                logging.info("lr decayed at update %d -> %0.5e",
                              num_update, self.base_lr)
         return self.base_lr
 
@@ -76,7 +75,7 @@ class MultiFactorScheduler(LRScheduler):
                 self.count = self.step[self.cur_step_ind]
                 self.cur_step_ind += 1
                 self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
+                logging.info("lr milestone hit at update %d -> %0.5e",
                              num_update, self.base_lr)
             else:
                 return self.base_lr

@@ -14,9 +14,10 @@ through the standard executor path.
 from .. import symbol as sym
 
 
-def _conv_act(data, name, num_filter, kernel, stride=(1, 1), pad=(0, 0)):
+def _conv_act(data, name, num_filter, kernel, stride=(1, 1), pad=(0, 0),
+              dilate=(1, 1)):
     c = sym.Convolution(data, name=name, num_filter=num_filter,
-                        kernel=kernel, stride=stride, pad=pad)
+                        kernel=kernel, stride=stride, pad=pad, dilate=dilate)
     return sym.Activation(c, act_type="relu", name=name + "_relu")
 
 
@@ -29,7 +30,11 @@ def _vgg16_reduced(data):
         for i in range(n_convs):
             x = _conv_act(x, "conv%d_%d" % (blk + 1, i + 1), nf,
                           (3, 3), pad=(1, 1))
+        # pool3 uses ceil-mode ("full") in the reference
+        # (vgg16_reduced.py:59): 75 -> 38, keeping relu4_3 at 38x38 for a
+        # 300x300 input
         x = sym.Pooling(x, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                        pooling_convention="full" if blk == 2 else "valid",
                         name="pool%d" % (blk + 1))
     for i in range(3):
         x = _conv_act(x, "conv4_%d" % (i + 1), 512, (3, 3), pad=(1, 1))
@@ -40,7 +45,9 @@ def _vgg16_reduced(data):
         x = _conv_act(x, "conv5_%d" % (i + 1), 512, (3, 3), pad=(1, 1))
     x = sym.Pooling(x, pool_type="max", kernel=(3, 3), stride=(1, 1),
                     pad=(1, 1), name="pool5")
-    x = _conv_act(x, "fc6", 1024, (3, 3), pad=(6, 6))  # dilation folded out
+    # atrous fc6: dilate=(6,6) like the reference (vgg16_reduced.py:87) —
+    # keeps fc7 at 19x19 for a 300x300 input (anchor-geometry parity)
+    x = _conv_act(x, "fc6", 1024, (3, 3), pad=(6, 6), dilate=(6, 6))
     relu7 = _conv_act(x, "fc7", 1024, (1, 1))
     return [relu4_3, relu7]
 

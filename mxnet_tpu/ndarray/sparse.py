@@ -78,6 +78,11 @@ class BaseSparseNDArray(NDArray):
         aux["data"] = aux["data"].astype(dtype)
         return self.__class__._from_aux(aux, self._shape)
 
+    def copy(self):
+        # stays compressed (the dense-NDArray copy would materialize)
+        aux = {k: v.copy() for k, v in self._aux.items()}
+        return self.__class__._from_aux(aux, self._shape)
+
     def copyto(self, other):
         return self._dense().copyto(other)
 

@@ -131,9 +131,13 @@ class _CustomOpDef(OpDef):
     def infer(self, attrs, in_shapes, in_dtypes):
         prop = self._make_prop(attrs)
         in_s, out_s, _aux = prop.infer_shape([list(s) for s in in_shapes])
-        dt = in_dtypes[0] if in_dtypes and in_dtypes[0] is not None \
-            else np.float32
-        _, out_t, _ = prop.infer_type([dt] * len(in_s))
+        # real per-input dtypes (float32 fallback per unknown slot) — a
+        # single broadcast dtype made mixed-dtype custom ops infer types
+        # that disagreed with the runtime path (ADVICE r3)
+        dts = [in_dtypes[i] if in_dtypes and i < len(in_dtypes)
+               and in_dtypes[i] is not None else np.float32
+               for i in range(len(in_s))]
+        _, out_t, _ = prop.infer_type(dts)
         return ([tuple(s) for s in in_s], [tuple(s) for s in out_s],
                 list(out_t))
 

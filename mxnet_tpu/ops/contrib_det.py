@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register, P
+from ..base import MXNetError
 
 _BIG_NEG = -1e9
 
@@ -289,6 +290,12 @@ def multibox_detection(attrs, cls_prob, loc_pred, anchor):
     invalid rows have class_id -1.  Greedy order matches the reference
     (score-descending, earlier box suppresses later).
     """
+    if attrs["background_id"] != 0:
+        # the reference accepts the param but its kernel hardcodes class 0
+        # as background (multibox_detection.cc:120 `id - 1` with the scan
+        # starting at j=1); silently mis-scoring classes would be worse
+        raise MXNetError("MultiBoxDetection: background_id != 0 is not "
+                         "supported (the reference kernel hardcodes 0)")
     anchors = anchor.reshape(-1, 4).astype(jnp.float32)
     variances = tuple(float(v) for v in attrs["variances"])
     f = lambda cp, lp: _detect_one(

@@ -69,18 +69,34 @@ def _ctc_single(logp, labels, in_len, lab_len, blank):
 
 @register("_contrib_CTCLoss", aliases=["contrib_CTCLoss", "CTCLoss",
                                        "ctc_loss"],
-          nin=2, input_names=["data", "label"],
+          nin=lambda attrs: (2 + bool((attrs or {}).get("use_data_lengths"))
+                             + bool((attrs or {}).get("use_label_lengths"))),
+          # the optional length operands keep their own names regardless of
+          # which subset is enabled (label_lengths may be input #3)
+          input_names=lambda attrs: (
+              ["data", "label"]
+              + (["data_lengths"]
+                 if (attrs or {}).get("use_data_lengths") else [])
+              + (["label_lengths"]
+                 if (attrs or {}).get("use_label_lengths") else [])),
           params={"use_data_lengths": P(bool, False),
                   "use_label_lengths": P(bool, False),
                   "blank_label": P(str, "first",
                                    choices=["first", "last"])})
-def ctc_loss(attrs, data, label):
+def ctc_loss(attrs, data, label, *lengths):
     """Connectionist temporal classification loss (ctc_loss.cc:127).
 
     data: (T, B, C) unnormalized activations (softmax applied inside,
     like the reference's warp-ctc); label: (B, L) padded with 0
-    (blank_label='first') or -1 ('last').  Output: (B,) losses.
+    (blank_label='first') or -1 ('last').  With use_data_lengths /
+    use_label_lengths, extra (B,) inputs give the true sequence / label
+    lengths (ctc_loss.cc nin 2-4).  Output: (B,) losses.
     """
+    # optional length operands appear in reference order: data_lengths
+    # first (if used), then label_lengths
+    lengths = list(lengths)
+    data_lengths = lengths.pop(0) if attrs["use_data_lengths"] else None
+    label_lengths = lengths.pop(0) if attrs["use_label_lengths"] else None
     T, B, C = data.shape
     logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=2)
     lab = label.astype(jnp.int32)
@@ -94,8 +110,17 @@ def ctc_loss(attrs, data, label):
         pad = -1
         ids = lab
         lab_valid = lab != pad
-    lab_len = lab_valid.sum(axis=1)
-    in_len = jnp.full((B,), T, jnp.int32)
+    if label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+        # padding-derived validity may undercount when labels legitimately
+        # contain the pad value inside the given length; trust the lengths
+        lab_valid = jnp.arange(lab.shape[1])[None, :] < lab_len[:, None]
+    else:
+        lab_len = lab_valid.sum(axis=1)
+    if data_lengths is not None:
+        in_len = data_lengths.astype(jnp.int32)
+    else:
+        in_len = jnp.full((B,), T, jnp.int32)
     # compact labels to the front (padding may be interleaved only at the
     # tail per the reference contract, so a stable sort by validity keeps
     # order)

@@ -69,7 +69,9 @@ def gamma_fn(attrs, x):
     return sign * mag
 
 
-@register("_copy", aliases=["identity"])
+# _CrossDeviceCopy (src/operator/cross_device_copy.cc): a device-boundary
+# copy node — placement is XLA's job here, so it is the identity
+@register("_copy", aliases=["identity", "_CrossDeviceCopy"])
 def _copy(attrs, x):
     return x
 

@@ -12,10 +12,20 @@ from jax import lax
 from .registry import register, P
 
 
-@register("dot", nin=2, input_names=["lhs", "rhs"],
+@register("dot", nin=2, input_names=["lhs", "rhs"], sparse_aware=True,
           params={"transpose_a": P(bool, False), "transpose_b": P(bool, False),
                   "forward_stype": P("str_or_none", None)})
 def dot(attrs, a, b):
+    # stype dispatch (dot.cc:31 FComputeEx): csr x dense stays O(nnz);
+    # other sparse combinations fall back to dense like the reference's
+    # storage-fallback executor
+    from .sparse_vals import CSRValue, densify
+    if isinstance(a, CSRValue) and not hasattr(b, "todense") \
+            and not attrs["transpose_b"]:
+        from .sparse_ops import csr_dot_dense
+        return csr_dot_dense(a, b, transpose_a=attrs["transpose_a"])
+    a = densify(a)
+    b = densify(b)
     if attrs["transpose_a"]:
         a = jnp.moveaxis(a, 0, -1) if a.ndim > 2 else a.T
     if attrs["transpose_b"]:

@@ -215,7 +215,8 @@ def _conv_dims(attrs, ndim):
     return k, stride, dilate, [(p, p) for p in pad]
 
 
-@register("Convolution", aliases=["convolution"],
+@register("Convolution", aliases=["convolution", "Convolution_v1",
+                                  "convolution_v1"],
           nin=lambda attrs: 2 if (attrs or {}).get("no_bias") else 3,
           input_names=["data", "weight", "bias"], fill_shapes=_conv_fill,
           params=_CONV_PARAMS)
@@ -292,7 +293,7 @@ def deconvolution(attrs, data, weight, bias=None):
 # Pooling
 # ---------------------------------------------------------------------------
 
-@register("Pooling", aliases=["pooling"],
+@register("Pooling", aliases=["pooling", "Pooling_v1", "pooling_v1"],
           params={"kernel": P("shape", ()), "stride": P("shape", ()),
                   "pad": P("shape", ()),
                   "pool_type": P(str, "max", choices=["max", "avg", "sum"]),
@@ -476,7 +477,8 @@ def _batch_norm_impl(attrs, data, gamma, beta, mov_mean, mov_var):
 # (out, batch_mean, batch_var, new_moving_mean, new_moving_var) — nout=3
 # graph outputs + 2 aux write-backs; imperative callers see `out` only,
 # or all three with output_mean_var=true (batch_norm.cc:408 semantics).
-register("BatchNorm", aliases=["batch_norm", "BatchNorm_v1", "batch_norm_v1"],
+register("BatchNorm", aliases=["batch_norm", "BatchNorm_v1", "batch_norm_v1",
+                               "CuDNNBatchNorm"],
          nin=5, input_names=["data", "gamma", "beta", "moving_mean", "moving_var"],
          aux_inputs=(3, 4), nout=3,
          num_visible_outputs=lambda attrs: 3 if (attrs or {}).get("output_mean_var") else 1,
@@ -653,6 +655,62 @@ def bn_stem_conv(attrs, data, gamma, beta, weight, mov_mean, mov_var):
     inv = lax.rsqrt(mov_var.astype(jnp.float32) + attrs["eps"])
     bn = _bn_stem_norm(cfg, data, beta, mean, inv)
     return _bn_stem_conv(cfg, bn, weight), mov_mean, mov_var
+
+
+# ---------------------------------------------------------------------------
+# IdentityAttachKLSparseReg — identity with a KL sparsity penalty gradient
+# ---------------------------------------------------------------------------
+# Reference: src/operator/identity_attach_KL_sparse_reg-inl.h — forward is
+# identity over (N, C) activations; backward adds the KL(rho || rho_hat)
+# derivative penalty*(-rho/ma + (1-rho)/(1-ma)) where ma is a momentum
+# moving average of the per-unit batch mean.  The reference updates ma
+# during Backward and treats it as a CONSTANT in the gradient (a
+# semi-gradient); here the functional equivalent computes the updated ma in
+# forward (it depends only on data), writes it back as an aux, and the
+# custom VJP uses it behind stop_gradient.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _kl_sparse_identity(cfg, data, ma_new):
+    return data
+
+
+def _kl_sparse_fwd(cfg, data, ma_new):
+    return data, ma_new
+
+
+def _kl_sparse_bwd(cfg, ma_new, dy):
+    rho, penalty = cfg
+    term = penalty * (-rho / ma_new + (1.0 - rho) / (1.0 - ma_new))
+    return (dy + term[None, :].astype(dy.dtype),
+            jnp.zeros_like(ma_new))
+
+
+_kl_sparse_identity.defvjp(_kl_sparse_fwd, _kl_sparse_bwd)
+
+
+@register("IdentityAttachKLSparseReg",
+          aliases=["identity_attach_kl_sparse_reg"],
+          nin=2, input_names=["data", "moving_avg"],
+          aux_inputs=(1,), nout=1, mutate_aux={1: 1}, mode_dependent=True,
+          fill_shapes=lambda attrs, s: [
+              s[0], (s[0][1],) if s[0] and len(s) > 1 and s[1] is None
+              else (s[1] if len(s) > 1 else None)],
+          params={"sparseness_target": P(float, 0.1),
+                  "penalty": P(float, 0.001),
+                  "momentum": P(float, 0.9)})
+def identity_attach_kl_sparse_reg(attrs, data, moving_avg):
+    if data.ndim != 2:
+        raise MXNetError("IdentityAttachKLSparseReg expects 2D (batch, "
+                         "hidden) data like the reference")
+    training = attrs.get("_training", False)
+    if not training:
+        return data, moving_avg
+    m = attrs["momentum"]
+    avg = jnp.mean(data.astype(jnp.float32), axis=0)
+    ma_new = lax.stop_gradient(m * moving_avg + (1 - m) * avg)
+    out = _kl_sparse_identity(
+        (attrs["sparseness_target"], attrs["penalty"]), data, ma_new)
+    return out, ma_new
 
 
 @register("InstanceNorm", aliases=["instance_norm"],

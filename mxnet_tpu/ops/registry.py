@@ -41,7 +41,7 @@ class OpDef:
                  input_names=None, variable_inputs=False, stochastic=False,
                  mode_dependent=False, mutate_aux=None, fill_shapes=None,
                  num_visible_outputs=None, key_var_num_args=None,
-                 aux_inputs=(), doc=""):
+                 aux_inputs=(), sparse_aware=False, doc=""):
         self.name = name
         self.impl = impl
         self.params = params or {}
@@ -59,6 +59,10 @@ class OpDef:
         # indices of inputs that are auxiliary state (not arguments/learnable;
         # cf. NNVM FMutateInputs + symbol list_auxiliary_states)
         self.aux_inputs = tuple(aux_inputs)
+        # FComputeEx analog: sparse-aware impls receive CSRValue/RSPValue
+        # pytrees; all other ops see densified inputs (the reference's
+        # storage-fallback executor, attach_op_execs_pass.cc:49)
+        self.sparse_aware = sparse_aware
         self.doc = doc or (impl.__doc__ or "")
         self._jit_cache = {}
 
@@ -74,6 +78,8 @@ class OpDef:
             n = int(n or 0)
             return ["arg%d" % i for i in range(n)]
         if self.input_names_spec is not None:
+            if callable(self.input_names_spec):
+                return list(self.input_names_spec(attrs))
             names = list(self.input_names_spec)
             n = self.nin(attrs) if callable(self.nin) else self.nin
             if isinstance(n, int) and 0 < n <= len(names):
@@ -96,6 +102,9 @@ class OpDef:
             a = dict(attrs)
             if opdef.mode_dependent:
                 a["_training"] = training
+            if not opdef.sparse_aware:
+                from .sparse_vals import densify
+                jax_inputs = tuple(densify(x) for x in jax_inputs)
             out = opdef.impl(a, *jax_inputs)
             if not isinstance(out, tuple):
                 out = (out,)

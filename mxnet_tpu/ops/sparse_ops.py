@@ -1,0 +1,128 @@
+"""Sparse-storage ops through the registry — the FComputeEx dispatch path.
+
+Reference: src/operator/tensor/cast_storage.cc:33, sparse_retain.cc:33,
+square_sum.cc, dot.cc:31 (sparse kernels selected by input stype).
+
+Capacity semantics (TPU/XLA): nnz inside a jit graph is a STATIC capacity.
+`cast_storage` to csr/rsp uses capacity = full logical size (a format op —
+compute O(size), like any dense->sparse pass must be); sparse values bound
+from CSRNDArray/RowSparseNDArray executor inputs carry their actual nnz as
+the capacity.  Padded slots hold data 0 (csr) / index -1 (rsp), making
+them arithmetic no-ops in every kernel below.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register, P
+from .sparse_vals import CSRValue, RSPValue, densify, is_sparse
+from ..base import MXNetError
+
+
+@register("cast_storage", aliases=["CastStorage"], sparse_aware=True,
+          params={"stype": P(str, "default",
+                             choices=["default", "row_sparse", "csr"])})
+def cast_storage(attrs, data):
+    """Convert between dense / row_sparse / csr storage
+    (cast_storage.cc:33)."""
+    stype = attrs["stype"]
+    if stype == "default":
+        return densify(data)
+    dense = densify(data)
+    if dense.ndim != 2 and stype == "csr":
+        raise MXNetError("cast_storage to csr needs 2D data")
+    if stype == "csr":
+        rows, cols = dense.shape
+        mask = (dense != 0).reshape(-1)
+        # stable sort nonzeros-first in row-major order IS csr order
+        order = jnp.argsort(~mask, stable=True)
+        vals = dense.reshape(-1)[order] * mask[order]
+        col_ids = (order % cols).astype(jnp.int32)
+        nnz_per_row = jnp.sum((dense != 0), axis=1)
+        indptr = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                  jnp.cumsum(nnz_per_row).astype(jnp.int32)])
+        # zero out padded tail cols so row_ids clipping stays harmless
+        col_ids = jnp.where(mask[order], col_ids, 0)
+        return CSRValue(vals, col_ids, indptr, dense.shape)
+    # row_sparse: compact nonzero rows to the front
+    row_mask = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
+    order = jnp.argsort(~row_mask, stable=True)
+    data_rows = dense[order] * row_mask[order].reshape(
+        (-1,) + (1,) * (dense.ndim - 1))
+    indices = jnp.where(row_mask[order], order, -1).astype(jnp.int32)
+    return RSPValue(data_rows, indices, dense.shape)
+
+
+@register("_sparse_retain", aliases=["sparse_retain"], nin=2,
+          input_names=["data", "indices"], sparse_aware=True)
+def sparse_retain(attrs, data, indices):
+    """Keep only the given rows of a row_sparse array
+    (sparse_retain.cc:33).  indices must be in ascending order, like the
+    reference requires."""
+    if not isinstance(data, RSPValue):
+        raise MXNetError("_sparse_retain expects row_sparse data")
+    idx = indices.astype(jnp.int32).reshape(-1)
+    src = jnp.where(data.indices >= 0, data.indices,
+                    jnp.iinfo(jnp.int32).max)  # padding sorts to the end
+    order = jnp.argsort(src)
+    src_sorted = src[order]
+    rows_sorted = data.data[order]
+    pos = jnp.searchsorted(src_sorted, idx)
+    pos_c = jnp.clip(pos, 0, src_sorted.shape[0] - 1)
+    match = src_sorted[pos_c] == idx
+    out_rows = jnp.where(
+        match.reshape((-1,) + (1,) * (data.data.ndim - 1)),
+        rows_sorted[pos_c], 0)
+    # output indices are exactly the requested rows; absent rows are zero
+    return RSPValue(out_rows, idx, data.shape)
+
+
+@register("_square_sum", aliases=["square_sum"], sparse_aware=True,
+          params={"axis": P("shape", ()), "keepdims": P(bool, False),
+                  "exclude": P(bool, False)})
+def square_sum(attrs, data):
+    """sum(square(x)) with O(nnz) work on row_sparse input
+    (square_sum.cc); axis=1 on rsp yields rsp output like the
+    reference."""
+    ax = tuple(attrs["axis"]) if attrs["axis"] else None
+    keep = attrs["keepdims"]
+    if isinstance(data, RSPValue):
+        sq = jnp.square(data.data)
+        valid = (data.indices >= 0).reshape(
+            (-1,) + (1,) * (data.data.ndim - 1))
+        sq = jnp.where(valid, sq, 0)
+        if ax == (1,):
+            rows = jnp.sum(sq, axis=tuple(range(1, sq.ndim)))
+            if keep:
+                return RSPValue(rows[:, None], data.indices,
+                                (data.shape[0], 1))
+            # dense vector output (scatter O(nnz))
+            out = jnp.zeros((data.shape[0],), sq.dtype)
+            safe = jnp.clip(data.indices, 0, data.shape[0] - 1)
+            return out.at[safe].add(jnp.where(data.indices >= 0, rows, 0))
+        total = jnp.sum(sq)
+        if ax is None:
+            return total.reshape((1,) * data.ndim) if keep else total
+        dense = densify(data)  # remaining axis patterns: fall back
+        return jnp.sum(jnp.square(dense), axis=ax, keepdims=keep)
+    dense = densify(data)
+    return jnp.sum(jnp.square(dense), axis=ax, keepdims=keep)
+
+
+def csr_dot_dense(csr, rhs, transpose_a=False):
+    """O(nnz * cols) sparse-dense matmul on the padded-csr value.
+    Supports 2-D rhs (matrix) and 1-D rhs (matrix-vector, reference
+    dot.cc csr x dense vector)."""
+    vec = rhs.ndim == 1
+    if vec:
+        rhs = rhs[:, None]
+    row_ids = csr.row_ids()
+    cols = jnp.clip(csr.indices, 0, csr.shape[1] - 1)
+    if not transpose_a:
+        contrib = csr.data[:, None] * rhs[cols]          # (nnz, N)
+        out = jax.ops.segment_sum(contrib, row_ids,
+                                  num_segments=csr.shape[0])
+    else:
+        contrib = csr.data[:, None] * rhs[row_ids]       # (nnz, N)
+        out = jax.ops.segment_sum(contrib, cols,
+                                  num_segments=csr.shape[1])
+    return out[:, 0] if vec else out

@@ -1,0 +1,112 @@
+"""In-graph sparse values — the FComputeEx analog for the XLA executor.
+
+Reference: the reference dispatches ops on storage type via FComputeEx
+(src/operator/tensor/cast_storage.cc:33, dot.cc:31, sparse_retain.cc:33)
+and densifies through a storage-fallback executor when an op has no sparse
+kernel (src/executor/attach_op_execs_pass.cc:49).
+
+TPU-native design: a sparse value inside the jit-traced graph is a JAX
+pytree with STATIC capacity (XLA needs static shapes; nnz is a compile-time
+capacity, padded entries carry value 0 / index -1 so they are arithmetic
+no-ops).  Sparse-aware ops (registered with ``sparse_aware=True``) receive
+these pytrees; every other op sees ``densify()``-ed inputs through one
+central hook in OpDef.bound — the storage-fallback semantic, in one line.
+
+CSRValue: data[cap], indices[cap] (col ids), indptr[rows+1], static shape.
+RSPValue: data[cap, *row_shape], indices[cap] (row ids, -1 = padding).
+"""
+from jax.tree_util import register_pytree_node
+
+__all__ = ["CSRValue", "RSPValue", "densify", "is_sparse"]
+
+
+class CSRValue:
+    """Compressed-sparse-row matrix value (static capacity)."""
+
+    def __init__(self, data, indices, indptr, shape):
+        self.data = data
+        self.indices = indices
+        self.indptr = indptr
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def ndim(self):
+        return 2
+
+    def todense(self):
+        import jax.numpy as jnp
+        rows, cols = self._shape
+        nnz = self.data.shape[0]
+        row_ids = jnp.clip(
+            jnp.searchsorted(self.indptr, jnp.arange(nnz), side="right") - 1,
+            0, rows - 1)
+        flat = jnp.zeros((rows * cols,), self.data.dtype)
+        pos = row_ids * cols + jnp.clip(self.indices, 0, cols - 1)
+        # padded entries carry data 0: scatter-add is a no-op for them
+        return flat.at[pos].add(self.data).reshape(rows, cols)
+
+    def row_ids(self):
+        """Row id per stored entry (derived from indptr)."""
+        import jax.numpy as jnp
+        nnz = self.data.shape[0]
+        return jnp.clip(
+            jnp.searchsorted(self.indptr, jnp.arange(nnz), side="right") - 1,
+            0, self._shape[0] - 1)
+
+
+class RSPValue:
+    """Row-sparse value: a compacted stack of rows + their row ids
+    (index -1 marks a padding slot; its data rows are zero)."""
+
+    def __init__(self, data, indices, shape):
+        self.data = data
+        self.indices = indices
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def todense(self):
+        import jax.numpy as jnp
+        out = jnp.zeros(self._shape, self.data.dtype)
+        safe = jnp.clip(self.indices, 0, self._shape[0] - 1)
+        valid = (self.indices >= 0)
+        data = jnp.where(
+            valid.reshape((-1,) + (1,) * (self.data.ndim - 1)),
+            self.data, 0)
+        return out.at[safe].add(data)
+
+
+register_pytree_node(
+    CSRValue,
+    lambda v: ((v.data, v.indices, v.indptr), v._shape),
+    lambda shape, leaves: CSRValue(leaves[0], leaves[1], leaves[2], shape))
+register_pytree_node(
+    RSPValue,
+    lambda v: ((v.data, v.indices), v._shape),
+    lambda shape, leaves: RSPValue(leaves[0], leaves[1], shape))
+
+
+def is_sparse(v):
+    return isinstance(v, (CSRValue, RSPValue))
+
+
+def densify(v):
+    return v.todense() if is_sparse(v) else v

@@ -159,6 +159,39 @@ class Optimizer(object):
 register = Optimizer.register  # pylint: disable=invalid-name
 
 
+def _rsp_grad_rows(grad, rescale, clip):
+    """Host-side (index, rows) view of a row_sparse gradient with
+    rescale/clip applied — the preamble every rsp kernel shares."""
+    import numpy as _np
+    idx = _np.asarray(grad._aux["indices"]._data).astype(_np.int64)
+    rows = _np.asarray(grad._aux["data"]._data).astype(_np.float32) * rescale
+    if clip:
+        rows = _np.clip(rows, -clip, clip)
+    return idx, rows
+
+
+def _rsp_sgd_update(weight, grad, mom, momentum, lr, wd, rescale, clip):
+    """Row-sparse sgd(_mom)_update with the reference's lazy_update
+    semantics: ONLY rows present in the gradient touch the weight and the
+    momentum (src/operator/optimizer_op.cc sgd rsp kernels) — O(nnz)."""
+    import numpy as _np
+    import jax.numpy as jnp
+    idx, rows = _rsp_grad_rows(grad, rescale, clip)
+    w = weight._data
+    w_rows = _np.asarray(w[idx]).astype(_np.float32)
+    g = rows + wd * w_rows
+    if mom is not None:
+        m_rows = _np.asarray(mom._data[idx]).astype(_np.float32)
+        m_rows = momentum * m_rows - lr * g
+        mom._data = mom._data.at[jnp.asarray(idx)].set(
+            jnp.asarray(m_rows, mom._data.dtype))
+        w_new = w_rows + m_rows
+    else:
+        w_new = w_rows - lr * g
+    weight._data = w.at[jnp.asarray(idx)].set(
+        jnp.asarray(w_new, w.dtype))
+
+
 @register
 class SGD(Optimizer):
     """SGD with momentum and optional fp16 multi-precision master weights.
@@ -194,6 +227,12 @@ class SGD(Optimizer):
         kwargs = self._common_attrs(index)
         if self.momentum > 0:
             kwargs["momentum"] = self.momentum
+        if getattr(grad, "stype", "default") == "row_sparse" \
+                and not isinstance(state, (list, tuple)):
+            _rsp_sgd_update(weight, grad, state, self.momentum,
+                            kwargs["lr"], kwargs["wd"], self.rescale_grad,
+                            self.clip_gradient)
+            return
         use_mp = isinstance(state, (list, tuple))
         if not use_mp:
             if state is not None:
@@ -376,6 +415,29 @@ class Adam(Optimizer):
         coef2 = 1. - self.beta2 ** t
         kwargs["lr"] *= math.sqrt(coef2) / coef1
         mean, var = state
+        if getattr(grad, "stype", "default") == "row_sparse":
+            # rsp lazy_update (optimizer_op.cc adam rsp kernel): only the
+            # gradient's rows touch mean/var/weight — O(nnz)
+            import numpy as _np
+            import jax.numpy as jnp
+            idx, rows = _rsp_grad_rows(grad, self.rescale_grad,
+                                       self.clip_gradient)
+            w = weight._data
+            w_rows = _np.asarray(w[idx]).astype(_np.float32)
+            g = rows + kwargs["wd"] * w_rows
+            m_rows = _np.asarray(mean._data[idx]).astype(_np.float32)
+            v_rows = _np.asarray(var._data[idx]).astype(_np.float32)
+            m_rows = self.beta1 * m_rows + (1 - self.beta1) * g
+            v_rows = self.beta2 * v_rows + (1 - self.beta2) * g * g
+            w_new = w_rows - kwargs["lr"] * m_rows / (
+                _np.sqrt(v_rows) + self.epsilon)
+            ji = jnp.asarray(idx)
+            mean._data = mean._data.at[ji].set(
+                jnp.asarray(m_rows, mean._data.dtype))
+            var._data = var._data.at[ji].set(
+                jnp.asarray(v_rows, var._data.dtype))
+            weight._data = w.at[ji].set(jnp.asarray(w_new, w.dtype))
+            return
         adam_update(weight, grad, mean, var, out=weight, **kwargs)
 
 

@@ -94,12 +94,47 @@ class BaseRNNCell(object):
 
     # -- weight (un)packing: reference fused<->unfused layout -------------
     def unpack_weights(self, args):
-        """Split fused parameter blobs into per-gate entries (identity for
-        already-unfused cells)."""
-        return dict(args)
+        """Split this cell's stacked-gate i2h/h2h weight+bias into per-gate
+        entries (reference BaseRNNCell.unpack_weights): lstm_i2h_weight of
+        shape (4H, C) becomes lstm_i2h_i_weight ... each (H, C).  Identity
+        for cells without gates."""
+        args = dict(args)
+        if not self._gate_names:
+            return args
+        import numpy as np
+        from ..ndarray import array as _nd_array
+        h = self._num_hidden
+        for group in ("i2h", "h2h"):
+            for kind in ("weight", "bias"):
+                name = "%s%s_%s" % (self._prefix, group, kind)
+                if name not in args:
+                    continue
+                blob = np.asarray(args.pop(name).asnumpy())
+                for j, gate in enumerate(self._gate_names):
+                    args["%s%s%s_%s" % (self._prefix, group, gate, kind)] = \
+                        _nd_array(blob[j * h:(j + 1) * h].copy())
+        return args
 
     def pack_weights(self, args):
-        return dict(args)
+        """Inverse of unpack_weights: stack per-gate entries back into the
+        cell's fused i2h/h2h blobs."""
+        args = dict(args)
+        if not self._gate_names:
+            return args
+        import numpy as np
+        from ..ndarray import array as _nd_array
+        for group in ("i2h", "h2h"):
+            for kind in ("weight", "bias"):
+                gate0 = "%s%s%s_%s" % (self._prefix, group,
+                                       self._gate_names[0], kind)
+                if gate0 not in args:
+                    continue
+                parts = [np.asarray(
+                    args.pop("%s%s%s_%s" % (self._prefix, group, g, kind))
+                    .asnumpy()) for g in self._gate_names]
+                args["%s%s_%s" % (self._prefix, group, kind)] = \
+                    _nd_array(np.concatenate(parts, axis=0))
+        return args
 
     def unroll(self, length, inputs=None, begin_state=None,
                input_prefix="", layout="NTC", merge_outputs=None):
@@ -192,9 +227,17 @@ class LSTMCell(BaseRNNCell):
         self._counter += 1
         name = "%st%d_" % (self._prefix, self._counter)
         h = self._num_hidden
-        i2h = sym.FullyConnected(inputs, weight=self.params.get("i2h_weight"),
-                                 bias=self.params.get("i2h_bias"),
-                                 num_hidden=h * 4, name=name + "i2h")
+        # forget_bias lives in the i2h_bias INITIAL VALUE (init=LSTMBias,
+        # reference rnn_cell.py:429), NOT as a graph constant — adding it
+        # in-graph would double-apply it when restoring a reference-trained
+        # checkpoint or any params initialized with LSTMBias
+        from .. import initializer as _init
+        i2h = sym.FullyConnected(
+            inputs, weight=self.params.get("i2h_weight"),
+            bias=self.params.get(
+                "i2h_bias",
+                init=_init.LSTMBias(forget_bias=self._forget_bias)),
+            num_hidden=h * 4, name=name + "i2h")
         h2h = sym.FullyConnected(states[0],
                                  weight=self.params.get("h2h_weight"),
                                  bias=self.params.get("h2h_bias"),
@@ -202,8 +245,7 @@ class LSTMCell(BaseRNNCell):
         gates = sym.SliceChannel(i2h + h2h, num_outputs=4, axis=1,
                                  name=name + "slice")
         in_gate = sym.Activation(gates[0], act_type="sigmoid")
-        forget_gate = sym.Activation(gates[1] + self._forget_bias,
-                                     act_type="sigmoid")
+        forget_gate = sym.Activation(gates[1], act_type="sigmoid")
         in_trans = sym.Activation(gates[2], act_type="tanh")
         out_gate = sym.Activation(gates[3], act_type="sigmoid")
         next_c = forget_gate * states[1] + in_gate * in_trans
@@ -438,6 +480,93 @@ class FusedRNNCell(BaseRNNCell):
         self._mode = mode
         self._bidi = bidirectional
         self._dropout = dropout
+        from .. import initializer as _init
+        self._parameter = self.params.get(
+            "parameters", init=_init.FusedRNN(
+                None, num_hidden, num_layers, mode, bidirectional,
+                forget_bias))
+
+    @property
+    def _gate_names(self):
+        return {"rnn_relu": ("",), "rnn_tanh": ("",),
+                "lstm": ("_i", "_f", "_c", "_o"),
+                "gru": ("_r", "_z", "_o")}[self._mode]
+
+    @property
+    def _directions(self):
+        return ["l", "r"] if self._bidi else ["l"]
+
+    def _blob_entries(self, num_input):
+        """Per-gate (name, shape, offset) table for the packed blob, derived
+        from the RNN op's own layout so the two can never drift."""
+        from ..ops.rnn import _param_layout
+        entries, total = _param_layout(self._mode, num_input,
+                                       self._num_hidden, self._num_layers,
+                                       self._bidi)
+        h = self._num_hidden
+        out = []
+        for kind, layer, direction, shape, off in entries:
+            group = kind.split("_")[0]                     # i2h / h2h
+            is_bias = kind.endswith("bias")
+            cols = 1 if is_bias else shape[1]
+            for j, gate in enumerate(self._gate_names):
+                name = "%s%s%d_%s%s_%s" % (
+                    self._prefix, self._directions[direction], layer, group,
+                    gate, "bias" if is_bias else "weight")
+                gshape = (h,) if is_bias else (h, cols)
+                out.append((name, gshape, off + j * h * cols))
+        return out, total
+
+    def _infer_num_input(self, blob_size):
+        """Invert the packed-blob size formula for the layer-0 input width."""
+        d = len(self._directions)
+        m = len(self._gate_names)
+        h = self._num_hidden
+        rest = blob_size - self._num_layers * d * 2 * m * h  # biases
+        for layer in range(1, self._num_layers):
+            rest -= d * m * h * (d * h + h)
+        li = rest // (d * m * h) - h
+        if li <= 0:
+            raise MXNetError("invalid fused parameter size %d" % blob_size)
+        return li
+
+    def unpack_weights(self, args):
+        """Fused blob -> per-gate i2h/h2h entries (reference
+        FusedRNNCell.unpack_weights), so fused checkpoints restore into
+        unfused cells and vice versa (rnn/rnn.py save/load contract)."""
+        import numpy as np
+        from ..ndarray import array as _nd_array
+        args = dict(args)
+        pname = self._parameter.name
+        if pname not in args:
+            return args
+        blob = np.asarray(args.pop(pname).asnumpy()).reshape(-1)
+        entries, total = self._blob_entries(self._infer_num_input(blob.size))
+        if total != blob.size:
+            raise MXNetError("fused parameter size %d does not match the "
+                             "cell spec (expected %d)" % (blob.size, total))
+        for name, shape, off in entries:
+            n = int(np.prod(shape))
+            args[name] = _nd_array(blob[off:off + n].reshape(shape).copy())
+        return args
+
+    def pack_weights(self, args):
+        import numpy as np
+        from ..ndarray import array as _nd_array
+        args = dict(args)
+        probe = "%s%s0_i2h%s_weight" % (self._prefix, self._directions[0],
+                                        self._gate_names[0])
+        if probe not in args:
+            return args
+        num_input = args[probe].shape[1]
+        entries, total = self._blob_entries(num_input)
+        blob = np.zeros((total,), dtype=np.float32)
+        for name, shape, off in entries:
+            n = int(np.prod(shape))
+            blob[off:off + n] = np.asarray(
+                args.pop(name).asnumpy()).reshape(-1)
+        args[self._parameter.name] = _nd_array(blob)
+        return args
 
     @property
     def state_info(self):

@@ -1,0 +1,72 @@
+"""SURVEY Appendix A op-name probe (VERDICT r3 next-round item #10).
+
+Extracts every backticked op-like identifier from SURVEY.md's Appendix A
+inventory and resolves it against the registry (or the io module for
+iterator names).  Every absence must be explained in ABSENT_OK — zero
+unexplained absences.
+"""
+import re
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import get_op
+
+# documented absences: name -> reason
+ABSENT_OK = {
+    # C++ registration macros / parsing artifacts of the survey prose
+    "NNVM_REGISTER_OP": "C++ registration macro, not an op",
+    "MXNET_REGISTER_OP_PROPERTY": "C++ registration macro, not an op",
+    "MXNET_REGISTER_IO_ITER": "C++ registration macro, not an op",
+    "REGISTER_UNARY_WITH_RSP": "C++ registration macro, not an op",
+    "np": "prose artifact (numpy abbreviation)",
+    "_v1": "prose artifact (suffix fragment)",
+    # backward twins are derived by autodiff here, never registered
+    "_broadcast_backward": "backward twin: jax.vjp derives all backwards",
+    # plugin ops the reference only builds with optional deps
+    "WarpCTC": "warp-ctc PLUGIN op (reference optional build); "
+               "_contrib_CTCLoss covers the CTC surface",
+    "_NDArray": "deprecated python-callback plugin op; Custom replaces it",
+    "_Native": "deprecated python-callback plugin op; Custom replaces it",
+    # data iterators live in mx.io, checked separately below
+    "MNISTIter": "io iterator", "CSVIter": "io iterator",
+    "LibSVMIter": "io iterator", "ImageRecordIter": "io iterator",
+    "ImageRecordUInt8Iter": "io iterator",
+    "ImageDetRecordIter": "io iterator",
+    "CaffeDataIter": "Caffe-plugin iterator (reference optional build; "
+                     "no Caffe in a TPU-native stack)",
+}
+
+_ITERATORS = {"MNISTIter", "CSVIter", "LibSVMIter", "ImageRecordIter",
+              "ImageRecordUInt8Iter", "ImageDetRecordIter"}
+
+
+def _appendix_names():
+    txt = open("SURVEY.md").read()
+    ap = txt[txt.index("## Appendix A"):]
+    nxt = ap.find("\n## Appendix B")
+    if nxt > 0:
+        ap = ap[:nxt]
+    names = set()
+    for m in re.finditer(r"`([A-Za-z_][A-Za-z0-9_]*)(?::\d+)?`", ap):
+        names.add(m.group(1))
+    return sorted(names)
+
+
+def test_appendix_a_zero_unexplained_absences():
+    unexplained = []
+    for name in _appendix_names():
+        if name in ABSENT_OK:
+            continue
+        try:
+            get_op(name)
+        except Exception:
+            unexplained.append(name)
+    assert not unexplained, (
+        "Appendix A names neither registered nor documented: %s"
+        % unexplained)
+
+
+@pytest.mark.parametrize("it", sorted(_ITERATORS))
+def test_appendix_a_iterators_exist(it):
+    assert hasattr(mx.io, it), "mx.io.%s missing" % it

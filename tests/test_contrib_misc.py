@@ -43,6 +43,54 @@ def test_ctc_loss_matches_bruteforce():
         np.testing.assert_allclose(out[b], expect, rtol=1e-4, atol=1e-4)
 
 
+def test_ctc_loss_with_length_inputs():
+    """use_data_lengths/use_label_lengths (ADVICE r3): losses computed over
+    the given lengths must equal CTC on the truncated sequences."""
+    rng = np.random.default_rng(1)
+    T, B, C = 5, 2, 3
+    acts = rng.standard_normal((T, B, C)).astype(np.float32)
+    label = np.array([[1, 2, 2], [2, 1, 1]], np.float32)  # padded junk tail
+    dlen = np.array([4, 3], np.float32)
+    llen = np.array([2, 1], np.float32)
+    out = np.asarray(invoke_jax(
+        "_contrib_CTCLoss",
+        {"use_data_lengths": True, "use_label_lengths": True},
+        jnp.asarray(acts), jnp.asarray(label),
+        jnp.asarray(dlen), jnp.asarray(llen))[0])
+    logp = np.log(np.exp(acts) / np.exp(acts).sum(2, keepdims=True) + 1e-30)
+    for b in range(B):
+        lab = [int(v) for v in label[b][:int(llen[b])]]
+        expect = _ctc_brute(logp[:int(dlen[b]), b], lab, blank=0)
+        np.testing.assert_allclose(out[b], expect, rtol=1e-4, atol=1e-4)
+
+
+def test_identity_attach_kl_sparse_reg():
+    """Identity forward; backward adds penalty*(-rho/ma + (1-rho)/(1-ma))
+    with ma = momentum-updated batch mean, treated as constant (the
+    reference's semi-gradient, identity_attach_KL_sparse_reg-inl.h)."""
+    import jax
+    from mxnet_tpu.ops.registry import get_op
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0.2, 0.8, (4, 3)).astype(np.float32)
+    ma0 = np.full(3, 0.5, np.float32)
+    rho, pen, mom = 0.2, 0.01, 0.9
+    op = get_op("IdentityAttachKLSparseReg")
+    attrs = op.normalize({"sparseness_target": rho, "penalty": pen,
+                          "momentum": mom})
+    f = op.bound(attrs, training=True)
+    out, ma_new = f(jnp.asarray(x), jnp.asarray(ma0))
+    np.testing.assert_allclose(out, x)  # identity forward
+    expect_ma = mom * ma0 + (1 - mom) * x.mean(axis=0)
+    np.testing.assert_allclose(ma_new, expect_ma, rtol=1e-6)
+
+    dy = rng.standard_normal((4, 3)).astype(np.float32)
+    g = jax.grad(lambda x_: jnp.sum(f(x_, jnp.asarray(ma0))[0]
+                                    * jnp.asarray(dy)))(jnp.asarray(x))
+    # d(ma)/dx is cut: every row gets the same constant penalty term
+    term = pen * (-rho / expect_ma + (1 - rho) / (1 - expect_ma))
+    np.testing.assert_allclose(g, dy + term[None, :], rtol=1e-5, atol=1e-6)
+
+
 def test_ctc_loss_blank_last():
     rng = np.random.default_rng(1)
     T, B, C = 3, 1, 3

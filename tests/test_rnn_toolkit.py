@@ -154,4 +154,11 @@ def test_rnn_checkpoint_roundtrip(tmp_path):
     prefix = str(tmp_path / "rnnck")
     mrnn.save_rnn_checkpoint(cell, prefix, 3, outs, arg, {})
     sym2, arg2, aux2 = mrnn.load_rnn_checkpoint(cell, prefix, 3)
-    np.testing.assert_allclose(arg2["ck_i2h_weight"].asnumpy(), 1.0)
+    # reference semantics: load returns UNPACKED per-gate entries
+    assert "ck_i2h_weight" not in arg2
+    for gate in ("_i", "_f", "_c", "_o"):
+        np.testing.assert_allclose(
+            arg2["ck_i2h%s_weight" % gate].asnumpy(), 1.0)
+        assert arg2["ck_i2h%s_weight" % gate].shape == (8, 4)
+    packed = cell.pack_weights(arg2)
+    np.testing.assert_allclose(packed["ck_i2h_weight"].asnumpy(), 1.0)

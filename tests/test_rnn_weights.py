@@ -1,0 +1,122 @@
+"""Fused<->unfused RNN weight conversion (ADVICE r3 items 1-2).
+
+- LSTMCell must NOT add forget_bias in-graph: the bias lives in the
+  i2h_bias initial value (init=LSTMBias), so restoring a checkpoint (or
+  FusedRNN-initialized params) cannot double-apply it.
+- FusedRNNCell.unpack_weights/pack_weights must translate the packed blob
+  to/from per-gate i2h/h2h names so fused checkpoints restore into unfused
+  cells with IDENTICAL numerics (reference rnn_cell.py FusedRNNCell).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.rnn as mrnn
+
+
+def _run(sym_out, feeds):
+    exe = sym_out.bind(mx.cpu(), args={k: mx.nd.array(v)
+                                       for k, v in feeds.items()},
+                       grad_req={n: "null"
+                                 for n in sym_out.list_arguments()})
+    return exe.forward()[0].asnumpy()
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn_tanh"])
+def test_fused_unpacks_to_equivalent_unfused(mode):
+    T, N, C, H, L = 3, 2, 4, 5, 2
+    fused = mrnn.FusedRNNCell(H, num_layers=L, mode=mode, prefix="f_")
+    data = mx.sym.Variable("data")
+    fout, _ = fused.unroll(T, inputs=data, layout="NTC", merge_outputs=True)
+
+    rng = np.random.default_rng(0)
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    psize = rnn_param_size(mode, C, H, L, False)
+    blob = rng.standard_normal(psize).astype(np.float32) * 0.3
+    x = rng.standard_normal((N, T, C)).astype(np.float32)
+
+    feeds_f = {"data": x, "f_parameters": blob,
+               "f_state": np.zeros((L, N, H), np.float32)}
+    if mode == "lstm":
+        feeds_f["f_state_cell"] = np.zeros((L, N, H), np.float32)
+    fgot = _run(fout, feeds_f)
+
+    # unpack -> per-gate names -> pack must be the identity on the blob
+    unpacked = fused.unpack_weights(
+        {"f_parameters": mx.nd.array(blob)})
+    assert "f_parameters" not in unpacked
+    repacked = fused.pack_weights(unpacked)
+    np.testing.assert_allclose(repacked["f_parameters"].asnumpy(), blob,
+                               rtol=1e-6)
+
+    # the unfused stack fed per-gate weights must match the fused op
+    stack = fused.unfuse()
+    uout, _ = stack.unroll(T, inputs=data, merge_outputs=True)
+    cell_args = {}
+    for cell in stack._cells:
+        cell_args = cell.pack_weights(unpacked if not cell_args
+                                      else {**unpacked, **cell_args})
+    feeds = {"data": x}
+    for name in uout.list_arguments():
+        if name == "data":
+            continue
+        feeds[name] = cell_args[name].asnumpy()
+    ugot = _run(uout, feeds)
+    np.testing.assert_allclose(ugot, fgot, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_forget_bias_not_double_applied():
+    """With i2h_bias explicitly ZERO, the forget gate must see zero
+    pre-activation bias (the forget_bias lives only in the INITIAL value)."""
+    cell = mrnn.LSTMCell(4, prefix="l_", forget_bias=5.0)
+    data = mx.sym.Variable("data")
+    out, _ = cell.unroll(1, inputs=data, merge_outputs=True)
+    x = np.zeros((1, 1, 3), np.float32)
+    feeds = {"data": x,
+             "l_i2h_weight": np.zeros((16, 3), np.float32),
+             "l_i2h_bias": np.zeros(16, np.float32),
+             "l_h2h_weight": np.zeros((16, 4), np.float32),
+             "l_h2h_bias": np.zeros(16, np.float32)}
+    got = _run(out, feeds)
+    # all-zero params: every gate sigmoid(0)=0.5, tanh(0)=0 -> h = 0
+    np.testing.assert_allclose(got, 0.0, atol=1e-7)
+
+    # and the i2h_bias Variable carries init=LSTMBias(forget_bias) so
+    # default initialization recreates the bias in the INITIAL VALUE
+    from mxnet_tpu.initializer import InitDesc, Uniform, create
+    bias_attrs = out.attr_dict().get("l_i2h_bias", {})
+    assert "__init__" in bias_attrs, bias_attrs
+    arr = mx.nd.zeros((16,))
+    Uniform(0.1)(InitDesc("l_i2h_bias", attrs=bias_attrs), arr)
+    b = arr.asnumpy()
+    np.testing.assert_allclose(b[4:8], 5.0)   # forget-gate block
+    np.testing.assert_allclose(b[:4], 0.0)
+    np.testing.assert_allclose(b[8:], 0.0)
+
+
+def test_rnn_checkpoint_fused_to_unfused(tmp_path):
+    """save_rnn_checkpoint(fused) -> load with unfused stack: params arrive
+    under per-gate names and reproduce the fused output."""
+    T, N, C, H = 2, 2, 3, 4
+    fused = mrnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="s_")
+    data = mx.sym.Variable("data")
+    fout, _ = fused.unroll(T, inputs=data, layout="NTC", merge_outputs=True)
+    rng = np.random.default_rng(1)
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    blob = rng.standard_normal(
+        rnn_param_size("lstm", C, H, 1, False)).astype(np.float32) * 0.3
+    prefix = str(tmp_path / "fck")
+    mrnn.save_rnn_checkpoint(fused, prefix, 1, fout,
+                             {"s_parameters": mx.nd.array(blob)}, {})
+    # reference contract: load with the cell that SAVED it — the fused
+    # cell's unpack yields per-gate names, which unfused cells' pack
+    # reassembles (rnn/rnn.py docstring)
+    _, arg2, _ = mrnn.load_rnn_checkpoint(fused, prefix, 1)
+    assert "s_parameters" not in arg2
+    assert "s_l0_i2h_i_weight" in arg2, sorted(arg2)
+    stack = fused.unfuse()
+    cell_args = dict(arg2)
+    for cell in stack._cells:
+        cell_args = cell.pack_weights(cell_args)
+    assert "s_l0_i2h_weight" in cell_args, sorted(cell_args)
+    assert cell_args["s_l0_i2h_weight"].shape == (4 * H, C)
