@@ -170,8 +170,18 @@ class KVStore(object):
                 merged = self._compressor(k, merged)
             merged = self._reduce_global(k, merged)
             if self._updater is not None:
-                self._updater(k if isinstance(k, int) else str(k), merged,
-                              self._store[k])
+                dst = self._store[k]
+                if getattr(dst, "stype", "default") != "default":
+                    # dense grad into a sparse-stored weight: run the dense
+                    # update on a dense view, recompress after (the dense
+                    # _data setter is forbidden on sparse storage)
+                    w = dst.tostype("default")
+                    self._updater(k if isinstance(k, int) else str(k),
+                                  merged, w)
+                    self._store[k] = w.tostype(dst.stype)
+                else:
+                    self._updater(k if isinstance(k, int) else str(k),
+                                  merged, dst)
             elif getattr(self._store[k], "stype", "default") != "default":
                 # dense push into a sparse-initialized key: keep the
                 # store's storage type (the dense _data setter is

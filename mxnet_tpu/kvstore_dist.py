@@ -84,18 +84,26 @@ class _Heartbeat(object):
         # a multi-day job; watchers never lag that far behind a live peer
         keep = max(4 * self._miss, int(60.0 / self._interval)) + 4
         seq = 0
+        failures = 0
         while not self._stop.is_set():
             try:
                 self._client.key_value_set(self._key(self._rank, seq), "1")
+                failures = 0
                 if seq >= keep:
                     try:
                         self._client.key_value_delete(
                             self._key(self._rank, seq - keep))
                     except Exception:
                         pass
+                seq += 1
             except Exception:
-                return
-            seq += 1
+                # transient coordination-service hiccup must not silence a
+                # HEALTHY worker's heartbeat (peers would fail-stop a live
+                # job); retry, giving up only when persistently broken —
+                # at which point the collectives are dead anyway
+                failures += 1
+                if failures > self._miss:
+                    return
             self._stop.wait(self._interval)
 
     def _watch(self, peer):

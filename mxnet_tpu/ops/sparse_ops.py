@@ -85,6 +85,10 @@ def square_sum(attrs, data):
     reference."""
     ax = tuple(attrs["axis"]) if attrs["axis"] else None
     keep = attrs["keepdims"]
+    if attrs["exclude"] and ax is not None:
+        nd = data.ndim if not is_sparse(data) else len(data.shape)
+        ax = tuple(i for i in range(nd) if i not in
+                   tuple(a % nd for a in ax)) or None
     if isinstance(data, RSPValue):
         sq = jnp.square(data.data)
         valid = (data.indices >= 0).reshape(

@@ -14,7 +14,7 @@ Reference mapping (SURVEY §2.3):
 from .mesh import make_mesh, ShardingPlan, data_parallel_plan
 from .ring_attention import ring_attention, blockwise_attention
 from .pipeline import (pipeline_shard_map, pipeline_train_step,
-                       PipelineModule)
+                       hetero_pipeline_train_step, PipelineModule)
 
 __all__ = ["make_mesh", "ShardingPlan", "data_parallel_plan",
            "ring_attention", "blockwise_attention", "pipeline_shard_map"]

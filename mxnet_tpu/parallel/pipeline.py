@@ -143,6 +143,151 @@ def pipeline_train_step(stage_fn, loss_fn, mesh, n_microbatch,
     return step
 
 
+# ---------------------------------------------------------------------------
+# Heterogeneous stages (embed -> body -> head)
+# ---------------------------------------------------------------------------
+
+def hetero_pipeline_train_step(stage_fns, stage_params, sample_x, loss_fn,
+                               mesh, n_microbatch, axis_name="pp",
+                               optimizer=None):
+    """GPipe training step for stages with DIFFERENT params/activations
+    (VERDICT r3 item #9; green field — the reference has no PP at all).
+
+    The SPMD machinery needs one ppermute state shape and one stacked
+    param array, so heterogeneity is packed away:
+      * each stage's param pytree is raveled to a flat vector, zero-padded
+        to the longest stage, and stacked -> (P, max_params), sharded
+        P(axis) so device i holds (only) stage i's slice;
+      * activations travel as per-sample flat buffers (mb, max_act); each
+        stage unflattens its input shape, computes, re-flattens + pads;
+      * `lax.switch` on the stage index picks the stage body inside the
+        tick (every branch has the packed signature, so the switch is
+        shape-uniform by construction).
+
+    stage_fns:    [fn_j(params_j, x_j) -> y_j]  (per-stage pytrees/shapes)
+    stage_params: [params_j pytree]             initial values
+    sample_x:     ONE microbatch-shaped input (mb, ...) for stage 0 —
+                  used to trace the inter-stage shapes
+    loss_fn(y_last, labels) -> scalar
+    Returns (step, pack, unpack): step(packed, x, labels) ->
+    (loss, new_packed); pack/unpack convert [pytree] <-> the stacked flat
+    array so callers can checkpoint real per-stage params.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.flatten_util import ravel_pytree
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    n_stage = len(stage_fns)
+    assert mesh.shape[axis_name] == n_stage, \
+        "mesh axis %r has %d devices but there are %d stages" \
+        % (axis_name, mesh.shape[axis_name], n_stage)
+    if optimizer is None:
+        def optimizer(p, g):
+            return p - 0.01 * g
+
+    # --- param packing -------------------------------------------------
+    flats, unravels = [], []
+    for sp in stage_params:
+        f, un = ravel_pytree(sp)
+        flats.append(f)
+        unravels.append(un)
+    max_p = max(f.shape[0] for f in flats)
+
+    def pack(params_list):
+        rows = []
+        for sp in params_list:
+            f, _ = ravel_pytree(sp)
+            rows.append(jnp.pad(f, (0, max_p - f.shape[0])))
+        return jnp.stack(rows)
+
+    def unpack(packed):
+        return [unravels[j](packed[j, :flats[j].shape[0]])
+                for j in range(n_stage)]
+
+    # --- activation shapes: trace the chain once ------------------------
+    in_shapes = [tuple(sample_x.shape)]
+    x_spec = jax.ShapeDtypeStruct(sample_x.shape, jnp.float32)
+    for j in range(n_stage):
+        y_spec = jax.eval_shape(stage_fns[j], stage_params[j], x_spec)
+        in_shapes.append(tuple(y_spec.shape))
+        x_spec = y_spec
+    out_shape = in_shapes[-1]
+    mb = in_shapes[0][0]
+    for s in in_shapes:
+        assert s[0] == mb, "stages must preserve the microbatch dim"
+    flat_sizes = [int(np.prod(s[1:])) for s in in_shapes]
+    max_act = max(flat_sizes)
+
+    def _stage_packed(j):
+        def f(pflat, aflat):
+            params = unravels[j](pflat[:flats[j].shape[0]])
+            x = aflat[:, :flat_sizes[j]].reshape(in_shapes[j])
+            y = stage_fns[j](params, x)
+            yf = y.reshape(mb, -1)
+            return jnp.pad(yf, ((0, 0), (0, max_act - yf.shape[1])))
+        return f
+
+    branches = [_stage_packed(j) for j in range(n_stage)]
+
+    def body(pflat, xm):
+        stage = lax.axis_index(axis_name)
+        m = xm.shape[0]
+        n_ticks = m + n_stage - 1
+        perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+        outputs = jnp.zeros((m, mb, max_act), jnp.float32)
+        state = jnp.zeros((mb, max_act), jnp.float32)
+
+        def tick(carry, t):
+            state, outputs = carry
+            inject = xm[jnp.minimum(t, m - 1)]
+            state = jnp.where(stage == 0, inject, state)
+            y = lax.switch(stage, branches, pflat, state)
+            out_idx = t - (n_stage - 1)
+            valid = (stage == n_stage - 1) & (out_idx >= 0)
+            outputs = lax.cond(
+                valid,
+                lambda o: o.at[jnp.maximum(out_idx, 0)].set(y),
+                lambda o: o, outputs)
+            state = lax.ppermute(y, axis_name, perm)
+            return (state, outputs), None
+
+        (_, outputs), _ = lax.scan(tick, (state, outputs),
+                                   jnp.arange(n_ticks))
+        outputs = lax.psum(
+            jnp.where(stage == n_stage - 1, outputs,
+                      jnp.zeros_like(outputs)), axis_name)
+        return outputs
+
+    sm = shard_map(
+        lambda p, xx: body(p[0], xx),     # strip the stage axis
+        mesh=mesh, in_specs=(P(axis_name), P()), out_specs=P(),
+        check_vma=False)
+
+    def forward_loss(packed, x, labels):
+        b = x.shape[0]
+        assert b == n_microbatch * mb, \
+            "batch %d != n_microbatch %d x microbatch %d" \
+            % (b, n_microbatch, mb)
+        m = n_microbatch
+        xf = x.reshape(m, mb, -1)
+        xm = jnp.pad(xf.astype(jnp.float32),
+                     ((0, 0), (0, 0), (0, max_act - xf.shape[-1])))
+        out = sm(packed, xm)                       # (m, mb, max_act)
+        y = out[:, :, :flat_sizes[-1]].reshape((b,) + out_shape[1:])
+        return loss_fn(y, labels)
+
+    @jax.jit
+    def step(packed, x, labels):
+        loss, g = jax.value_and_grad(forward_loss)(packed, x, labels)
+        return loss, optimizer(packed, g)
+
+    return step, pack, unpack
+
+
 class PipelineModule(object):
     """Module-style training driver for a homogeneous stage pipeline.
 

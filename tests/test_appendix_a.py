@@ -5,6 +5,7 @@ inventory and resolves it against the registry (or the io module for
 iterator names).  Every absence must be explained in ABSENT_OK — zero
 unexplained absences.
 """
+import os
 import re
 
 import pytest
@@ -42,7 +43,8 @@ _ITERATORS = {"MNISTIter", "CSVIter", "LibSVMIter", "ImageRecordIter",
 
 
 def _appendix_names():
-    txt = open("SURVEY.md").read()
+    survey = os.path.join(os.path.dirname(__file__), "..", "SURVEY.md")
+    txt = open(survey).read()
     ap = txt[txt.index("## Appendix A"):]
     nxt = ap.find("\n## Appendix B")
     if nxt > 0:

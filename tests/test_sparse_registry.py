@@ -204,6 +204,26 @@ def test_kvstore_dense_push_to_rsp_key():
     np.testing.assert_allclose(out.asnumpy(), 1.0)
 
 
+def test_kvstore_dense_push_to_rsp_key_with_updater():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((4, 2)).tostype("row_sparse"))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5, wd=0.0))
+    kv.push("w", mx.nd.ones((4, 2)))
+    assert kv._store["w"].stype == "row_sparse"
+    out = mx.nd.zeros((4, 2))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.5)  # 1 - 0.5*1
+
+
+def test_square_sum_exclude():
+    rng = np.random.default_rng(8)
+    x = _rand_sparse(rng, (4, 3))
+    (rsp,) = invoke_jax("cast_storage", {"stype": "row_sparse"},
+                        jnp.asarray(x))
+    (out,) = invoke_jax("_square_sum", {"axis": (0,), "exclude": True}, rsp)
+    np.testing.assert_allclose(out, np.square(x).sum(1), rtol=1e-5)
+
+
 def test_ctc_label_lengths_only_input_names():
     op = get_op("_contrib_CTCLoss")
     names = op.input_names({"use_label_lengths": True})
