@@ -74,6 +74,12 @@ VARIABLES = {v.name: v for v in [
          "for ResNet-50 (80.2 vs 75.9 ms biased / confirms on honest "
          "protocol) because the step is HBM-bound and the dot forms fuse "
          "worse, so the default stays off; kept as a measured experiment."),
+    _Var("MXNET_CONV1X1_FUSED_BWD", bool, False,
+         "Compute a channels-last stride-1 1x1 convolution's dgrad AND "
+         "wgrad in one Pallas kernel pass over the output gradient "
+         "(XLA emits two fusions that each re-read dy from HBM; the step "
+         "is bandwidth-bound, PROFILE_r04.md).  Off by default pending "
+         "the measured verdict recorded there."),
     _Var("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
          "Accepted for API parity; execution is always one fused XLA "
          "program (the engine bulking machinery this toggled does not "

@@ -197,6 +197,96 @@ def _conv1x1_eligible(attrs, k, pad):
             and all(p == (0, 0) for p in pad))
 
 
+# --- Pallas fused 1x1-conv backward: dgrad + wgrad in ONE pass over dy ----
+#
+# XLA lowers a 1x1 conv's backward to two separate fusions — dgrad reads
+# (dy, W) and wgrad reads (dy, x) — so dy crosses HBM twice.  On a
+# bandwidth-bound step (PROFILE_r04.md) that second read is pure waste: a
+# Pallas kernel tiles over the fused batch*spatial rows, computes the dx
+# tile (dy @ W) AND accumulates the dW partial (dy^T @ x, f32) from the
+# same resident dy tile.  Gated by MXNET_CONV1X1_FUSED_BWD.
+
+_PALLAS_ROW_BLOCK = 256
+
+
+def _fused1x1_bwd_pallas(x2d, dy2d, w2d):
+    """x2d (R, Ci), dy2d (R, Co), w2d (Co, Ci) -> dx (R, Ci), dW f32."""
+    import jax.experimental.pallas as pl
+    R, ci = x2d.shape
+    co = dy2d.shape[1]
+    br = next(b for b in (2048, 1024, 512, 256) if R % b == 0)
+
+    def kernel(x_ref, dy_ref, w_ref, dx_ref, dw_ref):
+        i = pl.program_id(0)
+        dy = dy_ref[...]
+        dx_ref[...] = jnp.dot(dy, w_ref[...],
+                              preferred_element_type=jnp.float32
+                              ).astype(dx_ref.dtype)
+        part = lax.dot_general(dy, x_ref[...], (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+        @pl.when(i == 0)
+        def _init():
+            dw_ref[...] = part
+
+        @pl.when(i > 0)
+        def _acc():
+            dw_ref[...] += part
+
+    interpret = jax.devices()[0].platform != "tpu"
+    dx, dw = pl.pallas_call(
+        kernel,
+        grid=(R // br,),
+        in_specs=[pl.BlockSpec((br, ci), lambda i: (i, 0)),
+                  pl.BlockSpec((br, co), lambda i: (i, 0)),
+                  pl.BlockSpec((co, ci), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((br, ci), lambda i: (i, 0)),
+                   pl.BlockSpec((co, ci), lambda i: (0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((R, ci), x2d.dtype),
+                   jax.ShapeDtypeStruct((co, ci), jnp.float32)],
+        interpret=interpret)(x2d, dy2d, w2d)
+    return dx, dw
+
+
+@jax.custom_vjp
+def _conv1x1_fused_bwd(x, w):
+    # forward stays XLA's native conv (it was fine); only backward fuses
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=[(0, 0), (0, 0)],
+        dimension_numbers=("NHWC", "OHWI", "NHWC"),
+        preferred_element_type=x.dtype)
+
+
+def _conv1x1_fused_fwd_rule(x, w):
+    return _conv1x1_fused_bwd(x, w), (x, w)
+
+
+def _conv1x1_fused_bwd_rule(res, dy):
+    x, w = res
+    n, h, wd, ci = x.shape
+    co = w.shape[0]
+    dx2d, dw = _fused1x1_bwd_pallas(x.reshape(-1, ci), dy.reshape(-1, co),
+                                    w.reshape(co, ci))
+    return dx2d.reshape(x.shape), dw.reshape(w.shape).astype(w.dtype)
+
+
+_conv1x1_fused_bwd.defvjp(_conv1x1_fused_fwd_rule, _conv1x1_fused_bwd_rule)
+
+
+def _conv1x1_fused_eligible(attrs, k, stride, pad, data):
+    return (config.get("MXNET_CONV1X1_FUSED_BWD") and _channels_last(attrs)
+            and data.ndim == 4
+            and all(ki == 1 for ki in k)
+            and all(s == 1 for s in stride)
+            and attrs["num_group"] == 1
+            and all(p == (0, 0) for p in pad)
+            # small-spatial deep layers only: where XLA's per-fusion dy
+            # re-read hurts most and the tile grid stays short
+            and data.shape[1] * data.shape[2] <= 256
+            and (data.shape[0] * data.shape[1] * data.shape[2])
+            % _PALLAS_ROW_BLOCK == 0)
+
+
 _CONV_PARAMS = {
     "kernel": P("shape"), "stride": P("shape", ()), "dilate": P("shape", ()),
     "pad": P("shape", ()), "num_filter": P(int), "num_group": P(int, 1),
@@ -224,6 +314,11 @@ def convolution(attrs, data, weight, bias=None):
     k, stride, dilate, pad = _conv_dims(attrs, data.ndim)
     nd = data.ndim - 2
     sp = "DHW"[3 - nd:]
+    if _conv1x1_fused_eligible(attrs, k, stride, pad, data):
+        out = _conv1x1_fused_bwd(data, weight)
+        if bias is not None and not attrs["no_bias"]:
+            out = out + bias.reshape((1,) * (data.ndim - 1) + (-1,))
+        return checkpoint_name(out, CKPT_CONV)
     if _conv1x1_eligible(attrs, k, pad):
         out = _conv1x1_cl(data, weight, stride, tuple(data.shape[1:-1]))
         if bias is not None and not attrs["no_bias"]:

@@ -42,6 +42,31 @@ def test_conv1x1_dot_matches_native(monkeypatch, stride, h):
     np.testing.assert_allclose(gw_dot, gw_nat, atol=1e-4)
 
 
+def test_conv1x1_pallas_fused_bwd_matches_native(monkeypatch):
+    """MXNET_CONV1X1_FUSED_BWD (Pallas dgrad+wgrad single-pass kernel,
+    interpret mode off-TPU) must be numerically identical to the native
+    path.  Measured slower on v5e-1 (PROFILE_r04.md) — kept off by
+    default as a documented experiment."""
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((4, 8, 8, 6)), jnp.float32)  # R=256
+    w = jnp.asarray(rng.standard_normal((5, 1, 1, 6)), jnp.float32)
+    attrs = _attrs((1, 1))
+
+    def run(flag):
+        monkeypatch.setenv("MXNET_CONV1X1_FUSED_BWD", flag)
+        y = convolution(attrs, x, w)
+        g = jax.grad(
+            lambda x_, w_: jnp.sum(jnp.tanh(convolution(attrs, x_, w_))),
+            argnums=(0, 1))(x, w)
+        return y, g
+
+    y1, g1 = run("1")
+    y0, g0 = run("0")
+    np.testing.assert_allclose(y1, y0, atol=1e-5)
+    np.testing.assert_allclose(g1[0], g0[0], atol=1e-4)
+    np.testing.assert_allclose(g1[1], g0[1], atol=1e-4)
+
+
 def test_conv1x1_dot_under_jit_and_symbol(monkeypatch):
     # the eligibility gate must hold inside jit tracing (shapes abstract)
     monkeypatch.setenv("MXNET_CONV_DOT_1X1", "1")
