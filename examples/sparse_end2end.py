@@ -1,0 +1,141 @@
+"""Sparse linear-regression end-to-end — the reference's flagship sparse
+workload (benchmark/python/sparse/sparse_end2end.py) on the TPU-native
+stack.
+
+Shape of the workload, kept faithful:
+  * csr input batches (criteo-like: few active features per sample)
+  * `dot(csr, weight)` through the registered sparse kernel (O(nnz))
+  * LinearRegressionOutput head
+  * per-batch `kv.row_sparse_pull` of ONLY the rows the batch touches
+  * rsp gradient push with the kvstore-held SGD doing the reference's
+    lazy_update (only touched rows move weight/momentum) — O(nnz)
+
+TPU-tier split (PROFILE_r04.md / ops/sparse_vals.py): inside the jit
+graph the weight is dense (XLA wants static shapes; the csr x dense dot
+is O(nnz) compute), while the KVSTORE tier keeps the weight row-sparse
+and all push/pull/update traffic O(nnz) — the same split the reference
+makes between device compute and ps-lite servers.
+
+Run: python examples/sparse_end2end.py [--num-batches 50]
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def make_batches(rng, num_batches, batch_size, feature_dim, nnz_per_row):
+    """Synthetic criteo-like stream: each sample activates a few features."""
+    w_true = (rng.standard_normal(feature_dim) *
+              (rng.random(feature_dim) < 0.5)).astype(np.float32)
+    batches = []
+    for _ in range(num_batches):
+        # sample WITHOUT replacement per row: constant nnz per batch keeps
+        # one compiled executable across the stream (static shapes)
+        idx = np.stack([rng.choice(feature_dim, nnz_per_row, replace=False)
+                        for _ in range(batch_size)]).astype(np.int64)
+        val = rng.standard_normal((batch_size, nnz_per_row)) \
+            .astype(np.float32)
+        dense = np.zeros((batch_size, feature_dim), np.float32)
+        for i in range(batch_size):
+            dense[i, idx[i]] = val[i]
+        y = dense @ w_true + 0.01 * rng.standard_normal(batch_size) \
+            .astype(np.float32)
+        batches.append((mx.nd.array(dense).tostype("csr"),
+                        mx.nd.array(y.astype(np.float32)),
+                        np.unique(idx)))
+    return batches, w_true
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-batches", type=int, default=50)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--feature-dim", type=int, default=1000)
+    ap.add_argument("--nnz-per-row", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--epochs", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    rng = np.random.default_rng(0)
+    batches, w_true = make_batches(rng, args.num_batches, args.batch_size,
+                                   args.feature_dim, args.nnz_per_row)
+
+    # symbol: csr data -> sparse dot -> linear regression head
+    data = mx.sym.Variable("data", stype="csr")
+    w = mx.sym.Variable("w")
+    pred = mx.sym.dot(data, w)
+    net = mx.sym.LinearRegressionOutput(pred, name="lro")
+
+    D = args.feature_dim
+    arg_arrays = {
+        "data": batches[0][0],
+        "w": mx.nd.zeros((D, 1)),
+        "lro_label": mx.nd.zeros((args.batch_size, 1)),
+    }
+    grad_req = {"data": "null", "lro_label": "null", "w": "write"}
+    exe = net.bind(mx.cpu(), args=arg_arrays, grad_req=grad_req)
+
+    # kvstore holds the ROW-SPARSE master weight + the optimizer
+    # (update_on_kvstore, reference style)
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((D, 1)).tostype("row_sparse"))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=args.lr,
+                                         momentum=0.9, wd=1e-5))
+
+    pulled = mx.nd.zeros((D, 1)).tostype("row_sparse")
+
+    def eval_loss():
+        """Mean squared error over the whole stream with the CURRENT
+        server weight (forward only)."""
+        w_dense = mx.nd.zeros((D, 1))
+        kv.pull("w", out=w_dense)
+        exe.arg_dict["w"][:] = w_dense.asnumpy()
+        tot = 0.0
+        for csr_batch, y, _ in batches:
+            exe.arg_dict["data"] = csr_batch
+            exe.arg_dict["lro_label"][:] = y.asnumpy()[:, None]
+            (out,) = exe.forward(is_train=False)
+            tot += float(np.square(out.asnumpy()[:, 0]
+                                   - y.asnumpy()).mean())
+        return tot / len(batches)
+
+    first_loss = eval_loss()
+    t0 = time.perf_counter()
+    n_samples = 0
+    for epoch in range(args.epochs):
+        for csr_batch, y, touched in batches:
+            rows = mx.nd.array(touched.astype(np.float32))
+            # pull ONLY the touched rows from the compressed store
+            kv.row_sparse_pull("w", out=pulled, row_ids=rows)
+            wd = np.array(exe.arg_dict["w"].asnumpy(), copy=True)
+            wd[touched] = pulled.data.asnumpy()
+            exe.arg_dict["w"][:] = wd
+            exe.arg_dict["data"] = csr_batch
+            exe.arg_dict["lro_label"][:] = y.asnumpy()[:, None]
+            exe.forward(is_train=True)
+            exe.backward()
+            # compress the dense in-graph gradient to the touched rows and
+            # push O(nnz): untouched rows are exactly zero by construction
+            g = exe.grad_dict["w"].asnumpy()
+            g_rsp = mx.nd.sparse.row_sparse_array(
+                (g[touched], touched), shape=(D, 1))
+            kv.push("w", g_rsp)
+            n_samples += args.batch_size
+    dt = time.perf_counter() - t0
+    last_loss = eval_loss()
+    print("sparse_end2end: %d samples in %.2fs (%.0f samples/s), "
+          "eval mse %.4f -> %.4f, pulled stype=%s"
+          % (n_samples, dt, n_samples / dt, first_loss, last_loss,
+             pulled.stype))
+    return first_loss, last_loss
+
+
+if __name__ == "__main__":
+    main()

@@ -223,21 +223,11 @@ class KVStore(object):
             ids = rid._data.astype("int32")
             if src.stype == "row_sparse":
                 # gather requested rows from the COMPRESSED store
+                from .ndarray.sparse import gather_rsp_rows
                 src_idx = _np.asarray(src._aux["indices"]._data)
                 src_rows = _np.asarray(src._aux["data"]._data)
                 ids_np = _np.asarray(ids)
-                if len(src_idx) == 0:  # empty store: all requested rows 0
-                    rows = _np.zeros((len(ids_np),) + src.shape[1:],
-                                     src_rows.dtype)
-                else:
-                    order = _np.argsort(src_idx, kind="stable")
-                    sidx = src_idx[order]
-                    pos = _np.clip(_np.searchsorted(sidx, ids_np), 0,
-                                   len(sidx) - 1)
-                    match = sidx[pos] == ids_np
-                    rows = _np.where(
-                        match.reshape((-1,) + (1,) * (src_rows.ndim - 1)),
-                        src_rows[order][pos], 0).astype(src_rows.dtype)
+                rows = gather_rsp_rows(src_idx, src_rows, ids_np)
                 for o in olist:
                     if getattr(o, "stype", "default") == "row_sparse":
                         o._aux["indices"]._data = jnp.asarray(ids_np)

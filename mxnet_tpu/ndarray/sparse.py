@@ -180,6 +180,21 @@ class CSRNDArray(BaseSparseNDArray):
 # constructors
 # ---------------------------------------------------------------------------
 
+def gather_rsp_rows(src_idx, src_rows, ids):
+    """Numpy gather of rows `ids` from a compressed (indices, rows) pair;
+    absent rows read as zero.  The one implementation of the
+    argsort/searchsorted/match dance shared by KVStore.row_sparse_pull and
+    the optimizers' rsp lazy-update kernels."""
+    out = _np.zeros((len(ids),) + src_rows.shape[1:], src_rows.dtype)
+    if len(src_idx):
+        order = _np.argsort(src_idx, kind="stable")
+        sidx = src_idx[order]
+        pos = _np.clip(_np.searchsorted(sidx, ids), 0, len(sidx) - 1)
+        match = sidx[pos] == ids
+        out[match] = src_rows[order][pos[match]]
+    return out
+
+
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 2 and not isinstance(arg1[0], int):
         data, indices = arg1

@@ -170,26 +170,79 @@ def _rsp_grad_rows(grad, rescale, clip):
     return idx, rows
 
 
+def _gather_weight_rows(weight, idx):
+    """Rows `idx` of a dense OR row_sparse-stored array (absent rsp rows
+    read as zero), as f32 numpy."""
+    import numpy as _np
+    from .ndarray.sparse import RowSparseNDArray, gather_rsp_rows
+    if isinstance(weight, RowSparseNDArray):
+        w_idx = _np.asarray(weight.indices._data).astype(_np.int64)
+        w_rows = _np.asarray(weight.data._data)
+        return gather_rsp_rows(w_idx, w_rows, idx).astype(_np.float32)
+    return _np.asarray(weight._data[idx]).astype(_np.float32)
+
+
+def _scatter_weight_rows(weight, idx, w_new):
+    """Write updated rows back, keeping a row_sparse store COMPRESSED.
+    Steady state (all touched rows already present, indices sorted) is an
+    in-place O(grad_nnz) row write; only genuinely NEW rows pay the
+    union-rebuild."""
+    import numpy as _np
+    import jax.numpy as jnp
+    from .ndarray.sparse import RowSparseNDArray, row_sparse_array
+    if isinstance(weight, RowSparseNDArray):
+        store_dtype = weight.data._data.dtype
+        w_idx = _np.asarray(weight.indices._data).astype(_np.int64)
+        if len(w_idx) and _np.all(w_idx[:-1] <= w_idx[1:]):
+            pos = _np.clip(_np.searchsorted(w_idx, idx), 0, len(w_idx) - 1)
+            if _np.array_equal(w_idx[pos], idx):
+                weight.data._data = weight.data._data.at[
+                    jnp.asarray(pos)].set(jnp.asarray(w_new, store_dtype))
+                return
+        w_rows = _np.asarray(weight.data._data)
+        union = _np.union1d(w_idx, idx)
+        merged = _np.zeros((len(union),) + w_new.shape[1:], store_dtype)
+        if len(w_idx):
+            merged[_np.searchsorted(union, w_idx)] = w_rows
+        merged[_np.searchsorted(union, idx)] = w_new.astype(store_dtype)
+        fresh = row_sparse_array((merged, union), shape=weight.shape,
+                                 dtype=store_dtype)
+        weight._aux = fresh._aux
+        return
+    weight._data = weight._data.at[jnp.asarray(idx)].set(
+        jnp.asarray(w_new, weight._data.dtype))
+
+
 def _rsp_sgd_update(weight, grad, mom, momentum, lr, wd, rescale, clip):
     """Row-sparse sgd(_mom)_update with the reference's lazy_update
     semantics: ONLY rows present in the gradient touch the weight and the
-    momentum (src/operator/optimizer_op.cc sgd rsp kernels) — O(nnz)."""
-    import numpy as _np
-    import jax.numpy as jnp
+    momentum (src/operator/optimizer_op.cc sgd rsp kernels) — O(nnz).
+    Works against dense- or rsp-stored weights (the kvstore keeps master
+    weights compressed)."""
     idx, rows = _rsp_grad_rows(grad, rescale, clip)
-    w = weight._data
-    w_rows = _np.asarray(w[idx]).astype(_np.float32)
+    w_rows = _gather_weight_rows(weight, idx)
     g = rows + wd * w_rows
     if mom is not None:
-        m_rows = _np.asarray(mom._data[idx]).astype(_np.float32)
-        m_rows = momentum * m_rows - lr * g
-        mom._data = mom._data.at[jnp.asarray(idx)].set(
-            jnp.asarray(m_rows, mom._data.dtype))
+        m_rows = momentum * _gather_weight_rows(mom, idx) - lr * g
+        _scatter_weight_rows(mom, idx, m_rows)
         w_new = w_rows + m_rows
     else:
         w_new = w_rows - lr * g
-    weight._data = w.at[jnp.asarray(idx)].set(
-        jnp.asarray(w_new, w.dtype))
+    _scatter_weight_rows(weight, idx, w_new)
+
+
+def _state_like(weight):
+    """Optimizer-state array matching the weight's STORAGE: rsp-stored
+    weights get an (initially empty) rsp state so a compressed embedding
+    server never allocates O(rows) dense state (reference lazy_update
+    keeps server state sparse too)."""
+    import numpy as _np
+    if getattr(weight, "stype", "default") == "row_sparse":
+        from .ndarray.sparse import row_sparse_array
+        return row_sparse_array(
+            (_np.zeros((0,) + weight.shape[1:], _np.float32),
+             _np.zeros((0,), _np.int64)), shape=weight.shape)
+    return zeros(weight.shape, weight.context, dtype=weight.dtype)
 
 
 @register
@@ -219,7 +272,7 @@ class SGD(Optimizer):
                             "using multi_precision=True option of the SGD "
                             "optimizer")
         if self.momentum != 0.0:
-            momentum = zeros(weight.shape, weight.context, dtype=weight.dtype)
+            momentum = _state_like(weight)
         return momentum
 
     def update(self, index, weight, grad, state):
@@ -401,8 +454,8 @@ class Adam(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # mean
-                zeros(weight.shape, weight.context, dtype=weight.dtype))  # var
+        return (_state_like(weight),   # mean
+                _state_like(weight))   # var
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -422,21 +475,17 @@ class Adam(Optimizer):
             import jax.numpy as jnp
             idx, rows = _rsp_grad_rows(grad, self.rescale_grad,
                                        self.clip_gradient)
-            w = weight._data
-            w_rows = _np.asarray(w[idx]).astype(_np.float32)
+            w_rows = _gather_weight_rows(weight, idx)
             g = rows + kwargs["wd"] * w_rows
-            m_rows = _np.asarray(mean._data[idx]).astype(_np.float32)
-            v_rows = _np.asarray(var._data[idx]).astype(_np.float32)
-            m_rows = self.beta1 * m_rows + (1 - self.beta1) * g
-            v_rows = self.beta2 * v_rows + (1 - self.beta2) * g * g
+            m_rows = (self.beta1 * _gather_weight_rows(mean, idx)
+                      + (1 - self.beta1) * g)
+            v_rows = (self.beta2 * _gather_weight_rows(var, idx)
+                      + (1 - self.beta2) * g * g)
             w_new = w_rows - kwargs["lr"] * m_rows / (
                 _np.sqrt(v_rows) + self.epsilon)
-            ji = jnp.asarray(idx)
-            mean._data = mean._data.at[ji].set(
-                jnp.asarray(m_rows, mean._data.dtype))
-            var._data = var._data.at[ji].set(
-                jnp.asarray(v_rows, var._data.dtype))
-            weight._data = w.at[ji].set(jnp.asarray(w_new, w.dtype))
+            _scatter_weight_rows(mean, idx, m_rows)
+            _scatter_weight_rows(var, idx, v_rows)
+            _scatter_weight_rows(weight, idx, w_new)
             return
         adam_update(weight, grad, mean, var, out=weight, **kwargs)
 

@@ -8,6 +8,8 @@ combining SparseEmbedding + sparse dot that trains end-to-end with a csr
 input bound through the executor, the kvstore rsp paths that must never
 densify, and the optimizers' rsp lazy-update kernels.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -228,6 +230,24 @@ def test_ctc_label_lengths_only_input_names():
     op = get_op("_contrib_CTCLoss")
     names = op.input_names({"use_label_lengths": True})
     assert names == ["data", "label", "label_lengths"], names
+
+
+def test_sparse_end2end_example_converges():
+    """The reference's flagship sparse workload, end to end: csr batches ->
+    sparse dot -> regression head, with O(nnz) kvstore row_sparse
+    pull/push/update and the store staying compressed throughout
+    (examples/sparse_end2end.py mirrors
+    benchmark/python/sparse/sparse_end2end.py)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "sparse_end2end.py")
+    spec = importlib.util.spec_from_file_location("sparse_end2end", path)
+    modx = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(modx)
+    first, last = modx.main(["--num-batches", "8", "--epochs", "2",
+                             "--feature-dim", "200", "--batch-size", "16",
+                             "--nnz-per-row", "6"])
+    assert last < first * 0.6, (first, last)
 
 
 @pytest.mark.parametrize("opt_name,extra", [
