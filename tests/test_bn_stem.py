@@ -6,6 +6,8 @@ composition: forward output, conv-weight gradient, bn beta gradient
 moving-stat writebacks — across strides/pads/odd sizes that stress the
 per-tap valid-range arithmetic, in both layouts.
 """
+import zlib
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -100,9 +102,82 @@ def test_fused_eval_mode_matches():
     np.testing.assert_allclose(out_f, out_u, rtol=2e-5, atol=2e-5)
 
 
+def _dbeta_f64_reference(g, weight, stride, pad, in_hw):
+    """Ground-truth dbeta = sum_m d(conv input)[m] in float64 (numpy),
+    derived from the raw window arithmetic (independent of the op's
+    _stem_valid_range): tap (kh, kw) contributes at output (oh, ow) iff
+    the tapped input position oh*s + kh - pad lies inside the image."""
+    gsum = np.asarray(g, np.float64).sum(axis=0)               # (OH, OW, O)
+    gh, gw = gsum.shape[0], gsum.shape[1]
+    kh_dim, kw_dim = weight.shape[1], weight.shape[2]
+    wf = np.asarray(weight, np.float64)                        # (O, KH, KW, I)
+    oh_idx = np.arange(gh)
+    ow_idx = np.arange(gw)
+    dbeta = np.zeros(weight.shape[-1], np.float64)
+    for kh in range(kh_dim):
+        vh = (oh_idx * stride[0] + kh - pad[0] >= 0) \
+            & (oh_idx * stride[0] + kh - pad[0] < in_hw[0])
+        for kw in range(kw_dim):
+            vw = (ow_idx * stride[1] + kw - pad[1] >= 0) \
+                & (ow_idx * stride[1] + kw - pad[1] < in_hw[1])
+            rect = gsum[vh][:, vw].sum(axis=(0, 1))             # (O,)
+            dbeta += rect @ wf[:, kh, kw, :]
+    return dbeta
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_stem_dbeta_224(seed):
+    """The rectangle-sum dbeta at the real ResNet stem shape (224^2, k7 s2
+    p3, 64 filters), 20 independent draws.  Error model: the fused path and
+    the unfused dgrad-conv path are two f32 summation orders of the same
+    f64 quantity; each must sit within a small multiple of f32 resolution
+    of the f64 ground truth, scaled by the summand magnitude
+    sum |gsum| * |W| that bounds any summation order's error."""
+    rng = np.random.default_rng(1000 + seed)
+    n, h, w, c, o, k = 2, 224, 224, 3, 64, 7
+    stride, pad = (2, 2), (3, 3)
+    data = jnp.asarray(rng.standard_normal((n, h, w, c)) * 2 + 1, jnp.float32)
+    weight = jnp.asarray(rng.standard_normal((o, k, k, c)) * 0.1, jnp.float32)
+    beta = jnp.asarray(rng.standard_normal(c), jnp.float32)
+    gamma = jnp.ones((c,), jnp.float32)
+    oh = (h + 2 * pad[0] - k) // stride[0] + 1
+    g = jnp.asarray(rng.standard_normal((n, oh, oh, o)), jnp.float32)
+
+    def run(fn):
+        out, vjp = jax.vjp(lambda b: fn(b)[0], beta)
+        return vjp(g)[0]
+
+    db_f = np.asarray(run(lambda b: _fused(
+        data, gamma, b, weight, 2e-5, stride, pad, True)))
+    db_u = np.asarray(run(lambda b: _unfused(
+        data, b, weight, 2e-5, stride, pad, True)))
+    ref = _dbeta_f64_reference(g, weight, stride, pad, (h, w))
+    # scale of any f32 summation of this quantity: magnitude of the summed
+    # terms (not of the cancelled result)
+    scale = float(np.abs(np.asarray(g, np.float64).sum(0)).sum()
+                  * np.abs(np.asarray(weight, np.float64)).max())
+    tol = 64 * np.finfo(np.float32).eps * scale
+    assert np.max(np.abs(db_f - ref)) < tol, (np.abs(db_f - ref).max(), tol)
+    assert np.max(np.abs(db_u - ref)) < tol, (np.abs(db_u - ref).max(), tol)
+    # and the two f32 paths agree with each other to the same budget
+    np.testing.assert_allclose(db_f, db_u, atol=2 * tol, rtol=0)
+
+
 def test_resnet_fused_stem_symbol_matches_default():
     """get_resnet_symbol(stem='fused') trains like the standard graph:
-    identical loss+grads on the shared parameter names."""
+    identical loss+grads on the shared parameter names.
+
+    Init is seeded-deterministic (crc32, not hash()) on purpose: the r4
+    flake was draw-dependent, and scanning draws shows why — fused and std
+    are two different XLA programs whose stem outputs differ by last-bit
+    rounding, and the 3x3/s2 maxpool after the stem ReLU re-routes its
+    gradient wherever two positive window entries are within rounding of a
+    tie (~1 draw in 10 at this size), flipping upstream grads
+    macroscopically.  That is kink amplification inherent to comparing any
+    two rounding-different programs, not an error in either one; op-level
+    numerics are proven against an f64 reference across 20 draws at 224^2
+    in test_stem_dbeta_224.  (The `data` gradient is excluded by the op's
+    documented contract: grad_req null, fused path returns zeros.)"""
     from mxnet_tpu.models import get_resnet_symbol
     rng = np.random.RandomState(0)
     kw = dict(num_classes=10, num_layers=18, image_shape=(3, 40, 40),
@@ -118,7 +193,7 @@ def test_resnet_fused_stem_symbol_matches_default():
     for name, arr in exe["std"].arg_dict.items():
         if name in ("data", "softmax_label"):
             continue
-        init[name] = np.random.RandomState(abs(hash(name)) % 2**31) \
+        init[name] = np.random.RandomState(zlib.crc32(name.encode()) % 2**31) \
             .uniform(-0.1, 0.1, arr.shape).astype(np.float32)
     data = rng.uniform(0, 1, shapes["data"]).astype(np.float32)
     label = rng.randint(0, 10, (batch,)).astype(np.float32)
