@@ -153,7 +153,12 @@ class _Heartbeat(object):
 
 
 def init_distributed():
-    """Join the jax.distributed job described by the env (idempotent)."""
+    """Join the jax.distributed job described by the env (idempotent).
+
+    Raises instead of degrading: a worker that silently comes up as a
+    1-process job would train standalone while the launcher believes it is
+    aggregating — fail-stop is the only safe behavior.
+    """
     global _initialized
     if _initialized:
         return True
@@ -162,10 +167,30 @@ def init_distributed():
     n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
     if uri is None or n <= 1:
         return False
+    # JAX_PLATFORMS in the env is not always enough: with an accelerator
+    # plugin installed, jax.distributed.initialize can take the plugin's
+    # bootstrap path and come up as a 1-process job unless the platform is
+    # pinned through jax.config first (observed with the axon TPU tunnel:
+    # env-only workers joined as n=1, config-pinned workers joined as n=2).
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
     port = os.environ.get("DMLC_PS_ROOT_PORT", "9000")
     pid = int(os.environ.get("DMLC_WORKER_ID", "0"))
     jax.distributed.initialize(coordinator_address="%s:%s" % (uri, port),
                                num_processes=n, process_id=pid)
+    got = jax.process_count()
+    if got != n:
+        # tear down before raising so a caller that catches and retries
+        # sees this message again, not 'already initialized'
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 - the raise below is the story
+            pass
+        raise MXNetError(
+            "jax.distributed came up with %d processes but the launcher "
+            "promised DMLC_NUM_WORKER=%d — refusing to run a silently "
+            "degraded 'distributed' job" % (got, n))
     _initialized = True
     return True
 

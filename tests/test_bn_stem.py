@@ -163,6 +163,62 @@ def test_stem_dbeta_224(seed):
     np.testing.assert_allclose(db_f, db_u, atol=2 * tol, rtol=0)
 
 
+def _assert_pool_windows_tie_free(data, init, rel_margin=1e-6):
+    """Recompute the stem -> maxpool input in f64 numpy and assert every
+    3x3/s2 pooling window's top-2 gap clears `rel_margin` of the global
+    activation scale.  This is the guard that makes the pinned init draw
+    in the symbol test self-checking: if an XLA/backend change ever shifts
+    the draw onto a near-tie (the mechanism behind the r4 flake), this
+    fails with an actionable message instead of a mystery grad mismatch.
+    Windows whose max is <= 0 are exact ReLU-zero ties, routed identically
+    by both programs, and are exempt.
+
+    Margin rationale: cross-program f32 rounding differences at the pool
+    input are ~1e-7 relative (f32 eps 1.2e-7, short accumulation chains);
+    a scan of 12 draws showed min window gaps from 3e-8 (the flaky kind)
+    to 6e-6 relative — ties at rel gap <= 1e-5 occur in EVERY draw among
+    the ~25k correlated windows, so demanding more margin than ~1e-6 is
+    statistically impossible and unnecessary.  The pinned draw (seed
+    offset +8) clears 1e-6 by 6x."""
+    eps = 2e-5
+    x = np.asarray(data, np.float64)                            # (N,H,W,3)
+    mean = x.mean(axis=(0, 1, 2))
+    var = x.var(axis=(0, 1, 2))
+    xb = (x - mean) / np.sqrt(var + eps) + init["bn_data_beta"].astype(np.float64)
+    w = init["conv0_weight"].astype(np.float64)                 # (64,7,7,3) OHWI
+    n, h, _, _ = x.shape
+    pad, k, s = 3, 7, 2
+    oh = (h + 2 * pad - k) // s + 1
+    xp = np.pad(xb, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    conv = np.zeros((n, oh, oh, w.shape[0]))
+    for kh in range(k):
+        for kw in range(k):
+            patch = xp[:, kh:kh + s * oh:s, kw:kw + s * oh:s, :]
+            conv += np.einsum("nhwc,oc->nhwo", patch, w[:, kh, kw, :])
+    m0 = conv.mean(axis=(0, 1, 2))
+    v0 = conv.var(axis=(0, 1, 2))
+    act = (conv - m0) / np.sqrt(v0 + eps)
+    act = act * init["bn0_gamma"].astype(np.float64) \
+        + init["bn0_beta"].astype(np.float64)
+    act = np.maximum(act, 0.0)                                  # ReLU
+    # 3x3/s2 pad1 maxpool windows: top-2 gap per window
+    ap = np.pad(act, ((0, 0), (1, 1), (1, 1), (0, 0)),
+                constant_values=-np.inf)
+    po = (oh + 2 - 3) // 2 + 1
+    vals = np.stack([ap[:, i:i + 2 * po:2, j:j + 2 * po:2, :]
+                     for i in range(3) for j in range(3)], axis=0)
+    vals = np.sort(vals, axis=0)
+    top1, top2 = vals[-1], vals[-2]
+    gap = top1 - top2
+    scale = np.abs(act).max()
+    risky = (top1 > 0) & (gap < rel_margin * scale)
+    assert not risky.any(), (
+        "the pinned init draw landed %d maxpool window(s) within %.0e of a "
+        "tie (min gap %.3e, scale %.3e): the fused-vs-std comparison would "
+        "be rounding-sensitive. Bump the crc32 seed offset in this test." %
+        (int(risky.sum()), rel_margin, float(gap[top1 > 0].min()), scale))
+
+
 def test_resnet_fused_stem_symbol_matches_default():
     """get_resnet_symbol(stem='fused') trains like the standard graph:
     identical loss+grads on the shared parameter names.
@@ -193,10 +249,14 @@ def test_resnet_fused_stem_symbol_matches_default():
     for name, arr in exe["std"].arg_dict.items():
         if name in ("data", "softmax_label"):
             continue
-        init[name] = np.random.RandomState(zlib.crc32(name.encode()) % 2**31) \
+        # +8: the first tie-free seed offset (see _assert_pool_windows_
+        # tie_free margin rationale); offsets 0-7 land closer to a pool tie
+        init[name] = np.random.RandomState(
+            (zlib.crc32(name.encode()) + 8) % 2**31) \
             .uniform(-0.1, 0.1, arr.shape).astype(np.float32)
     data = rng.uniform(0, 1, shapes["data"]).astype(np.float32)
     label = rng.randint(0, 10, (batch,)).astype(np.float32)
+    _assert_pool_windows_tie_free(data, init)
     outs = {}
     grads = {}
     for tag, ex in exe.items():
