@@ -1,20 +1,23 @@
 """Sparse linear-regression end-to-end — the reference's flagship sparse
 workload (benchmark/python/sparse/sparse_end2end.py) on the TPU-native
-stack.
+stack, O(nnz) at EVERY tier:
 
-Shape of the workload, kept faithful:
-  * csr input batches (criteo-like: few active features per sample)
-  * `dot(csr, weight)` through the registered sparse kernel (O(nnz))
-  * LinearRegressionOutput head
-  * per-batch `kv.row_sparse_pull` of ONLY the rows the batch touches
-  * rsp gradient push with the kvstore-held SGD doing the reference's
-    lazy_update (only touched rows move weight/momentum) — O(nnz)
+  * csr input batches (criteo-like: few active features per sample),
+    built directly in csr form — no dense (batch, feature_dim) staging
+  * the weight lives ROW-SPARSE everywhere: the kvstore holds the
+    compressed master copy, the device holds only the rows the current
+    batch touches (a static-capacity RSPValue inside the jit graph), and
+    `dot(csr, w_rsp)` gathers stored rows by id — the dense
+    (feature_dim, 1) matrix never exists, host or device
+  * the executor emits a ROW-SPARSE gradient (grad_stype inference,
+    executor._resolve_grad_storage): jax.vjp over the RSPValue pytree
+    produces the O(nnz) cotangent directly; `kv.push` of that rsp grad
+    and the kvstore-held SGD's lazy_update keep update+comm O(nnz)
 
-TPU-tier split (PROFILE_r04.md / ops/sparse_vals.py): inside the jit
-graph the weight is dense (XLA wants static shapes; the csr x dense dot
-is O(nnz) compute), while the KVSTORE tier keeps the weight row-sparse
-and all push/pull/update traffic O(nnz) — the same split the reference
-makes between device compute and ps-lite servers.
+This mirrors the reference's split (device compute / ps-lite servers kept
+sparse, indexing_op.cc SparseEmbeddingOpBackwardRsp +
+kvstore_dist_server.h rsp path), with XLA's static-shape constraint met
+by padding each batch's touched-row list to one fixed capacity.
 
 Run: python examples/sparse_end2end.py [--num-batches 50]
 """
@@ -31,26 +34,38 @@ import mxnet_tpu as mx  # noqa: E402
 
 
 def make_batches(rng, num_batches, batch_size, feature_dim, nnz_per_row):
-    """Synthetic criteo-like stream: each sample activates a few features."""
+    """Synthetic criteo-like stream, built directly as csr (no dense
+    (batch, feature_dim) staging array)."""
     w_true = (rng.standard_normal(feature_dim) *
               (rng.random(feature_dim) < 0.5)).astype(np.float32)
     batches = []
     for _ in range(num_batches):
         # sample WITHOUT replacement per row: constant nnz per batch keeps
         # one compiled executable across the stream (static shapes)
-        idx = np.stack([rng.choice(feature_dim, nnz_per_row, replace=False)
+        idx = np.stack([np.sort(rng.choice(feature_dim, nnz_per_row,
+                                           replace=False))
                         for _ in range(batch_size)]).astype(np.int64)
         val = rng.standard_normal((batch_size, nnz_per_row)) \
             .astype(np.float32)
-        dense = np.zeros((batch_size, feature_dim), np.float32)
-        for i in range(batch_size):
-            dense[i, idx[i]] = val[i]
-        y = dense @ w_true + 0.01 * rng.standard_normal(batch_size) \
-            .astype(np.float32)
-        batches.append((mx.nd.array(dense).tostype("csr"),
-                        mx.nd.array(y.astype(np.float32)),
+        y = (val * w_true[idx]).sum(axis=1) \
+            + 0.01 * rng.standard_normal(batch_size).astype(np.float32)
+        csr = mx.nd.sparse.csr_matrix(
+            (val.reshape(-1), idx.reshape(-1),
+             np.arange(0, batch_size * nnz_per_row + 1, nnz_per_row)),
+            shape=(batch_size, feature_dim))
+        batches.append((csr, mx.nd.array(y.astype(np.float32)),
                         np.unique(idx)))
     return batches, w_true
+
+
+def _pad_rows(touched, cap):
+    """Pad a batch's touched-row list to the stream-wide static capacity
+    by repeating the last id (keeps ascending order; the push-side merge
+    dedups, so duplicate padding rows are harmless)."""
+    out = np.empty(cap, np.int64)
+    out[:len(touched)] = touched
+    out[len(touched):] = touched[-1]
+    return out
 
 
 def main(argv=None):
@@ -66,17 +81,23 @@ def main(argv=None):
     rng = np.random.default_rng(0)
     batches, w_true = make_batches(rng, args.num_batches, args.batch_size,
                                    args.feature_dim, args.nnz_per_row)
+    D = args.feature_dim
+    cap = max(len(t) for _, _, t in batches)
 
-    # symbol: csr data -> sparse dot -> linear regression head
+    # symbol: csr data -> sparse dot -> linear regression head.  `w` is
+    # bound row-sparse, so inside the graph it is a static-capacity
+    # RSPValue and its gradient comes back row-sparse (O(cap))
     data = mx.sym.Variable("data", stype="csr")
-    w = mx.sym.Variable("w")
+    w = mx.sym.Variable("w", stype="row_sparse")
     pred = mx.sym.dot(data, w)
     net = mx.sym.LinearRegressionOutput(pred, name="lro")
 
-    D = args.feature_dim
+    pulled = mx.nd.sparse.row_sparse_array(
+        (np.zeros((cap, 1), np.float32), np.zeros(cap, np.int64)),
+        shape=(D, 1))
     arg_arrays = {
         "data": batches[0][0],
-        "w": mx.nd.zeros((D, 1)),
+        "w": pulled,
         "lro_label": mx.nd.zeros((args.batch_size, 1)),
     }
     grad_req = {"data": "null", "lro_label": "null", "w": "write"}
@@ -85,20 +106,23 @@ def main(argv=None):
     # kvstore holds the ROW-SPARSE master weight + the optimizer
     # (update_on_kvstore, reference style)
     kv = mx.kv.create("local")
-    kv.init("w", mx.nd.zeros((D, 1)).tostype("row_sparse"))
+    kv.init("w", mx.nd.sparse.row_sparse_array(
+        (np.zeros((0, 1), np.float32), np.zeros(0, np.int64)),
+        shape=(D, 1)))
     kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=args.lr,
                                          momentum=0.9, wd=1e-5))
 
-    pulled = mx.nd.zeros((D, 1)).tostype("row_sparse")
+    def pull_batch_rows(touched):
+        rows = mx.nd.array(_pad_rows(touched, cap).astype(np.float32))
+        kv.row_sparse_pull("w", out=pulled, row_ids=rows)
+        exe.arg_dict["w"] = pulled
 
     def eval_loss():
-        """Mean squared error over the whole stream with the CURRENT
-        server weight (forward only)."""
-        w_dense = mx.nd.zeros((D, 1))
-        kv.pull("w", out=w_dense)
-        exe.arg_dict["w"][:] = w_dense.asnumpy()
+        """MSE over the whole stream with the CURRENT server weight —
+        forward-only, still pulling just each batch's touched rows."""
         tot = 0.0
-        for csr_batch, y, _ in batches:
+        for csr_batch, y, touched in batches:
+            pull_batch_rows(touched)
             exe.arg_dict["data"] = csr_batch
             exe.arg_dict["lro_label"][:] = y.asnumpy()[:, None]
             (out,) = exe.forward(is_train=False)
@@ -111,29 +135,23 @@ def main(argv=None):
     n_samples = 0
     for epoch in range(args.epochs):
         for csr_batch, y, touched in batches:
-            rows = mx.nd.array(touched.astype(np.float32))
-            # pull ONLY the touched rows from the compressed store
-            kv.row_sparse_pull("w", out=pulled, row_ids=rows)
-            wd = np.array(exe.arg_dict["w"].asnumpy(), copy=True)
-            wd[touched] = pulled.data.asnumpy()
-            exe.arg_dict["w"][:] = wd
+            pull_batch_rows(touched)
             exe.arg_dict["data"] = csr_batch
             exe.arg_dict["lro_label"][:] = y.asnumpy()[:, None]
             exe.forward(is_train=True)
             exe.backward()
-            # compress the dense in-graph gradient to the touched rows and
-            # push O(nnz): untouched rows are exactly zero by construction
-            g = exe.grad_dict["w"].asnumpy()
-            g_rsp = mx.nd.sparse.row_sparse_array(
-                (g[touched], touched), shape=(D, 1))
+            # the gradient comes out of the executor ALREADY row-sparse
+            # (indices = the pulled rows); push is O(cap)
+            g_rsp = exe.grad_dict["w"]
+            assert g_rsp.stype == "row_sparse", g_rsp.stype
             kv.push("w", g_rsp)
             n_samples += args.batch_size
     dt = time.perf_counter() - t0
     last_loss = eval_loss()
     print("sparse_end2end: %d samples in %.2fs (%.0f samples/s), "
-          "eval mse %.4f -> %.4f, pulled stype=%s"
+          "eval mse %.4f -> %.4f, grad stype=%s"
           % (n_samples, dt, n_samples / dt, first_loss, last_loss,
-             pulled.stype))
+             exe.grad_dict["w"].stype))
     return first_loss, last_loss
 
 

@@ -8,6 +8,18 @@ jax.jit over sharded meshes; KVStore modes are mesh collectives.
 """
 __version__ = "0.12.0.tpu1"
 
+# Honor JAX_PLATFORMS even when an accelerator plugin would override it:
+# with some plugins (observed with the axon TPU tunnel) the env var alone
+# does not pin the platform, silently sending eager ops through the plugin
+# and breaking jax.distributed worker bootstrap (see
+# kvstore_dist.init_distributed).  Pinning through jax.config at import is
+# the documented env semantics, applied reliably.
+import os as _os
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+del _os
+
 from .base import MXNetError
 from . import config
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus

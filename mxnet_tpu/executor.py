@@ -40,7 +40,15 @@ def build_graph_fn(symbol, arg_names, aux_names):
     impls, meant to run under jax.jit so the whole graph becomes one XLA
     computation.  Aux-state mutation (mutate_aux) is threaded functionally:
     the updated value replaces the aux entry for downstream readers and is
-    returned for write-back by the caller."""
+    returned for write-back by the caller.
+
+    Sparse-gradient support (see Executor._get_fwd_bwd): ``probes`` maps a
+    node's id to an array ADDED to that node's first output — differentiating
+    the probe yields the cotangent arriving at that output without making the
+    node's own inputs wrt leaves.  ``capture`` lists node ids whose (input
+    values, first output) to return so op-declared sparse backwards can run
+    on the same traced values; when non-empty the return becomes
+    ``(outputs, new_aux, captures)``."""
     topo = _topo(symbol._outputs)
     var_kind = {}   # node id -> ('arg', name) | ('aux', name)
     aux_set = set(aux_names)
@@ -53,9 +61,10 @@ def build_graph_fn(symbol, arg_names, aux_names):
             sto_index[id(n)] = len(sto_index)
     heads = symbol._outputs
 
-    def graph_fn(arg_vals, aux_vals, key, training):
+    def graph_fn(arg_vals, aux_vals, key, training, probes=None, capture=()):
         import jax
         env = {}
+        captured = {}
         aux_env = dict(zip(aux_names, aux_vals))
         argd = dict(zip(arg_names, arg_vals))
         for n in topo:
@@ -72,6 +81,10 @@ def build_graph_fn(symbol, arg_names, aux_names):
                 outs = f(k, *ins)
             else:
                 outs = f(*ins)
+            if probes is not None and id(n) in probes:
+                outs = (outs[0] + probes[id(n)],) + tuple(outs[1:])
+            if id(n) in capture:
+                captured[id(n)] = (tuple(ins), outs[0])
             for i, o in enumerate(outs):
                 env[(id(n), i)] = o
             for in_idx, out_idx in n.op.mutate_aux.items():
@@ -80,6 +93,8 @@ def build_graph_fn(symbol, arg_names, aux_names):
                     aux_env[var_kind[id(src)][1]] = outs[out_idx]
         out_vals = tuple(env[(id(n), ix)] for (n, ix) in heads)
         new_aux = tuple(aux_env[a] for a in aux_names)
+        if capture:
+            return out_vals, new_aux, tuple(captured[c] for c in capture)
         return out_vals, new_aux
 
     return graph_fn
@@ -117,11 +132,6 @@ class Executor:
             args_grad = {}
         self.grad_dict = self._as_dict(args_grad, self.arg_names, "grads",
                                        allow_missing=True)
-        for n in self.arg_names:
-            if self._grad_req.get(n, "null") != "null" and n not in self.grad_dict:
-                import jax.numpy as jnp
-                self.grad_dict[n] = _wrap(
-                    jnp.zeros_like(self.arg_dict[n]._data), self._ctx)
 
         self.outputs = []
         self._monitor = None
@@ -130,9 +140,25 @@ class Executor:
         self._base_key = None
         self._step = 0
         self._pending_train_fwd = False
+        self._build()
+        self._resolve_grad_storage()
+        for n in self.arg_names:
+            if self._grad_req.get(n, "null") != "null" and n not in self.grad_dict:
+                if self._grad_storage.get(n, "dense") != "dense":
+                    # row-sparse gradient: pre-allocating a dense
+                    # zeros_like would materialize the (vocab, dim) array
+                    # this path exists to avoid; start empty, backward()
+                    # writes the real (indices, values) pair
+                    from .ndarray import sparse as _sp
+                    self.grad_dict[n] = _sp.zeros(
+                        "row_sparse", self.arg_dict[n].shape, self._ctx,
+                        self.arg_dict[n].dtype)
+                else:
+                    import jax.numpy as jnp
+                    self.grad_dict[n] = _wrap(
+                        jnp.zeros_like(self.arg_dict[n]._data), self._ctx)
         if self._sharding:
             self._apply_sharding()
-        self._build()
 
     # ------------------------------------------------------------------
     def _as_dict(self, values, names, what, allow_missing=False):
@@ -202,9 +228,10 @@ class Executor:
         return jax.device_put(local, shards[0].data.devices().pop())
 
     def _apply_sharding(self):
+        from .ndarray.sparse import BaseSparseNDArray
         for name, sh in self._sharding.items():
             for d in (self.arg_dict, self.aux_dict, self.grad_dict):
-                if name in d:
+                if name in d and not isinstance(d[name], BaseSparseNDArray):
                     d[name]._data = self._place_global(d[name]._data, sh)
 
     # ------------------------------------------------------------------
@@ -212,6 +239,78 @@ class Executor:
         self._topo = _topo(self._symbol._outputs)
         self._graph_fn = build_graph_fn(self._symbol, self.arg_names,
                                         self.aux_names)
+
+    def _resolve_grad_storage(self):
+        """Gradient storage-type inference — the FInferStorageType analog
+        (include/mxnet/op_attr_types.h, dispatched per-op in the reference).
+
+        Per grad-requesting arg:
+          * 'rsp_stored'  — the arg itself is bound row-sparse; jax.vjp over
+            its RSPValue pytree yields an O(nnz) cotangent on the .data leaf
+            directly (no special machinery).
+          * ('rsp_probe', node, pos, attrs, spec) — the arg is dense-stored
+            but its single consumer declares an O(nnz) row-sparse backward
+            for it (Embedding sparse_grad=True, dot(csr, w)); the dense vjp
+            for this arg is skipped and replaced by the op's sparse bwd fed
+            with the consumer's output cotangent (probe mechanism).
+          * 'dense' — everything else.
+        """
+        from .ndarray.sparse import RowSparseNDArray
+        self._grad_storage = {}
+        var_nodes = {n.name: n for n in self._topo if n.op is None}
+        for name in self.arg_names:
+            if self._grad_req.get(name, "null") == "null":
+                continue
+            arr = self.arg_dict[name]
+            if isinstance(arr, RowSparseNDArray):
+                if self._grad_req[name] == "add":
+                    raise MXNetError(
+                        "grad_req='add' is not supported for row-sparse "
+                        "gradients (%r): successive batches touch "
+                        "different rows" % name)
+                self._grad_storage[name] = "rsp_stored"
+                continue
+            storage = "dense"
+            vnode = var_nodes.get(name)
+            consumers = []
+            if vnode is not None:
+                for node in self._topo:
+                    if node.op is None:
+                        continue
+                    for pos, (src, _ix) in enumerate(node.inputs):
+                        if src is vnode:
+                            consumers.append((node, pos))
+            user_buf = self.grad_dict.get(name)   # pre-supplied args_grad
+            if user_buf is not None \
+                    and not isinstance(user_buf, RowSparseNDArray):
+                # the caller bound a DENSE gradient buffer (the bind
+                # args_grad contract): keep the dense vjp writing into it
+                # rather than silently orphaning the buffer
+                self._grad_storage[name] = "dense"
+                continue
+            if len(consumers) == 1 and arr.ndim >= 2:
+                node, pos = consumers[0]
+                spec = node.op.sparse_grad.get(pos)
+                if spec is not None:
+                    attrs = node.op.normalize(
+                        {k: v for k, v in node.attrs.items()
+                         if not k.startswith("__")})
+                    in_stypes = []
+                    for (src, _ix) in node.inputs:
+                        st = "default"
+                        if src.op is None:
+                            a = self.arg_dict.get(src.name)
+                            if a is None:
+                                a = self.aux_dict.get(src.name)
+                            st = getattr(a, "stype", "default")
+                        in_stypes.append(st)
+                    if spec["stype"](attrs, in_stypes) == "row_sparse":
+                        if self._grad_req[name] == "add":
+                            raise MXNetError(
+                                "grad_req='add' is not supported for "
+                                "row-sparse gradients (%r)" % name)
+                        storage = ("rsp_probe", node, pos, attrs, spec)
+            self._grad_storage[name] = storage
 
     def _key(self):
         import jax
@@ -234,47 +333,98 @@ class Executor:
         import jax.numpy as jnp
         fn = self._fwd_bwd_jit.get(with_head_grads)
         if fn is None:
+            from .ops.sparse_vals import RSPValue
             g = self._graph_fn
             grad_names = [n for n in self.arg_names
                           if self._grad_req.get(n, "null") != "null"]
-            gidx = [self.arg_names.index(n) for n in grad_names]
-            req_add = [self._grad_req[n] == "add" for n in grad_names]
+            storage = self._grad_storage
+            # wrt leaves: dense args AND rsp-stored args (whose RSPValue
+            # pytree yields an O(nnz) .data cotangent); probe-class args are
+            # NOT differentiated — their grad comes from the op's sparse bwd
+            wrt_names = [n for n in grad_names
+                         if not isinstance(storage[n], tuple)]
+            probe_specs = [(n,) + tuple(storage[n][1:]) for n in grad_names
+                           if isinstance(storage[n], tuple)]
+            probe_order = [n for (n, *_r) in probe_specs]
+            wrt_idx = [self.arg_names.index(n) for n in wrt_names]
+            dense_names = [n for n in grad_names if storage[n] == "dense"]
+            req_add = {n: self._grad_req[n] == "add" for n in dense_names}
             self._grad_names = grad_names
-            grad_shards = [self._sharding.get(n) if self._sharding else None
-                           for n in grad_names]
+            self._dense_grad_names = dense_names
+            grad_shards = {n: self._sharding.get(n) for n in dense_names} \
+                if self._sharding else {}
+            cap_ids = tuple(id(node) for (_n, node, _p, _a, _s)
+                            in probe_specs)
 
             from . import config
             mirror = config.get("MXNET_BACKWARD_DO_MIRROR")
 
             def fwd_bwd(arg_vals, aux_vals, key, head_grads, old_grads):
+                if cap_ids:
+                    # trace-time shape probe: the consumer outputs' avals
+                    # give each probe's shape/dtype
+                    cap_avals = jax.eval_shape(
+                        lambda av: g(av, aux_vals, key, True, None,
+                                     cap_ids), arg_vals)[2]
+                    probe_zeros = tuple(jnp.zeros(c[1].shape, c[1].dtype)
+                                        for c in cap_avals)
+                else:
+                    probe_zeros = ()
+
                 def f(*wrt):
                     av = list(arg_vals)
-                    for i, w in zip(gidx, wrt):
+                    for i, w in zip(wrt_idx, wrt):
                         av[i] = w
-                    outs, new_aux = g(tuple(av), aux_vals, key, True)
-                    return outs, new_aux
+                    if cap_ids:
+                        probes = dict(zip(cap_ids, wrt[len(wrt_idx):]))
+                        outs, new_aux, caps = g(tuple(av), aux_vals, key,
+                                                True, probes, cap_ids)
+                    else:
+                        outs, new_aux = g(tuple(av), aux_vals, key, True)
+                        caps = ()
+                    return outs, (new_aux, caps)
                 if mirror:
                     # MXNET_BACKWARD_DO_MIRROR ≡ rematerialization: recompute
                     # forward activations in backward instead of storing
                     # them (graph_executor.cc:282 mirror pass → jax.checkpoint)
                     f = jax.checkpoint(f)
-                wrt_vals = tuple(arg_vals[i] for i in gidx)
-                outs, vjp, new_aux = jax.vjp(f, *wrt_vals, has_aux=True)
+                wrt_vals = tuple(arg_vals[i] for i in wrt_idx) + probe_zeros
+                outs, vjp, (new_aux, caps) = jax.vjp(f, *wrt_vals,
+                                                     has_aux=True)
                 if head_grads is None:
                     # backward() with no out_grads: seed ones (loss heads'
                     # custom vjps ignore the cotangent, reference semantics)
                     head_grads = tuple(jnp.ones_like(o) for o in outs)
-                grads = vjp(head_grads)
-                new_grads = tuple(og + gr if add else gr for og, gr, add
-                                  in zip(old_grads, grads, req_add))
-                if any(s is not None for s in grad_shards):
-                    # pin grads to their param's sharding: for replicated
-                    # params under a dp mesh this compiles the allreduce in
-                    new_grads = tuple(
-                        jax.lax.with_sharding_constraint(g, s)
-                        if s is not None else g
-                        for g, s in zip(new_grads, grad_shards))
-                return outs, new_aux, new_grads
+                cots = vjp(tuple(head_grads))
+                by_name = dict(zip(wrt_names, cots[:len(wrt_idx)]))
+                probe_cots = cots[len(wrt_idx):]
+                dense_old = dict(zip(dense_names, old_grads))
+                new_grads = []
+                for n in grad_names:
+                    st = storage[n]
+                    if st == "dense":
+                        gv = by_name[n]
+                        if req_add[n]:
+                            gv = dense_old[n] + gv
+                        sh = grad_shards.get(n)
+                        if sh is not None:
+                            # pin grads to their param's sharding: for
+                            # replicated params under a dp mesh this
+                            # compiles the allreduce in
+                            gv = jax.lax.with_sharding_constraint(gv, sh)
+                        new_grads.append(gv)
+                    elif st == "rsp_stored":
+                        cot = by_name[n]     # RSPValue-structured cotangent
+                        orig = arg_vals[self.arg_names.index(n)]
+                        new_grads.append(
+                            RSPValue(cot.data, orig.indices, orig.shape))
+                    else:                    # rsp_probe
+                        k = probe_order.index(n)
+                        (_nm, _node, _pos, attrs, spec) = probe_specs[k]
+                        in_vals, _out0 = caps[k]
+                        new_grads.append(
+                            spec["bwd"](attrs, in_vals, probe_cots[k]))
+                return outs, new_aux, tuple(new_grads)
 
             if with_head_grads:
                 fn = jax.jit(fwd_bwd, donate_argnums=(4,))
@@ -305,9 +455,9 @@ class Executor:
                             arr._aux["indptr"]._data.astype("int32"),
                             arr.shape)
         if isinstance(arr, RowSparseNDArray):
-            if self._grad_req.get(name, "null") != "null":
-                raise MXNetError(
-                    "grad_req must be null for row_sparse argument %r" % name)
+            # grads ARE allowed for rsp args (storage 'rsp_stored'): the
+            # vjp cotangent of this pytree's .data leaf is the O(nnz)
+            # row-sparse gradient
             return RSPValue(arr._aux["data"]._data,
                             arr._aux["indices"]._data.astype("int32"),
                             arr.shape)
@@ -364,7 +514,7 @@ class Executor:
         from . import profiler
         fn = self._get_fwd_bwd(out_grads is not None)
         grad_names = self._grad_names
-        old = tuple(self.grad_dict[n]._data for n in grad_names)
+        old = tuple(self.grad_dict[n]._data for n in self._dense_grad_names)
         with profiler.record_span("forward_backward", "backward"):
             if out_grads is None:
                 outs, new_aux, new_grads = fn(self._arg_vals(),
@@ -379,8 +529,22 @@ class Executor:
         self._set_outputs(outs)
         for n, a in zip(self.aux_names, new_aux):
             self.aux_dict[n]._data = a
+        from .ops.sparse_vals import RSPValue
         for n, gv in zip(grad_names, new_grads):
-            self.grad_dict[n]._data = gv
+            if isinstance(gv, RSPValue):
+                from .ndarray.sparse import RowSparseNDArray
+                cur = self.grad_dict.get(n)
+                if isinstance(cur, RowSparseNDArray) \
+                        and cur._aux["data"]._data.shape == gv.data.shape:
+                    # in-place: keeps references handed out at bind alive
+                    cur._aux["data"]._data = gv.data
+                    cur._aux["indices"]._data = gv.indices
+                else:
+                    self.grad_dict[n] = RowSparseNDArray._from_aux(
+                        {"data": _wrap(gv.data, self._ctx),
+                         "indices": _wrap(gv.indices, self._ctx)}, gv.shape)
+            else:
+                self.grad_dict[n]._data = gv
         self._pending_train_fwd = False
         self._pending_key = None
 
@@ -452,6 +616,18 @@ class Executor:
         grad_req = dict(self._grad_req)
         return Executor(self._symbol, self._ctx, new_args, None, grad_req,
                         new_aux, sharding=self._sharding)
+
+    def lowered_fwd_bwd_text(self):
+        """StableHLO text of the fused fwd+bwd program.
+
+        Diagnostic surface for the sparse no-densify contract: tests grep
+        this for vocab-extent tensor shapes to prove a row-sparse path
+        never materializes the dense (vocab, dim) array on device."""
+        import jax
+        fn = self._get_fwd_bwd(False)
+        old = tuple(self.grad_dict[n]._data for n in self._dense_grad_names)
+        return str(fn.lower(self._arg_vals(), self._aux_vals(),
+                            jax.random.PRNGKey(0), old).as_text())
 
     def debug_str(self):
         lines = ["Symbol outputs: %s" % ", ".join(self.output_names)]

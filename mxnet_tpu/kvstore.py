@@ -63,6 +63,13 @@ def _merge_rsp(vlist):
         [_np.asarray(v._aux["indices"]._data) for v in vlist])
     all_rows = _np.concatenate(
         [_np.asarray(v._aux["data"]._data) for v in vlist], axis=0)
+    # index -1 marks padding slots (executor rsp grads, RSPValue contract);
+    # they must not reach the update kernels, where -1 would wrap around to
+    # the LAST row and silently corrupt it (wd/momentum apply even to a
+    # zero gradient row)
+    valid = all_idx >= 0
+    all_idx = all_idx[valid]
+    all_rows = all_rows[valid]
     uniq, inv = _np.unique(all_idx, return_inverse=True)
     summed = _np.zeros((len(uniq),) + all_rows.shape[1:], all_rows.dtype)
     _np.add.at(summed, inv, all_rows)
@@ -150,9 +157,11 @@ class KVStore(object):
                 # row-sparse stays compressed end to end: O(nnz) merge, the
                 # optimizer's rsp lazy-update kernel, compressed store —
                 # the reference server's FComputeEx path
-                # (kvstore_dist_server.h:340-420)
-                merged = vlist[0] if len(vlist) == 1 \
-                    else _merge_rsp(vlist)
+                # (kvstore_dist_server.h:340-420).  Single-value pushes go
+                # through the merge too: it dedups/sorts row ids, which the
+                # lazy-update scatter kernels require (executor rsp grads
+                # may carry padded duplicate rows)
+                merged = _merge_rsp(vlist)
                 merged = self._reduce_global(k, merged)
                 if self._updater is not None:
                     self._updater(k if isinstance(k, int) else str(k),

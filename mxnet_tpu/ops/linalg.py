@@ -12,18 +12,55 @@ from jax import lax
 from .registry import register, P
 
 
+def _dot_grad_stype(attrs, in_stypes):
+    # dot(csr, dense): d(rhs) = dot(csr^T, dy) — row-sparse with support =
+    # the lhs's stored column ids (dot.cc:31 FInferStorageType backward)
+    if (in_stypes and in_stypes[0] == "csr"
+            and not attrs.get("transpose_a")
+            and not attrs.get("transpose_b")):
+        return "row_sparse"
+    return "default"
+
+
+def _dot_sparse_bwd(attrs, in_vals, cot):
+    from .sparse_vals import RSPValue
+    from .sparse_ops import dedup_rows
+    csr = in_vals[0]
+    c = cot[:, None] if cot.ndim == 1 else cot
+    row_ids = csr.row_ids()
+    cols = jnp.clip(csr.indices, 0, csr.shape[1] - 1)
+    # each stored entry (r, c, v) contributes v * dy[r] to d(rhs)[c]:
+    # O(nnz) — no (k, n) dense gradient exists anywhere
+    contrib = csr.data.reshape(-1, 1) * c[row_ids]      # (nnz, N)
+    rows, vals = dedup_rows(cols, contrib)
+    if cot.ndim == 1:
+        vals = vals[:, 0]
+        return RSPValue(vals, rows, (csr.shape[1],))
+    return RSPValue(vals, rows, (csr.shape[1], c.shape[1]))
+
+
 @register("dot", nin=2, input_names=["lhs", "rhs"], sparse_aware=True,
+          sparse_grad={1: {"stype": _dot_grad_stype, "bwd": _dot_sparse_bwd}},
           params={"transpose_a": P(bool, False), "transpose_b": P(bool, False),
                   "forward_stype": P("str_or_none", None)})
 def dot(attrs, a, b):
-    # stype dispatch (dot.cc:31 FComputeEx): csr x dense stays O(nnz);
-    # other sparse combinations fall back to dense like the reference's
-    # storage-fallback executor
-    from .sparse_vals import CSRValue, densify
-    if isinstance(a, CSRValue) and not hasattr(b, "todense") \
-            and not attrs["transpose_b"]:
-        from .sparse_ops import csr_dot_dense
-        return csr_dot_dense(a, b, transpose_a=attrs["transpose_a"])
+    # stype dispatch (dot.cc:31 FComputeEx): csr x dense and csr x
+    # row-sparse stay O(nnz); other sparse combinations fall back to dense
+    # like the reference's storage-fallback executor
+    from .sparse_vals import CSRValue, RSPValue, densify
+    if isinstance(a, CSRValue) and not attrs["transpose_b"]:
+        if isinstance(b, RSPValue) and not attrs["transpose_a"]:
+            # csr x rsp-stored rhs: gather only the stored rows the csr
+            # touches — the full rhs table never densifies
+            from .sparse_ops import rsp_lookup
+            cols = jnp.clip(a.indices, 0, a.shape[1] - 1)
+            wrows = rsp_lookup(b, cols)                   # (nnz, ...)
+            contrib = a.data.reshape((-1,) + (1,) * (wrows.ndim - 1)) * wrows
+            return jax.ops.segment_sum(contrib, a.row_ids(),
+                                       num_segments=a.shape[0])
+        if not hasattr(b, "todense"):
+            from .sparse_ops import csr_dot_dense
+            return csr_dot_dense(a, b, transpose_a=attrs["transpose_a"])
     a = densify(a)
     b = densify(b)
     if attrs["transpose_a"]:

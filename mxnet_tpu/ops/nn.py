@@ -1123,15 +1123,42 @@ def dropout(attrs, rng, x):
 # Embedding
 # ---------------------------------------------------------------------------
 
+def _emb_grad_stype(attrs, in_stypes):
+    # sparse_grad=True: backward emits a row-sparse gradient with support =
+    # the batch's (deduplicated) ids — O(nnz) through backward, update and
+    # comm (indexing_op.cc:32-80 SparseEmbeddingOpBackwardRsp)
+    return "row_sparse" if attrs.get("sparse_grad") else "default"
+
+
+def _emb_sparse_bwd(attrs, in_vals, cot):
+    from .sparse_vals import RSPValue
+    from .sparse_ops import dedup_rows
+    data = in_vals[0]
+    idx = jnp.clip(data.astype(jnp.int32), 0,
+                   attrs["input_dim"] - 1).reshape(-1)
+    vals = cot.reshape((idx.shape[0], cot.shape[-1])).astype(jnp.float32)
+    rows, summed = dedup_rows(idx, vals)
+    return RSPValue(summed, rows,
+                    (attrs["input_dim"], attrs["output_dim"]))
+
+
 @register("Embedding", aliases=["embedding", "_contrib_SparseEmbedding"],
-          nin=2, input_names=["data", "weight"],
+          nin=2, input_names=["data", "weight"], sparse_aware=True,
+          sparse_grad={1: {"stype": _emb_grad_stype, "bwd": _emb_sparse_bwd}},
           fill_shapes=lambda attrs, s: [s[0],
                                         (attrs["input_dim"], attrs["output_dim"]) if len(s) > 1 and s[1] is None else s[1]],
           params={"input_dim": P(int), "output_dim": P(int),
                   "dtype": P(str, "float32"), "sparse_grad": P(bool, False)})
 def embedding(attrs, data, weight):
-    idx = jnp.clip(data.astype(jnp.int32), 0, attrs["input_dim"] - 1)
-    return jnp.take(weight, idx, axis=0)
+    from .sparse_vals import RSPValue, densify
+    idx = jnp.clip(densify(data).astype(jnp.int32), 0,
+                   attrs["input_dim"] - 1)
+    if isinstance(weight, RSPValue):
+        # rsp-STORED table (only the pulled rows live on device): gather by
+        # id lookup — the full (input_dim, output_dim) array never exists
+        from .sparse_ops import rsp_lookup
+        return rsp_lookup(weight, idx)
+    return jnp.take(densify(weight), idx, axis=0)
 
 
 # ---------------------------------------------------------------------------

@@ -41,7 +41,7 @@ class OpDef:
                  input_names=None, variable_inputs=False, stochastic=False,
                  mode_dependent=False, mutate_aux=None, fill_shapes=None,
                  num_visible_outputs=None, key_var_num_args=None,
-                 aux_inputs=(), sparse_aware=False, doc=""):
+                 aux_inputs=(), sparse_aware=False, sparse_grad=None, doc=""):
         self.name = name
         self.impl = impl
         self.params = params or {}
@@ -63,6 +63,17 @@ class OpDef:
         # pytrees; all other ops see densified inputs (the reference's
         # storage-fallback executor, attach_op_execs_pass.cc:49)
         self.sparse_aware = sparse_aware
+        # FInferStorageType analog for GRADIENTS (op_attr_types.h FInferStorageType
+        # + e.g. indexing_op.cc SparseEmbeddingOpBackwardRsp): declares, per
+        # input index, that this op can emit an O(nnz) row-sparse gradient.
+        #   {in_index: {"stype": fn(attrs, in_stypes) -> "row_sparse"|"default",
+        #               "bwd":   fn(attrs, in_vals, cotangent) -> RSPValue}}
+        # The executor consults "stype" at bind time (with the stypes of the
+        # op's VARIABLE inputs; intermediates count as "default") and, when it
+        # answers row_sparse, skips the dense vjp for that input entirely —
+        # it differentiates a zero probe added to the op's output instead and
+        # hands the probe cotangent to "bwd" (see Executor._get_fwd_bwd).
+        self.sparse_grad = sparse_grad or {}
         self.doc = doc or (impl.__doc__ or "")
         self._jit_cache = {}
 

@@ -112,6 +112,39 @@ def square_sum(attrs, data):
     return jnp.sum(jnp.square(dense), axis=ax, keepdims=keep)
 
 
+def dedup_rows(rows, vals):
+    """Sum ``vals`` over duplicate ``rows`` ids: (uniq_rows, summed_vals)
+    at the same static capacity, padding slots index -1 / data 0 (the rsp
+    invariant).  The reference's AddTakeGradRspKernel
+    (src/operator/tensor/indexing_op.h) does the same sort+accumulate when
+    SparseEmbedding's backward builds its rsp gradient."""
+    cap = rows.shape[0]
+    uniq, inv = jnp.unique(rows.astype(jnp.int32), return_inverse=True,
+                           size=cap, fill_value=-1)
+    summed = jnp.zeros((cap,) + vals.shape[1:], vals.dtype) \
+        .at[inv.reshape(-1)].add(vals)
+    return uniq.astype(jnp.int32), summed
+
+
+def rsp_lookup(w, ids):
+    """Dense rows of a row-sparse value for the requested ``ids`` (rows not
+    stored read as zero) — O(|ids| log nnz), the gather that lets ops
+    consume rsp-STORED weights without densifying the full table."""
+    flat = ids.astype(jnp.int32).reshape(-1)
+    src = jnp.where(w.indices >= 0, w.indices,
+                    jnp.iinfo(jnp.int32).max)      # padding sorts last
+    order = jnp.argsort(src)
+    src_sorted = src[order]
+    rows_sorted = w.data[order]
+    pos = jnp.clip(jnp.searchsorted(src_sorted, flat),
+                   0, src_sorted.shape[0] - 1)
+    match = src_sorted[pos] == flat
+    row_shape = w.data.shape[1:]
+    out = jnp.where(match.reshape((-1,) + (1,) * len(row_shape)),
+                    rows_sorted[pos], 0)
+    return out.reshape(tuple(ids.shape) + row_shape)
+
+
 def csr_dot_dense(csr, rhs, transpose_a=False):
     """O(nnz * cols) sparse-dense matmul on the padded-csr value.
     Supports 2-D rhs (matrix) and 1-D rhs (matrix-vector, reference

@@ -1,0 +1,264 @@
+"""Row-sparse gradients out of the executor (VERDICT r4 missing #1).
+
+Reference: FInferStorageType gradient dispatch
+(include/mxnet/op_attr_types.h) + SparseEmbeddingOpBackwardRsp
+(src/operator/tensor/indexing_op.cc:32-80) + dot backward storage
+inference (src/operator/tensor/dot.cc:31).  Three executor paths:
+
+  * 'rsp_probe' — dense-stored weight whose single consumer declares an
+    O(nnz) sparse backward (Embedding sparse_grad=True; dot(csr, w)):
+    the dense vjp for the weight is skipped, the op's sparse bwd runs on
+    the consumer-output cotangent.
+  * 'rsp_stored' — the arg itself is bound row-sparse; jax.vjp over its
+    RSPValue pytree gives the O(nnz) cotangent directly.
+  * the no-densify contract is asserted on the lowered StableHLO: with a
+    vocab-sized extent that appears nowhere else, the compiled program
+    must not contain it when the weight is rsp-stored.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def _rand_csr(rng, batch, dim, nnz_per_row):
+    idx = np.stack([np.sort(rng.choice(dim, nnz_per_row, replace=False))
+                    for _ in range(batch)]).astype(np.int64)
+    val = rng.standard_normal((batch, nnz_per_row)).astype(np.float32)
+    csr = mx.nd.sparse.csr_matrix(
+        (val.reshape(-1), idx.reshape(-1),
+         np.arange(0, batch * nnz_per_row + 1, nnz_per_row)),
+        shape=(batch, dim))
+    dense = np.zeros((batch, dim), np.float32)
+    for i in range(batch):
+        dense[i, idx[i]] = val[i]
+    return csr, dense, np.unique(idx)
+
+
+def test_dot_csr_emits_rsp_grad():
+    """dot(csr, w) with dense-stored w: the w gradient comes back
+    row-sparse with support = the csr's touched columns, matching the
+    dense computation exactly."""
+    rng = np.random.RandomState(0)
+    B, D, N = 8, 64, 3
+    csr, dense, touched = _rand_csr(rng, B, D, 4)
+    w0 = rng.standard_normal((D, N)).astype(np.float32)
+
+    data = mx.sym.Variable("data", stype="csr")
+    w = mx.sym.Variable("w")
+    net = mx.sym.MakeLoss(mx.sym.sum(mx.sym.square(mx.sym.dot(data, w))))
+    exe = net.bind(mx.cpu(), args={"data": csr, "w": mx.nd.array(w0)},
+                   grad_req={"data": "null", "w": "write"})
+    exe.forward(is_train=True)
+    exe.backward()
+    gw = exe.grad_dict["w"]
+    assert gw.stype == "row_sparse"
+    assert gw.data.shape[0] == B * 4          # csr nnz capacity
+    expect = 2 * dense.T @ (dense @ w0)
+    np.testing.assert_allclose(gw.tostype("default").asnumpy(), expect,
+                               rtol=1e-4, atol=1e-5)
+    # untouched rows are absent from the support
+    got_rows = set(int(r) for r in gw.indices.asnumpy() if r >= 0)
+    assert got_rows <= set(touched.tolist())
+
+
+def test_embedding_sparse_grad():
+    """Embedding(sparse_grad=True) with a dense-stored table: rsp grad
+    with duplicate ids summed (AddTakeGradRspKernel semantics)."""
+    rng = np.random.RandomState(1)
+    V, E, B, T = 50, 6, 4, 7
+    idx = rng.randint(0, V, (B, T)).astype(np.float32)
+    idx[0, 0] = idx[0, 1] = 3          # force duplicates
+    wt = rng.standard_normal((V, E)).astype(np.float32)
+
+    d = mx.sym.Variable("data")
+    w = mx.sym.Variable("weight")
+    emb = mx.sym.Embedding(d, w, input_dim=V, output_dim=E,
+                           sparse_grad=True)
+    net = mx.sym.MakeLoss(mx.sym.sum(mx.sym.square(emb)))
+    exe = net.bind(mx.cpu(),
+                   args={"data": mx.nd.array(idx), "weight": mx.nd.array(wt)},
+                   grad_req={"data": "null", "weight": "write"})
+    exe.forward(is_train=True)
+    exe.backward()
+    ge = exe.grad_dict["weight"]
+    assert ge.stype == "row_sparse"
+    assert ge.data.shape == (B * T, E)        # static nnz capacity
+    expect = np.zeros((V, E), np.float32)
+    for b in range(B):
+        for t in range(T):
+            expect[int(idx[b, t])] += 2 * wt[int(idx[b, t])]
+    np.testing.assert_allclose(ge.tostype("default").asnumpy(), expect,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_dense_grad_unchanged():
+    """sparse_grad=False keeps the dense gradient path."""
+    rng = np.random.RandomState(2)
+    V, E, B = 20, 4, 5
+    idx = rng.randint(0, V, (B,)).astype(np.float32)
+    wt = rng.standard_normal((V, E)).astype(np.float32)
+    d = mx.sym.Variable("data")
+    w = mx.sym.Variable("weight")
+    emb = mx.sym.Embedding(d, w, input_dim=V, output_dim=E)
+    net = mx.sym.MakeLoss(mx.sym.sum(mx.sym.square(emb)))
+    exe = net.bind(mx.cpu(),
+                   args={"data": mx.nd.array(idx), "weight": mx.nd.array(wt)},
+                   grad_req={"data": "null", "weight": "write"})
+    exe.forward(is_train=True)
+    exe.backward()
+    ge = exe.grad_dict["weight"]
+    assert getattr(ge, "stype", "default") == "default"
+    assert ge.shape == (V, E)
+
+
+def test_rsp_stored_arg_grad():
+    """A row-sparse-BOUND weight: only the stored rows live on device,
+    and the gradient arrives as the RSPValue pytree cotangent."""
+    rng = np.random.RandomState(3)
+    B, D, N = 8, 64, 3
+    csr, dense, touched = _rand_csr(rng, B, D, 4)
+    w0 = rng.standard_normal((D, N)).astype(np.float32)
+    wr = mx.nd.sparse.row_sparse_array((w0[touched], touched.copy()),
+                                       shape=(D, N))
+
+    data = mx.sym.Variable("data", stype="csr")
+    w = mx.sym.Variable("w", stype="row_sparse")
+    net = mx.sym.MakeLoss(mx.sym.sum(mx.sym.square(mx.sym.dot(data, w))))
+    exe = net.bind(mx.cpu(), args={"data": csr, "w": wr},
+                   grad_req={"data": "null", "w": "write"})
+    exe.forward(is_train=True)
+    exe.backward()
+    g = exe.grad_dict["w"]
+    assert g.stype == "row_sparse"
+    assert g.data.shape == (len(touched), N)   # the arg's own capacity
+    wd = np.zeros((D, N), np.float32)
+    wd[touched] = w0[touched]
+    expect = 2 * dense.T @ (dense @ wd)
+    got = g.tostype("default").asnumpy()
+    np.testing.assert_allclose(got[touched], expect[touched],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_no_dense_vocab_materialization():
+    """The no-densify contract, proven on the compiled program: with an
+    rsp-stored weight of an unmistakable vocab extent, the lowered
+    StableHLO of the fused fwd+bwd step must not mention that extent at
+    all — no dense (vocab, dim) tensor exists on device in forward,
+    backward, or the gradient outputs."""
+    rng = np.random.RandomState(4)
+    B, D, N = 8, 199481, 2            # prime-ish extent: greppable
+    nnz = 4
+    idx = np.stack([np.sort(rng.choice(D, nnz, replace=False))
+                    for _ in range(B)]).astype(np.int64)
+    val = rng.standard_normal((B, nnz)).astype(np.float32)
+    csr = mx.nd.sparse.csr_matrix(
+        (val.reshape(-1), idx.reshape(-1),
+         np.arange(0, B * nnz + 1, nnz)), shape=(B, D))
+    touched = np.unique(idx)
+    wr = mx.nd.sparse.row_sparse_array(
+        (rng.standard_normal((len(touched), N)).astype(np.float32),
+         touched), shape=(D, N))
+
+    data = mx.sym.Variable("data", stype="csr")
+    w = mx.sym.Variable("w", stype="row_sparse")
+    net = mx.sym.MakeLoss(mx.sym.sum(mx.sym.square(mx.sym.dot(data, w))))
+    exe = net.bind(mx.cpu(), args={"data": csr, "w": wr},
+                   grad_req={"data": "null", "w": "write"})
+    text = exe.lowered_fwd_bwd_text()
+    assert "199481" not in text, \
+        "a vocab-extent tensor appears in the compiled step"
+    # and the step still runs + produces the rsp grad
+    exe.forward(is_train=True)
+    exe.backward()
+    assert exe.grad_dict["w"].stype == "row_sparse"
+
+
+def test_rsp_grad_req_add_rejected():
+    rng = np.random.RandomState(5)
+    csr, _, touched = _rand_csr(rng, 4, 32, 3)
+    wr = mx.nd.sparse.row_sparse_array(
+        (np.zeros((len(touched), 2), np.float32), touched), shape=(32, 2))
+    data = mx.sym.Variable("data", stype="csr")
+    w = mx.sym.Variable("w", stype="row_sparse")
+    net = mx.sym.MakeLoss(mx.sym.sum(mx.sym.dot(data, w)))
+    with pytest.raises(MXNetError, match="add"):
+        net.bind(mx.cpu(), args={"data": csr, "w": wr},
+                 grad_req={"data": "null", "w": "add"})
+
+
+def test_kvstore_push_dedups_duplicate_rows():
+    """Padded duplicate rows in a pushed rsp gradient must be SUMMED
+    before the lazy-update scatter (which is last-wins per row)."""
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.sparse.row_sparse_array(
+        (np.zeros((0, 1), np.float32), np.zeros(0, np.int64)),
+        shape=(8, 1)))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0,
+                                         momentum=0.0, wd=0.0))
+    g = mx.nd.sparse.row_sparse_array(
+        (np.array([[1.0], [3.0], [5.0]], np.float32),
+         np.array([2, 2, 6], np.int64)), shape=(8, 1))
+    kv.push("w", g)
+    out = mx.nd.zeros((8, 1))
+    kv.pull("w", out=out)
+    got = out.asnumpy()[:, 0]
+    np.testing.assert_allclose(got[2], -4.0)   # 1+3 summed, not 3 last-wins
+    np.testing.assert_allclose(got[6], -5.0)
+    assert np.all(got[[0, 1, 3, 4, 5, 7]] == 0)
+
+
+def test_kvstore_push_ignores_padding_rows():
+    """Index -1 padding slots in an executor rsp gradient must not reach
+    the update kernels, where -1 would wrap to the LAST row and apply a
+    spurious wd/momentum update to it."""
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.sparse.row_sparse_array(
+        (np.ones((8, 1), np.float32), np.arange(8, dtype=np.int64)),
+        shape=(8, 1)))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0,
+                                         momentum=0.0, wd=0.1))
+    g = mx.nd.sparse.row_sparse_array(
+        (np.array([[0.0], [2.0]], np.float32),
+         np.array([-1, 3], np.int64)), shape=(8, 1))
+    kv.push("w", g)
+    out = mx.nd.zeros((8, 1))
+    kv.pull("w", out=out)
+    got = out.asnumpy()[:, 0]
+    assert got[7] == 1.0, "padding row -1 corrupted the last row: %r" % got
+    np.testing.assert_allclose(got[3], 1.0 - (2.0 + 0.1))
+
+
+def test_user_dense_grad_buffer_respected():
+    """A caller-supplied DENSE args_grad buffer keeps the dense vjp path
+    (the bind contract): the buffer receives the gradient instead of
+    being silently orphaned by probe classification."""
+    rng = np.random.RandomState(6)
+    B, D, N = 4, 24, 2
+    csr, dense, _ = _rand_csr(rng, B, D, 3)
+    w0 = rng.standard_normal((D, N)).astype(np.float32)
+    gw = mx.nd.zeros((D, N))
+    data = mx.sym.Variable("data", stype="csr")
+    w = mx.sym.Variable("w")
+    net = mx.sym.MakeLoss(mx.sym.sum(mx.sym.square(mx.sym.dot(data, w))))
+    exe = net.bind(mx.cpu(), args={"data": csr, "w": mx.nd.array(w0)},
+                   args_grad={"w": gw},
+                   grad_req={"data": "null", "w": "write"})
+    exe.forward(is_train=True)
+    exe.backward()
+    expect = 2 * dense.T @ (dense @ w0)
+    np.testing.assert_allclose(gw.asnumpy(), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_end2end_example():
+    """The flagship sparse workload trains O(nnz) end to end."""
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "sparse_end2end.py")
+    spec = importlib.util.spec_from_file_location("sparse_end2end", path)
+    modl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(modl)
+    first, last = modl.main(["--num-batches", "8", "--epochs", "3"])
+    assert last < first * 0.5, (first, last)
