@@ -443,25 +443,15 @@ class Executor:
     def _as_graph_value(self, arr, name):
         """Dense args flow as jax arrays; sparse NDArrays flow as their
         compressed pytree (FComputeEx dispatch — sparse-aware ops consume
-        them, others densify at the op boundary)."""
-        from .ndarray.sparse import CSRNDArray, RowSparseNDArray
-        from .ops.sparse_vals import CSRValue, RSPValue
-        if isinstance(arr, CSRNDArray):
-            if self._grad_req.get(name, "null") != "null":
-                raise MXNetError(
-                    "grad_req must be null for csr argument %r" % name)
-            return CSRValue(arr._aux["data"]._data,
-                            arr._aux["indices"]._data.astype("int32"),
-                            arr._aux["indptr"]._data.astype("int32"),
-                            arr.shape)
-        if isinstance(arr, RowSparseNDArray):
-            # grads ARE allowed for rsp args (storage 'rsp_stored'): the
-            # vjp cotangent of this pytree's .data leaf is the O(nnz)
-            # row-sparse gradient
-            return RSPValue(arr._aux["data"]._data,
-                            arr._aux["indices"]._data.astype("int32"),
-                            arr.shape)
-        return arr._data
+        them, others densify at the op boundary).  Grads are allowed for
+        rsp args (storage 'rsp_stored': the vjp cotangent of the pytree's
+        .data leaf is the O(nnz) gradient) but not for csr args."""
+        from .ndarray.sparse import CSRNDArray, to_value
+        if isinstance(arr, CSRNDArray) \
+                and self._grad_req.get(name, "null") != "null":
+            raise MXNetError(
+                "grad_req must be null for csr argument %r" % name)
+        return to_value(arr)
 
     def _aux_vals(self):
         return tuple(self.aux_dict[n]._data for n in self.aux_names)
@@ -559,7 +549,19 @@ class Executor:
                 self.aux_dict[n]._data = a
 
     def _set_outputs(self, outs):
-        self.outputs = [_wrap(self._localize(o), self._ctx) for o in outs]
+        from .ndarray.sparse import from_value
+        from .ops.sparse_vals import is_sparse
+
+        def _localized(o):
+            if is_sparse(o):
+                # localize each LEAF: the pytree container itself reports
+                # no addressability, its jax arrays do
+                import jax
+                leaves, treedef = jax.tree_util.tree_flatten(o)
+                return jax.tree_util.tree_unflatten(
+                    treedef, [self._localize(x) for x in leaves])
+            return self._localize(o)
+        self.outputs = [from_value(_localized(o), self._ctx) for o in outs]
         if self._monitor is not None:
             for name, o in zip(self.output_names, self.outputs):
                 self._monitor(name, o)

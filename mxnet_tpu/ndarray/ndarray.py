@@ -119,7 +119,18 @@ def invoke(op, inputs, attrs=None, out=None):
         else:
             ctx = current_context()
 
-    jax_ins = [i._data for i in inputs]
+    recording = autograd.is_recording() and autograd.any_traced(inputs)
+    sparse_eager = False
+    if opdef.sparse_aware and not recording:
+        from .sparse import BaseSparseNDArray, to_value
+        if any(isinstance(i, BaseSparseNDArray) for i in inputs):
+            # FComputeEx eager path: sparse-aware kernels get the
+            # compressed pytrees and may return them (autograd recording
+            # keeps the dense fallback: the tape stores dense cotangents)
+            sparse_eager = True
+            jax_ins = [to_value(i) for i in inputs]
+    if not sparse_eager:
+        jax_ins = [i._data for i in inputs]
     training = autograd.is_training()
     kernel = opdef.jitted(attrs, training)
 
@@ -128,8 +139,6 @@ def invoke(op, inputs, attrs=None, out=None):
         primal = lambda *ins: kernel(key, *ins)  # noqa: E731
     else:
         primal = kernel
-
-    recording = autograd.is_recording() and autograd.any_traced(inputs)
 
     if not inputs:
         # creator ops: place on the requested context
@@ -151,7 +160,10 @@ def invoke(op, inputs, attrs=None, out=None):
     nvis = opdef.num_visible_outputs
     if callable(nvis):
         nvis = nvis(attrs)
-    all_out_nds = [_wrap(o, ctx) for o in outs]
+    # sparse-tolerant wrapping: sparse-aware kernels may return compressed
+    # pytrees even for dense inputs (cast_storage, dot forward_stype)
+    from .sparse import from_value
+    all_out_nds = [from_value(o, ctx) for o in outs]
 
     if recording:
         autograd.record_op(opdef.name, vjp_fn, primal, list(inputs),

@@ -180,6 +180,39 @@ class CSRNDArray(BaseSparseNDArray):
 # constructors
 # ---------------------------------------------------------------------------
 
+def to_value(arr):
+    """In-graph value for an NDArray: the compressed pytree for sparse
+    storage, the jax array otherwise (FComputeEx operand conversion —
+    shared by the executor's _as_graph_value and eager invoke)."""
+    from ..ops.sparse_vals import CSRValue, RSPValue
+    if isinstance(arr, CSRNDArray):
+        return CSRValue(arr._aux["data"]._data,
+                        arr._aux["indices"]._data.astype("int32"),
+                        arr._aux["indptr"]._data.astype("int32"), arr.shape)
+    if isinstance(arr, RowSparseNDArray):
+        return RSPValue(arr._aux["data"]._data,
+                        arr._aux["indices"]._data.astype("int32"), arr.shape)
+    return arr._data
+
+
+def from_value(v, ctx):
+    """Wrap an op result back into an NDArray, preserving sparse storage
+    (CSRValue/RSPValue results become CSR/RowSparse NDArrays).  Indices
+    are cast back to int64 — the aux-dtype the constructors promise —
+    undoing the int32 graph-boundary cast in to_value."""
+    from ..ops.sparse_vals import CSRValue, RSPValue
+    if isinstance(v, RSPValue):
+        return RowSparseNDArray._from_aux(
+            {"data": _wrap(v.data, ctx),
+             "indices": _wrap(v.indices.astype("int64"), ctx)}, v.shape)
+    if isinstance(v, CSRValue):
+        return CSRNDArray._from_aux(
+            {"data": _wrap(v.data, ctx),
+             "indices": _wrap(v.indices.astype("int64"), ctx),
+             "indptr": _wrap(v.indptr.astype("int64"), ctx)}, v.shape)
+    return _wrap(v, ctx)
+
+
 def gather_rsp_rows(src_idx, src_rows, ids):
     """Numpy gather of rows `ids` from a compressed (indices, rows) pair;
     absent rows read as zero.  The one implementation of the

@@ -57,8 +57,35 @@ _UNARY = {
     "logical_not": lambda x: (x == 0).astype(x.dtype),
 }
 
+# f(0) = 0 unary ops preserve sparsity: applied to the compressed data
+# only, they keep rsp/csr storage through the graph O(nnz) instead of
+# hitting the densify fallback (elemwise_unary_op_basic.cc:373-466 +
+# _trig.cc register these with FComputeEx rsp/csr kernels).  Padding
+# slots carry data 0, and f(0)=0 keeps them 0 — no masking needed.
+_SPARSITY_PRESERVING = frozenset([
+    "relu", "abs", "sign", "round", "rint", "ceil", "floor", "trunc",
+    "fix", "square", "sqrt", "cbrt", "negative", "degrees", "radians",
+    "expm1", "log1p", "erf", "erfinv", "sin", "tan", "arcsin", "arctan",
+    "sinh", "tanh", "arcsinh", "arctanh",
+])
+
+
+def _unary_impl(fn, preserves_sparsity):
+    from .sparse_vals import CSRValue, RSPValue, densify
+
+    def impl(attrs, x, _fn=fn):
+        if preserves_sparsity:
+            if isinstance(x, RSPValue):
+                return RSPValue(_fn(x.data), x.indices, x.shape)
+            if isinstance(x, CSRValue):
+                return CSRValue(_fn(x.data), x.indices, x.indptr, x.shape)
+        return _fn(densify(x))
+    return impl
+
+
 for _name, _fn in _UNARY.items():
-    register(_name)(lambda attrs, x, _fn=_fn: _fn(x))
+    _sp = _name in _SPARSITY_PRESERVING
+    register(_name, sparse_aware=_sp)(_unary_impl(_fn, _sp))
 
 @register("gamma")
 def gamma_fn(attrs, x):
@@ -147,11 +174,53 @@ _OLD_NAME = {"add": "_plus", "sub": "_minus", "mul": "_mul", "div": "_div",
              "greater_equal": "_greater_equal", "lesser": "_lesser",
              "lesser_equal": "_lesser_equal"}
 
+# Sparse binary kernels (elemwise_binary_op_basic.cc FComputeEx):
+#   add/sub(rsp, rsp)  -> rsp with union support (concat + dedup, O(nnz))
+#   mul(rsp, dense)    -> rsp (gather the dense rows the rsp stores)
+# Every other sparse combination falls back to the dense kernel.
+_SPARSE_BINARY = frozenset(["add", "sub", "mul"])
+
+
+def _binary_impl(name, fn):
+    from .sparse_vals import RSPValue, densify
+
+    def impl(attrs, a, b, _fn=fn, _name=name):
+        a_rsp = isinstance(a, RSPValue)
+        b_rsp = isinstance(b, RSPValue)
+        if _name in ("add", "sub") and a_rsp and b_rsp \
+                and a.shape == b.shape:
+            from .sparse_ops import dedup_rows
+            bd = -b.data if _name == "sub" else b.data
+            rows = jnp.concatenate([a.indices, b.indices])
+            vals = jnp.concatenate([a.data, bd], axis=0)
+            uniq, summed = dedup_rows(rows, vals)
+            # clamp capacity: dedup compacts distinct ids to the front
+            # (only fill padding occupies the tail), so chained adds stay
+            # bounded by the row count instead of growing capA+capB each
+            # step and recompiling per new static shape.  +1 slot: a real
+            # -1 padding id sorts first and must not displace a real row.
+            limit = min(rows.shape[0], a.shape[0] + 1)
+            return RSPValue(summed[:limit], uniq[:limit], a.shape)
+        if _name == "mul":
+            if a_rsp and not b_rsp and not hasattr(b, "todense") \
+                    and tuple(getattr(b, "shape", ())) == a.shape:
+                safe = jnp.clip(a.indices, 0, a.shape[0] - 1)
+                return RSPValue(a.data * b[safe], a.indices, a.shape)
+            if b_rsp and not a_rsp and not hasattr(a, "todense") \
+                    and tuple(getattr(a, "shape", ())) == b.shape:
+                safe = jnp.clip(b.indices, 0, b.shape[0] - 1)
+                return RSPValue(a[safe] * b.data, b.indices, b.shape)
+        return _fn(densify(a), densify(b))
+    return impl
+
+
 for _name, _fn in {**_BINARY, **_BINARY_LOGIC}.items():
     _logic = _name in _BINARY_LOGIC
     if _logic:
         def _impl(attrs, a, b, _fn=_fn):
             return _fn(a, b).astype(a.dtype)
+    elif _name in _SPARSE_BINARY:
+        _impl = _binary_impl(_name, _fn)
     else:
         def _impl(attrs, a, b, _fn=_fn):
             return _fn(a, b)
@@ -164,7 +233,8 @@ for _name, _fn in {**_BINARY, **_BINARY_LOGIC}.items():
     if _name in _OLD_NAME and _OLD_NAME[_name] != primary:
         aliases.append(_OLD_NAME[_name])
     register(primary, aliases=aliases, nin=2,
-             input_names=["lhs", "rhs"])(_impl)
+             input_names=["lhs", "rhs"],
+             sparse_aware=_name in _SPARSE_BINARY)(_impl)
 
 # primary broadcast names referencing the same impls already aliased above;
 # also expose elemwise power alias `_power` handled above.

@@ -48,6 +48,20 @@ def dot(attrs, a, b):
     # row-sparse stay O(nnz); other sparse combinations fall back to dense
     # like the reference's storage-fallback executor
     from .sparse_vals import CSRValue, RSPValue, densify
+    if (isinstance(a, CSRValue) and attrs["transpose_a"]
+            and not attrs["transpose_b"]
+            and attrs.get("forward_stype") == "row_sparse"
+            and not isinstance(b, RSPValue) and not hasattr(b, "todense")
+            and b.ndim == 2):
+        # dot(csr.T, dense) -> ROW-SPARSE output with support = the csr's
+        # stored column ids (dot.cc:31 transpose variant; the reference's
+        # forward_stype request).  O(nnz), no (k, n) dense result
+        from .sparse_ops import dedup_rows
+        row_ids = a.row_ids()
+        cols = jnp.clip(a.indices, 0, a.shape[1] - 1)
+        contrib = a.data[:, None] * b[row_ids]             # (nnz, N)
+        rows, vals = dedup_rows(cols, contrib)
+        return RSPValue(vals, rows, (a.shape[1], b.shape[1]))
     if isinstance(a, CSRValue) and not attrs["transpose_b"]:
         if isinstance(b, RSPValue) and not attrs["transpose_a"]:
             # csr x rsp-stored rhs: gather only the stored rows the csr

@@ -32,12 +32,37 @@ def _reduce(fn):
     return impl
 
 
-for _name, _fn in {"sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+def _sum_impl(attrs, x):
+    """sum with O(nnz) full/row reduction on row_sparse input (the
+    reference's rsp sum kernel, broadcast_reduce_op_value.cc FComputeEx):
+    padded slots carry zero data so a plain data reduce is exact.  Axis
+    patterns a compressed reduce cannot express fall back to dense."""
+    from .sparse_vals import RSPValue, densify
+    if isinstance(x, RSPValue):
+        nd = len(x.shape)
+        axes = _norm_axes(attrs, nd)
+        if axes == tuple(range(nd)):
+            out = jnp.sum(x.data)
+            return out.reshape((1,) * nd) if attrs["keepdims"] else out
+        if axes == tuple(range(1, nd)) and not attrs["keepdims"]:
+            # per-row sums scattered to a dense vector (O(nnz))
+            rows = jnp.sum(x.data, axis=tuple(range(1, x.data.ndim)))
+            safe = jnp.clip(x.indices, 0, x.shape[0] - 1)
+            out = jnp.zeros((x.shape[0],), x.data.dtype)
+            return out.at[safe].add(jnp.where(x.indices >= 0, rows, 0))
+    x = densify(x)
+    axes = _norm_axes(attrs, x.ndim)
+    return jnp.sum(x, axis=axes, keepdims=attrs["keepdims"])
+
+
+register("sum", aliases=["sum_axis"], params=dict(_AXES),
+         sparse_aware=True)(_sum_impl)
+
+for _name, _fn in {"mean": jnp.mean, "prod": jnp.prod,
                    "nansum": jnp.nansum, "nanprod": jnp.nanprod,
                    "max": jnp.max, "min": jnp.min}.items():
-    register(_name, aliases=["sum_axis"] if _name == "sum" else
-             (["max_axis"] if _name == "max" else
-              (["min_axis"] if _name == "min" else [])),
+    register(_name, aliases=(["max_axis"] if _name == "max" else
+                             (["min_axis"] if _name == "min" else [])),
              params=dict(_AXES))(_reduce(_fn))
 
 

@@ -89,12 +89,18 @@ def test_dot_csr_dense_o_nnz():
 
 def test_dense_fallback_for_unaware_ops():
     """A sparse value flowing into a dense-only op densifies at the op
-    boundary (the storage-fallback executor semantic)."""
+    boundary (the storage-fallback executor semantic); f(0)!=0 unaries
+    like sigmoid stay dense-only because their result is dense by math."""
     rng = np.random.default_rng(4)
     x = _rand_sparse(rng, (4, 4))
     (csr,) = invoke_jax("cast_storage", {"stype": "csr"}, jnp.asarray(x))
-    (out,) = invoke_jax("relu", {}, csr)
-    np.testing.assert_allclose(out, np.maximum(x, 0))
+    (out,) = invoke_jax("sigmoid", {}, csr)
+    assert not hasattr(out, "todense")   # densified
+    np.testing.assert_allclose(out, 1 / (1 + np.exp(-x)), rtol=1e-5)
+    # while an f(0)=0 unary PRESERVES csr storage (r5 broadened dispatch)
+    (out2,) = invoke_jax("relu", {}, csr)
+    assert isinstance(out2, CSRValue)
+    np.testing.assert_allclose(densify(out2), np.maximum(x, 0))
 
 
 # ---------------------------------------------------------------------------
@@ -287,3 +293,111 @@ def test_optimizer_rsp_lazy_update(opt_name, extra):
     # equal the ORIGINAL weights under lazy semantics
     np.testing.assert_allclose(b[[0, 1, 3, 4, 6, 7]],
                                w0[[0, 1, 3, 4, 6, 7]], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Broadened sparse-aware dispatch (VERDICT r5 item #4): the rsp-preserving
+# unary family, sparse elemwise add/sub/mul, and dot's transpose variant run
+# O(nnz) through the registry instead of the densify fallback
+# (elemwise_unary_op_basic.cc:373-466, elemwise_binary_op_basic.cc,
+# dot.cc:31).  No-densify is asserted on the compiled program: an
+# unmistakable vocab extent must not appear in the lowered StableHLO.
+# ---------------------------------------------------------------------------
+
+def _big_rsp(rng, rows=199481, cap=6, dim=3):
+    touched = np.sort(rng.choice(rows, cap, replace=False)).astype(np.int64)
+    data = rng.standard_normal((cap, dim)).astype(np.float32)
+    return mx.nd.sparse.row_sparse_array((data, touched),
+                                         shape=(rows, dim)), touched, data
+
+
+def test_unary_preserves_rsp():
+    """f(0)=0 unaries keep row_sparse storage end to end (symbol graph),
+    never materializing the vocab-sized dense array."""
+    rng = np.random.RandomState(0)
+    w_nd, touched, data = _big_rsp(rng)
+    x = mx.sym.Variable("x", stype="row_sparse")
+    net = mx.sym.sqrt(mx.sym.square(x))
+    exe = net.bind(mx.cpu(), args={"x": w_nd}, grad_req={"x": "null"})
+    (out,) = exe.forward(is_train=False)
+    assert out.stype == "row_sparse"
+    got = out.data.asnumpy()
+    np.testing.assert_allclose(got, np.abs(data), rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(out.indices.asnumpy(), touched)
+
+
+def test_unary_rsp_eager():
+    """Eager FComputeEx path: mx.nd.relu on a RowSparseNDArray returns
+    row_sparse, O(nnz)."""
+    rng = np.random.RandomState(1)
+    w_nd, touched, data = _big_rsp(rng)
+    out = mx.nd.relu(w_nd)
+    assert out.stype == "row_sparse"
+    np.testing.assert_allclose(out.data.asnumpy(), np.maximum(data, 0))
+    np.testing.assert_array_equal(out.indices.asnumpy(), touched)
+
+
+def test_elemwise_add_rsp_union():
+    """add/sub(rsp, rsp) -> rsp with union support."""
+    a = mx.nd.sparse.row_sparse_array(
+        (np.array([[1.], [2.]], np.float32), np.array([1, 3], np.int64)),
+        shape=(6, 1))
+    b = mx.nd.sparse.row_sparse_array(
+        (np.array([[10.], [20.]], np.float32), np.array([3, 5], np.int64)),
+        shape=(6, 1))
+    out = mx.nd.elemwise_add(a, b)
+    assert out.stype == "row_sparse"
+    dense = out.tostype("default").asnumpy()[:, 0]
+    np.testing.assert_allclose(dense, [0, 1, 0, 12, 0, 20])
+    out2 = mx.nd.elemwise_sub(a, b)
+    assert out2.stype == "row_sparse"
+    np.testing.assert_allclose(out2.tostype("default").asnumpy()[:, 0],
+                               [0, 1, 0, -8, 0, -20])
+
+
+def test_elemwise_mul_rsp_dense():
+    rng = np.random.RandomState(2)
+    w_nd, touched, data = _big_rsp(rng, rows=40, cap=5, dim=2)
+    d = rng.standard_normal((40, 2)).astype(np.float32)
+    out = mx.nd.elemwise_mul(w_nd, mx.nd.array(d))
+    assert out.stype == "row_sparse"
+    np.testing.assert_allclose(out.data.asnumpy(), data * d[touched],
+                               rtol=1e-5)
+
+
+def test_dot_transpose_rsp_output():
+    """dot(csr.T, dense, forward_stype='row_sparse') emits rsp output with
+    support = the csr's stored columns, matching the dense result."""
+    rng = np.random.RandomState(3)
+    B, D, N = 8, 64, 3
+    idx = np.stack([np.sort(rng.choice(D, 4, replace=False))
+                    for _ in range(B)]).astype(np.int64)
+    val = rng.standard_normal((B, 4)).astype(np.float32)
+    csr = mx.nd.sparse.csr_matrix(
+        (val.reshape(-1), idx.reshape(-1), np.arange(0, B * 4 + 1, 4)),
+        shape=(B, D))
+    rhs = rng.standard_normal((B, N)).astype(np.float32)
+    out = mx.nd.dot(csr, mx.nd.array(rhs), transpose_a=True,
+                    forward_stype="row_sparse")
+    assert out.stype == "row_sparse"
+    dense = np.zeros((B, D), np.float32)
+    for i in range(B):
+        dense[i, idx[i]] = val[i]
+    np.testing.assert_allclose(out.tostype("default").asnumpy(),
+                               dense.T @ rhs, rtol=1e-4, atol=1e-5)
+
+
+def test_no_densify_unary_chain_hlo():
+    """The compiled fwd+bwd of an rsp chain (square -> sqrt -> retain-free
+    sum path) must not contain the vocab extent anywhere."""
+    rng = np.random.RandomState(4)
+    w_nd, touched, data = _big_rsp(rng)   # rows=199481
+    x = mx.sym.Variable("x", stype="row_sparse")
+    net = mx.sym.MakeLoss(mx.sym.sum(mx.sym.sqrt(mx.sym.square(x))))
+    exe = net.bind(mx.cpu(), args={"x": w_nd}, grad_req={"x": "write"})
+    text = exe.lowered_fwd_bwd_text()
+    assert "199481" not in text, \
+        "rsp unary chain materialized the vocab extent"
+    exe.forward(is_train=True)
+    exe.backward()
+    assert exe.grad_dict["x"].stype == "row_sparse"
