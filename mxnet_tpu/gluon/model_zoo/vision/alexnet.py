@@ -1,10 +1,27 @@
-"""AlexNet (reference python/mxnet/gluon/model_zoo/vision/alexnet.py)."""
+"""AlexNet, table-driven (Krizhevsky et al.; reference architecture:
+python/mxnet/gluon/model_zoo/vision/alexnet.py)."""
 from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
+from ._builder import assemble
 
 __all__ = ["AlexNet", "alexnet_fn", "alexnet"]
+
+_FEATURES = [
+    ("conv", 64, 11, 4, 2, {"act": "relu"}), ("pool", 3, 2, 0),
+    ("conv", 192, 5, 1, 2, {"act": "relu"}), ("pool", 3, 2, 0),
+    ("conv", 384, 3, 1, 1, {"act": "relu"}),
+    ("conv", 256, 3, 1, 1, {"act": "relu"}),
+    ("conv", 256, 3, 1, 1, {"act": "relu"}), ("pool", 3, 2, 0),
+    ("flatten",),
+]
+
+
+def _classifier_rows(classes):
+    return [("dense", 4096, {"act": "relu"}), ("dropout", 0.5),
+            ("dense", 4096, {"act": "relu"}), ("dropout", 0.5),
+            ("dense", classes)]
 
 
 class AlexNet(HybridBlock):
@@ -13,33 +30,13 @@ class AlexNet(HybridBlock):
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             with self.features.name_scope():
-                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4,
-                                            padding=2, activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Flatten())
-
+                assemble(self.features, _FEATURES)
             self.classifier = nn.HybridSequential(prefix="")
             with self.classifier.name_scope():
-                self.classifier.add(nn.Dense(4096, activation="relu"))
-                self.classifier.add(nn.Dropout(0.5))
-                self.classifier.add(nn.Dense(4096, activation="relu"))
-                self.classifier.add(nn.Dropout(0.5))
-                self.classifier.add(nn.Dense(classes))
+                assemble(self.classifier, _classifier_rows(classes))
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.classifier(x)
-        return x
+        return self.classifier(self.features(x))
 
 
 def alexnet_fn(pretrained=False, ctx=None, root=None, **kwargs):

@@ -1,25 +1,37 @@
-"""MobileNet (reference gluon/model_zoo/vision/mobilenet.py,
-Howard et al. 1704.04861)."""
+"""MobileNet v1, table-driven (Howard et al. 1704.04861; reference
+architecture: python/mxnet/gluon/model_zoo/vision/mobilenet.py).
+
+The whole body is one generated row table: a full-conv stem, then 13
+depthwise-separable pairs described by (width, stride) entries, scaled by
+the channel multiplier.  The assembler in _builder.py consumes it.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
+from ._builder import assemble, named_factory
 
 __all__ = ["MobileNet", "mobilenet1_0", "mobilenet0_75", "mobilenet0_5",
            "mobilenet0_25"]
 
-
-def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1):
-    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
-                      use_bias=False))
-    out.add(nn.BatchNorm(scale=True))
-    out.add(nn.Activation("relu"))
+# (pointwise output width, depthwise stride) for each separable pair;
+# the depthwise stage always runs at the PREVIOUS pair's width
+_SEPARABLE = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1),
+              (512, 2)] + [(512, 1)] * 5 + [(1024, 2), (1024, 1)]
 
 
-def _add_conv_dw(out, dw_channels, channels, stride):
-    _add_conv(out, channels=dw_channels, kernel=3, stride=stride, pad=1,
-              num_group=dw_channels)
-    _add_conv(out, channels=channels)
+def _body_rows(multiplier):
+    def m(width):
+        return int(width * multiplier)
+    rows = [("conv", m(32), 3, 2, 1, {"bias": False}), ("bn",), ("relu",)]
+    prev = 32
+    for width, stride in _SEPARABLE:
+        rows += [("conv", m(prev), 3, stride, 1,
+                  {"groups": m(prev), "bias": False}), ("bn",), ("relu",),
+                 ("conv", m(width), 1, 1, 0, {"bias": False}), ("bn",),
+                 ("relu",)]
+        prev = width
+    return rows + [("gap",), ("flatten",)]
 
 
 class MobileNet(HybridBlock):
@@ -28,27 +40,11 @@ class MobileNet(HybridBlock):
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             with self.features.name_scope():
-                _add_conv(self.features, channels=int(32 * multiplier),
-                          kernel=3, pad=1, stride=2)
-                dw_channels = [int(x * multiplier) for x in
-                               [32, 64] + [128] * 2 + [256] * 2
-                               + [512] * 6 + [1024]]
-                channels = [int(x * multiplier) for x in
-                            [64] + [128] * 2 + [256] * 2 + [512] * 6
-                            + [1024] * 2]
-                strides = [1, 2] * 3 + [1] * 5 + [2, 1]
-                for dwc, c, s in zip(dw_channels, channels, strides):
-                    _add_conv_dw(self.features, dw_channels=dwc, channels=c,
-                                 stride=s)
-                self.features.add(nn.GlobalAvgPool2D())
-                self.features.add(nn.Flatten())
-
+                assemble(self.features, _body_rows(multiplier))
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
@@ -56,25 +52,14 @@ def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        version_suffix = "{0:.2f}".format(multiplier)
-        if version_suffix in ("1.00", "0.50"):
-            version_suffix = version_suffix[:-1]
-        net.load_params(get_model_file("mobilenet%s" % version_suffix,
-                                       root=root), ctx=ctx)
+        tag = "%.2f" % multiplier
+        tag = tag[:-1] if tag.endswith("0") else tag   # 1.00 -> 1.0
+        net.load_params(get_model_file("mobilenet%s" % tag, root=root),
+                        ctx=ctx)
     return net
 
 
-def mobilenet1_0(**kwargs):
-    return get_mobilenet(1.0, **kwargs)
-
-
-def mobilenet0_75(**kwargs):
-    return get_mobilenet(0.75, **kwargs)
-
-
-def mobilenet0_5(**kwargs):
-    return get_mobilenet(0.5, **kwargs)
-
-
-def mobilenet0_25(**kwargs):
-    return get_mobilenet(0.25, **kwargs)
+mobilenet1_0 = named_factory("mobilenet1_0", get_mobilenet, 1.0)
+mobilenet0_75 = named_factory("mobilenet0_75", get_mobilenet, 0.75)
+mobilenet0_5 = named_factory("mobilenet0_5", get_mobilenet, 0.5)
+mobilenet0_25 = named_factory("mobilenet0_25", get_mobilenet, 0.25)

@@ -1,12 +1,19 @@
-"""ResNet v1/v2 for Gluon.
+"""ResNet v1/v2 for Gluon, table-driven.
 
-Reference: python/mxnet/gluon/model_zoo/vision/resnet.py (He et al. 1512.03385
-v1 with the torch-style stride-on-3x3 variant; v2 pre-activation 1603.05027).
+Reference architectures: python/mxnet/gluon/model_zoo/vision/resnet.py
+(He et al. 1512.03385 v1 in the torch-style stride-on-first-conv variant;
+1603.05027 v2 pre-activation).  Here each unit variant is ONE row table
+consumed by a generic ResidualUnit, and both network versions share one
+generic assembler — the architecture is data, not transcribed class
+bodies.  Parameterized-layer order matches the reference exactly (incl.
+its quirks: v1 bottleneck 1x1 convs keep their bias, v2 downsample is a
+bare conv), so parameter names and checkpoints are unchanged.
 """
 from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
+from ._builder import assemble, make_layer, named_factory
 
 __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "BottleneckV1", "BottleneckV2", "resnet18_v1", "resnet34_v1",
@@ -14,235 +21,147 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
            "get_resnet"]
 
-
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
+_NOBIAS = {"bias": False}
 
 
-class BasicBlockV1(HybridBlock):
-    """18/34-layer residual block, v1 (resnet.py BasicBlockV1)."""
+def _unit_rows(version, kind, c, s):
+    """Forward-order row table of one residual unit."""
+    q = c // 4
+    if version == 1:
+        if kind == "basic":
+            return [("conv", c, 3, s, 1, _NOBIAS), ("bn",), ("relu",),
+                    ("conv", c, 3, 1, 1, _NOBIAS), ("bn",)]
+        return [("conv", q, 1, s, 0), ("bn",), ("relu",),       # bias kept:
+                ("conv", q, 3, 1, 1, _NOBIAS), ("bn",), ("relu",),  # ref quirk
+                ("conv", c, 1, 1, 0), ("bn",)]
+    if kind == "basic":
+        return [("bn",), ("relu",), ("conv", c, 3, s, 1, _NOBIAS),
+                ("bn",), ("relu",), ("conv", c, 3, 1, 1, _NOBIAS)]
+    return [("bn",), ("relu",), ("conv", q, 1, 1, 0, _NOBIAS),
+            ("bn",), ("relu",), ("conv", q, 3, s, 1, _NOBIAS),
+            ("bn",), ("relu",), ("conv", c, 1, 1, 0, _NOBIAS)]
 
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+
+class ResidualUnit(HybridBlock):
+    """One residual unit assembled from a row table.
+
+    v1 (post-activation): rows live in ``self.body``; the skip path is an
+    optional conv+bn pair; output = relu(body(x) + skip(x)).
+    v2 (pre-activation): rows apply in sequence; the skip branches off the
+    FIRST activated tensor (after the leading bn+relu) through an optional
+    bare conv; output = chain(x) + skip.
+    """
+
+    def __init__(self, version, kind, channels, stride, downsample=False,
+                 in_channels=0, **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+        rows = _unit_rows(version, kind, channels, stride)
+        self._preact = version == 2
+        if not self._preact:
+            self.body = assemble(nn.HybridSequential(prefix=""), rows)
+            if downsample:
+                self.downsample = assemble(
+                    nn.HybridSequential(prefix=""),
+                    [("conv", channels, 1, stride, 0, _NOBIAS), ("bn",)])
+            else:
+                self.downsample = None
         else:
-            self.downsample = None
+            self._chain = []
+            for row in rows:
+                layer = make_layer(row)
+                self.register_child(layer)
+                self._chain.append(layer)
+            self._tap = rows.index(("relu",)) \
+                if ("relu",) in rows else 0
+            if downsample:
+                self.downsample = nn.Conv2D(channels, 1, stride,
+                                            use_bias=False,
+                                            in_channels=in_channels)
+            else:
+                self.downsample = None
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        x = F.Activation(residual + x, act_type="relu")
-        return x
+        if not self._preact:
+            skip = x if self.downsample is None else self.downsample(x)
+            return F.Activation(self.body(x) + skip, act_type="relu")
+        skip = x
+        for i, layer in enumerate(self._chain):
+            x = layer(x)
+            if i == self._tap and self.downsample is not None:
+                skip = self.downsample(x)
+        return x + skip
 
 
-class BottleneckV1(HybridBlock):
-    """50+-layer bottleneck, v1 (resnet.py BottleneckV1)."""
-
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        x = F.Activation(x + residual, act_type="relu")
-        return x
+def _unit_factory(version, kind):
+    class _Unit(ResidualUnit):
+        def __init__(self, channels, stride, downsample=False,
+                     in_channels=0, **kwargs):
+            super().__init__(version, kind, channels, stride,
+                             downsample=downsample,
+                             in_channels=in_channels, **kwargs)
+    return _Unit
 
 
-class BasicBlockV2(HybridBlock):
-    """Pre-activation basic block, v2 (resnet.py BasicBlockV2)."""
-
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
+BasicBlockV1 = _unit_factory(1, "basic")
+BottleneckV1 = _unit_factory(1, "bottleneck")
+BasicBlockV2 = _unit_factory(2, "basic")
+BottleneckV2 = _unit_factory(2, "bottleneck")
+for _cls, _nm in ((BasicBlockV1, "BasicBlockV1"),
+                  (BottleneckV1, "BottleneckV1"),
+                  (BasicBlockV2, "BasicBlockV2"),
+                  (BottleneckV2, "BottleneckV2")):
+    _cls.__name__ = _cls.__qualname__ = _nm
 
 
-class BottleneckV2(HybridBlock):
-    """Pre-activation bottleneck, v2 (resnet.py BottleneckV2)."""
+class _ResNet(HybridBlock):
+    """Generic ResNet assembler: stem rows + staged units + head rows."""
 
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
-                               use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
+    _version = None
 
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
-
-
-class ResNetV1(HybridBlock):
-    """ResNet v1 (resnet.py ResNetV1)."""
-
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+    def __init__(self, block, layers, channels, classes=1000,
+                 thumbnail=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
+        v = self._version
+        stem = [("conv", channels[0], 3, 1, 1, _NOBIAS)] if thumbnail else [
+            ("conv", channels[0], 7, 2, 3, _NOBIAS), ("bn",), ("relu",),
+            ("pool", 3, 2, 1)]
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
-            self.features.add(nn.GlobalAvgPool2D())
-
-            self.output = nn.Dense(classes, in_units=channels[-1])
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
+            if v == 2:
+                # raw-input normalization, the v2 graph's bn_data
+                self.features.add(nn.BatchNorm(scale=False, center=False))
+            assemble(self.features, stem)
+            width = channels[0]
+            for i, n_units in enumerate(layers):
+                stage = nn.HybridSequential(prefix="stage%d_" % (i + 1))
+                with stage.name_scope():
+                    out = channels[i + 1]
+                    stage.add(block(out, 1 if i == 0 else 2, out != width,
+                                    in_channels=width, prefix=""))
+                    for _ in range(n_units - 1):
+                        stage.add(block(out, 1, False, in_channels=out,
+                                        prefix=""))
+                self.features.add(stage)
+                width = out
+            head = [("gap",)] if v == 1 else [("bn",), ("relu",), ("gap",),
+                                              ("flatten",)]
+            assemble(self.features, head)
+            self.output = nn.Dense(classes, in_units=width)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-class ResNetV2(HybridBlock):
-    """ResNet v2 pre-activation (resnet.py ResNetV2)."""
-
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
-                in_channels = channels[i + 1]
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+class ResNetV1(_ResNet):
+    _version = 1
 
 
-# block type, layer counts, channels per spec (resnet.py resnet_spec)
+class ResNetV2(_ResNet):
+    _version = 2
+
+
+# depth -> (unit kind, units per stage, stage widths) — resnet_spec parity
 resnet_spec = {
     18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
     34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
@@ -259,15 +178,14 @@ resnet_block_versions = [
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
                **kwargs):
-    assert num_layers in resnet_spec, \
-        "Invalid number of layers: %d. Options are %s" % (
-            num_layers, str(resnet_spec.keys()))
-    block_type, layers, channels = resnet_spec[num_layers]
-    assert version >= 1 and version <= 2, \
-        "Invalid resnet version: %d. Options are 1 and 2." % version
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+    if num_layers not in resnet_spec:
+        raise ValueError("no resnet of depth %d; known depths: %s"
+                         % (num_layers, sorted(resnet_spec)))
+    if version not in (1, 2):
+        raise ValueError("resnet version must be 1 or 2, got %r" % version)
+    kind, layers, channels = resnet_spec[num_layers]
+    net = resnet_net_versions[version - 1](
+        resnet_block_versions[version - 1][kind], layers, channels, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
         net.load_params(get_model_file("resnet%d_v%d" % (num_layers, version),
@@ -275,44 +193,13 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
-
-
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
-
-
-_models = {}
+resnet18_v1 = named_factory("resnet18_v1", get_resnet, 1, 18)
+resnet34_v1 = named_factory("resnet34_v1", get_resnet, 1, 34)
+resnet50_v1 = named_factory("resnet50_v1", get_resnet, 1, 50)
+resnet101_v1 = named_factory("resnet101_v1", get_resnet, 1, 101)
+resnet152_v1 = named_factory("resnet152_v1", get_resnet, 1, 152)
+resnet18_v2 = named_factory("resnet18_v2", get_resnet, 2, 18)
+resnet34_v2 = named_factory("resnet34_v2", get_resnet, 2, 34)
+resnet50_v2 = named_factory("resnet50_v2", get_resnet, 2, 50)
+resnet101_v2 = named_factory("resnet101_v2", get_resnet, 2, 101)
+resnet152_v2 = named_factory("resnet152_v2", get_resnet, 2, 152)

@@ -1,18 +1,42 @@
-"""VGG 11/13/16/19 ±BN (reference gluon/model_zoo/vision/vgg.py)."""
+"""VGG 11/13/16/19, with and without BatchNorm, table-driven
+(Simonyan & Zisserman 1409.1556; reference architecture:
+python/mxnet/gluon/model_zoo/vision/vgg.py).
+
+One spec table (convs-per-stage x stage widths) expands into a row list
+for the shared assembler; the classifier tail is three Dense rows.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
 from ....initializer import Xavier
+from ._builder import assemble, named_factory
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "vgg11_bn", "vgg13_bn",
            "vgg16_bn", "vgg19_bn", "get_vgg"]
-
 
 vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
             13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
             16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
             19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+
+_CONV_INIT = {"init": Xavier(rnd_type="gaussian", factor_type="out",
+                             magnitude=2)}
+
+
+def _feature_rows(layers, filters, batch_norm):
+    rows = []
+    for count, width in zip(layers, filters):
+        for _ in range(count):
+            rows.append(("conv", width, 3, 1, 1, _CONV_INIT))
+            if batch_norm:
+                rows.append(("bn",))
+            rows.append(("relu",))
+        rows.append(("pool", 2, 2, 0))
+    for _ in range(2):
+        rows += [("dense", 4096, {"act": "relu", "init": "normal"}),
+                 ("dropout", 0.5)]
+    return rows
 
 
 class VGG(HybridBlock):
@@ -21,37 +45,14 @@ class VGG(HybridBlock):
         super().__init__(**kwargs)
         assert len(layers) == len(filters)
         with self.name_scope():
-            self.features = self._make_features(layers, filters, batch_norm)
-            self.features.add(nn.Dense(4096, activation="relu",
-                                       weight_initializer="normal",
-                                       bias_initializer="zeros"))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.features.add(nn.Dense(4096, activation="relu",
-                                       weight_initializer="normal",
-                                       bias_initializer="zeros"))
-            self.features.add(nn.Dropout(rate=0.5))
+            self.features = assemble(
+                nn.HybridSequential(prefix=""),
+                _feature_rows(layers, filters, batch_norm))
             self.output = nn.Dense(classes, weight_initializer="normal",
                                    bias_initializer="zeros")
 
-    def _make_features(self, layers, filters, batch_norm):
-        featurizer = nn.HybridSequential(prefix="")
-        for i, num in enumerate(layers):
-            for _ in range(num):
-                featurizer.add(nn.Conv2D(filters[i], kernel_size=3, padding=1,
-                                         weight_initializer=Xavier(
-                                             rnd_type="gaussian",
-                                             factor_type="out", magnitude=2),
-                                         bias_initializer="zeros"))
-                if batch_norm:
-                    featurizer.add(nn.BatchNorm())
-                featurizer.add(nn.Activation("relu"))
-            featurizer.add(nn.MaxPool2D(strides=2))
-        return featurizer
-
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
@@ -59,44 +60,17 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     net = VGG(layers, filters, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        batch_norm_suffix = "_bn" if kwargs.get("batch_norm") else ""
-        net.load_params(get_model_file("vgg%d%s" % (num_layers,
-                                                    batch_norm_suffix),
+        tag = "_bn" if kwargs.get("batch_norm") else ""
+        net.load_params(get_model_file("vgg%d%s" % (num_layers, tag),
                                        root=root), ctx=ctx)
     return net
 
 
-def vgg11(**kwargs):
-    return get_vgg(11, **kwargs)
-
-
-def vgg13(**kwargs):
-    return get_vgg(13, **kwargs)
-
-
-def vgg16(**kwargs):
-    return get_vgg(16, **kwargs)
-
-
-def vgg19(**kwargs):
-    return get_vgg(19, **kwargs)
-
-
-def vgg11_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(11, **kwargs)
-
-
-def vgg13_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(13, **kwargs)
-
-
-def vgg16_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(16, **kwargs)
-
-
-def vgg19_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(19, **kwargs)
+vgg11 = named_factory("vgg11", get_vgg, 11)
+vgg13 = named_factory("vgg13", get_vgg, 13)
+vgg16 = named_factory("vgg16", get_vgg, 16)
+vgg19 = named_factory("vgg19", get_vgg, 19)
+vgg11_bn = named_factory("vgg11_bn", get_vgg, 11, batch_norm=True)
+vgg13_bn = named_factory("vgg13_bn", get_vgg, 13, batch_norm=True)
+vgg16_bn = named_factory("vgg16_bn", get_vgg, 16, batch_norm=True)
+vgg19_bn = named_factory("vgg19_bn", get_vgg, 19, batch_norm=True)

@@ -202,14 +202,22 @@ def _binary_impl(name, fn):
             limit = min(rows.shape[0], a.shape[0] + 1)
             return RSPValue(summed[:limit], uniq[:limit], a.shape)
         if _name == "mul":
+            # mask padding slots: 0 * inf/nan from the gathered dense row
+            # must not break the 'padding data is 0' invariant
             if a_rsp and not b_rsp and not hasattr(b, "todense") \
                     and tuple(getattr(b, "shape", ())) == a.shape:
                 safe = jnp.clip(a.indices, 0, a.shape[0] - 1)
-                return RSPValue(a.data * b[safe], a.indices, a.shape)
+                valid = (a.indices >= 0).reshape(
+                    (-1,) + (1,) * (a.data.ndim - 1))
+                return RSPValue(jnp.where(valid, a.data * b[safe], 0),
+                                a.indices, a.shape)
             if b_rsp and not a_rsp and not hasattr(a, "todense") \
                     and tuple(getattr(a, "shape", ())) == b.shape:
                 safe = jnp.clip(b.indices, 0, b.shape[0] - 1)
-                return RSPValue(a[safe] * b.data, b.indices, b.shape)
+                valid = (b.indices >= 0).reshape(
+                    (-1,) + (1,) * (b.data.ndim - 1))
+                return RSPValue(jnp.where(valid, a[safe] * b.data, 0),
+                                b.indices, b.shape)
         return _fn(densify(a), densify(b))
     return impl
 

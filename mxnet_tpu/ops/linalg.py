@@ -61,7 +61,11 @@ def dot(attrs, a, b):
         cols = jnp.clip(a.indices, 0, a.shape[1] - 1)
         contrib = a.data[:, None] * b[row_ids]             # (nnz, N)
         rows, vals = dedup_rows(cols, contrib)
-        return RSPValue(vals, rows, (a.shape[1], b.shape[1]))
+        # clamp capacity to the output's row count (dedup compacts real
+        # ids to the front; +1 covers a possible explicit -1 slot)
+        limit = min(cols.shape[0], a.shape[1] + 1)
+        return RSPValue(vals[:limit], rows[:limit],
+                        (a.shape[1], b.shape[1]))
     if isinstance(a, CSRValue) and not attrs["transpose_b"]:
         if isinstance(b, RSPValue) and not attrs["transpose_a"]:
             # csr x rsp-stored rhs: gather only the stored rows the csr

@@ -280,3 +280,39 @@ def test_block_save_load_roundtrip(tmp_path):
     net2.load_params(path, mx.cpu())
     y2 = net2(x).asnumpy()
     np.testing.assert_allclose(y1, y2, rtol=1e-6)
+
+
+def test_model_zoo_param_names_stable():
+    """The r5 table-driven zoo rewrite must stay checkpoint-compatible:
+    parameter-name digests captured from the pre-rewrite classes."""
+    import hashlib
+    zoo = gluon.model_zoo.vision
+
+    def digest(net):
+        # strip the net-level prefix: it carries the process-wide instance
+        # counter (order-dependent across tests), and save_params strips it
+        # too — the stripped names are the checkpoint contract
+        names = sorted(k[len(net.prefix):]
+                       for k in net.collect_params().keys())
+        return (hashlib.sha256("\n".join(names).encode()).hexdigest()[:16],
+                len(names))
+
+    expected = {
+        "resnet18_v1": ("6a7f0b648e49d072", 102),
+        "resnet50_v1": ("3cd872f679085f3c", 299),
+        "resnet18_v2": ("6bbaf610941c4837", 98),
+        "resnet50_v2": ("0e4f949c1c42fa07", 259),
+        "mobilenet1_0": ("2659607d2096c3a9", 137),
+        "vgg11": ("a4bc9d6b177ca551", 22),
+        "vgg16_bn": ("94e9598facd36ced", 84),
+        "alexnet": ("5a0fac7afd50f1ea", 16),
+    }
+    builders = {
+        "resnet18_v1": zoo.resnet18_v1, "resnet50_v1": zoo.resnet50_v1,
+        "resnet18_v2": zoo.resnet18_v2, "resnet50_v2": zoo.resnet50_v2,
+        "mobilenet1_0": zoo.mobilenet1_0, "vgg11": zoo.vgg11,
+        "vgg16_bn": zoo.vgg16_bn, "alexnet": zoo.alexnet,
+    }
+    for name, want in expected.items():
+        got = digest(builders[name](classes=10))
+        assert got == want, (name, got, want)
