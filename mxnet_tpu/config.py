@@ -44,6 +44,15 @@ VARIABLES = {v.name: v for v in [
          "during backward instead of storing them (the reference's "
          "mirror pass, graph_executor.cc:282; here jax.checkpoint around "
          "the fused step's forward)."),
+    _Var("MXNET_FUSED_UNIT_MIN_FILTER", int, 0,
+         "Minimum num_filter for unit_impl='fused' residual units to use "
+         "the Pallas block-kernel tier (models/resnet.py); narrower "
+         "units keep the XLA path.  0 = fuse every eligible unit."),
+    _Var("MXNET_FUSED_UNIT_C3", str, "auto",
+         "Middle-conv path inside fused units: 'auto' = Pallas 3x3 "
+         "where its VMEM model fits (ops/fused_unit.py _c3_bwd_fits), "
+         "'xla' = always the XLA segment (measured faster on v5e: the "
+         "Pallas 3x3 runs far below line rate at small spatial sizes)."),
     _Var("MXNET_CPU_WORKER_NTHREADS", int, 4,
          "Default worker-thread count for host-side pipelines "
          "(ImageRecordIter preprocess_threads default; the reference's "

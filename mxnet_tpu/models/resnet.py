@@ -7,10 +7,40 @@ Reference: example/image-classification/symbols/resnet.py (He et al.
 from .. import symbol as sym
 
 
+def _fused_unit(data, num_filter, name, bn_mom):
+    """The stride-1 dim-match bottleneck unit as ONE fused op backed by
+    the Pallas kernel tier (ops/fused_unit.py): BN+ReLU prologues and
+    batch-stats/BN-reduction epilogues live inside the conv kernels, so
+    normalized activations never cross HBM.  Parameter and aux names
+    match the unfused subgraph exactly — checkpoints interchange."""
+    v = sym.Variable
+    return sym._contrib_FusedBottleneckUnit(
+        data,
+        gamma1=v(name + "_bn1_gamma"), beta1=v(name + "_bn1_beta"),
+        weight1=v(name + "_conv1_weight"),
+        gamma2=v(name + "_bn2_gamma"), beta2=v(name + "_bn2_beta"),
+        weight2=v(name + "_conv2_weight"),
+        gamma3=v(name + "_bn3_gamma"), beta3=v(name + "_bn3_beta"),
+        weight3=v(name + "_conv3_weight"),
+        moving_mean1=v(name + "_bn1_moving_mean"),
+        moving_var1=v(name + "_bn1_moving_var"),
+        moving_mean2=v(name + "_bn2_moving_mean"),
+        moving_var2=v(name + "_bn2_moving_var"),
+        moving_mean3=v(name + "_bn3_moving_mean"),
+        moving_var3=v(name + "_bn3_moving_var"),
+        num_filter=num_filter, eps=2e-5, momentum=bn_mom,
+        layout="NHWC", name=name + "_fused")
+
+
 def _residual_unit(data, num_filter, stride, dim_match, name,
                    bottle_neck=True, bn_mom=0.9, layout="NCHW",
-                   bn_axis=1):
+                   bn_axis=1, unit_impl="plain"):
     """Pre-activation residual unit (symbols/resnet.py residual_unit)."""
+    if (unit_impl == "fused" and bottle_neck and dim_match
+            and layout == "NHWC" and stride == (1, 1)):
+        from .. import config
+        if num_filter >= config.get("MXNET_FUSED_UNIT_MIN_FILTER"):
+            return _fused_unit(data, num_filter, name, bn_mom)
     if bottle_neck:
         bn1 = sym.BatchNorm(data, fix_gamma=False, eps=2e-5, momentum=bn_mom,
                             name=name + "_bn1", axis=bn_axis)
@@ -95,7 +125,8 @@ def _s2d_stem(data, num_filter, height, layout):
 
 
 def _resnet(units, num_stages, filter_list, num_classes, image_shape,
-            bottle_neck=True, bn_mom=0.9, layout="NCHW", stem="conv7"):
+            bottle_neck=True, bn_mom=0.9, layout="NCHW", stem="conv7",
+            unit_impl="plain"):
     """symbols/resnet.py resnet()."""
     bn_axis = 3 if layout == "NHWC" else 1
     data = sym.Variable("data")
@@ -143,12 +174,14 @@ def _resnet(units, num_stages, filter_list, num_classes, image_shape,
         body = _residual_unit(body, filter_list[i + 1], stride, False,
                               name="stage%d_unit%d" % (i + 1, 1),
                               bottle_neck=bottle_neck, bn_mom=bn_mom,
-                              layout=layout, bn_axis=bn_axis)
+                              layout=layout, bn_axis=bn_axis,
+                              unit_impl=unit_impl)
         for j in range(units[i] - 1):
             body = _residual_unit(body, filter_list[i + 1], (1, 1), True,
                                   name="stage%d_unit%d" % (i + 1, j + 2),
                                   bottle_neck=bottle_neck, bn_mom=bn_mom,
-                                  layout=layout, bn_axis=bn_axis)
+                                  layout=layout, bn_axis=bn_axis,
+                                  unit_impl=unit_impl)
     bn1 = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
                         name="bn1", axis=bn_axis)
     relu1 = sym.Activation(bn1, act_type="relu", name="relu1")
@@ -169,12 +202,17 @@ _SPECS = {
 
 def get_resnet_symbol(num_classes=1000, num_layers=50,
                       image_shape=(3, 224, 224), layout="NCHW",
-                      stem="conv7"):
+                      stem="conv7", unit_impl="plain"):
     """Build a ResNet symbol (symbols/resnet.py get_symbol).
 
     stem='s2d' (NHWC only): exact space-to-depth reformulation of the
     7x7/s2 stem — same parameters, same outputs, ~4x better MXU lane
-    utilization on the C=3 input (see _s2d_stem)."""
+    utilization on the C=3 input (see _s2d_stem).
+
+    unit_impl='fused' (NHWC bottleneck only): stride-1 dim-match units
+    run as single fused ops over the Pallas kernel tier
+    (ops/fused_unit.py) — same parameters, same math, fewer HBM passes;
+    transition units keep the XLA path."""
     nchannel, height, _ = image_shape
     if height <= 28:
         num_stages = 3
@@ -199,4 +237,5 @@ def get_resnet_symbol(num_classes=1000, num_layers=50,
             raise ValueError("no experiments done on num_layers %d" % num_layers)
         units, bottle_neck = _SPECS[num_layers]
     return _resnet(units, num_stages, filter_list, num_classes, image_shape,
-                   bottle_neck, layout=layout, stem=stem)
+                   bottle_neck, layout=layout, stem=stem,
+                   unit_impl=unit_impl)

@@ -21,3 +21,4 @@ from . import contrib_misc  # noqa: F401
 from . import contrib_rcnn  # noqa: F401
 from . import contrib_deform  # noqa: F401
 from . import sparse_ops    # noqa: F401
+from . import fused_unit    # noqa: F401

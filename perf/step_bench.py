@@ -31,6 +31,9 @@ def main():
                     default="native")
     ap.add_argument("--stem", choices=["conv7", "s2d", "fused"],
                     default="conv7")
+    ap.add_argument("--units", choices=["plain", "fused"], default="plain",
+                    help="fused = dim-match bottleneck units through the "
+                         "Pallas block-kernel tier (ops/fused_unit.py)")
     ap.add_argument("--remat", choices=["none", "full", "names"],
                     default="none",
                     help="names = save only conv outputs/BN stats/pool, "
@@ -63,7 +66,7 @@ def main():
 
     net = get_resnet_symbol(num_classes=1000, num_layers=50,
                             image_shape=(3, image, image), layout="NHWC",
-                            stem=args.stem)
+                            stem=args.stem, unit_impl=args.units)
     arg_names = net.list_arguments()
     aux_names = net.list_auxiliary_states()
     graph_fn = build_graph_fn(net, arg_names, aux_names)

@@ -1,0 +1,200 @@
+"""_contrib_FusedBottleneckUnit — the Pallas block-scope kernel tier
+(ops/fused_unit.py; VERDICT r4 next-round item #1).
+
+Equivalence strategy:
+  * UNIT level is strict: the fused op must match the unfused
+    bn-relu-conv composition to f32 rounding (~1e-5 relative) on the
+    output and every gradient — this is where a math bug would show.
+  * MODEL level cannot use tight elementwise tolerances: a measured
+    control shows a 1e-6 perturbation of ONE weight in the PLAIN
+    ResNet-50 graph moves some grads by up to ~17% relative (BN chains +
+    ReLU mask flips amplify chaotically with depth).  Fused-vs-plain
+    differences sit far below that floor (<1%), so the model-level tests
+    check structure (identical arg/aux sets), forward agreement, and
+    that both variants train with closely tracking losses.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.fused_unit import fused_bottleneck_unit
+
+EPS = 2e-5
+
+
+def _params(rng, c, dtype=np.float32):
+    cq = c // 4
+    mk = lambda *s: jnp.asarray(rng.standard_normal(s).astype(dtype) * 0.1)
+    pos = lambda n: jnp.asarray(rng.uniform(0.5, 1.5, n).astype(dtype))
+    return dict(
+        g1=pos(c), b1=mk(c), w1=mk(cq, 1, 1, c),
+        g2=pos(cq), b2=mk(cq), w2=mk(cq, 3, 3, cq),
+        g3=pos(cq), b3=mk(cq), w3=mk(c, 1, 1, cq))
+
+
+def _bnrelu(x, g, b):
+    mu = jnp.mean(x.astype(jnp.float32), axis=(0, 1, 2))
+    var = jnp.var(x.astype(jnp.float32), axis=(0, 1, 2))
+    xh = (x - mu) / jnp.sqrt(var + EPS)
+    return jnp.maximum(g * xh + b, 0).astype(x.dtype)
+
+
+def _conv(x, w, pad):
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(pad, pad)] * 2,
+        dimension_numbers=("NHWC", "OHWI", "NHWC"),
+        preferred_element_type=x.dtype)
+
+
+def _unfused(data, p):
+    a1 = _bnrelu(data, p["g1"], p["b1"])
+    y1 = _conv(a1, p["w1"], 0)
+    a2 = _bnrelu(y1, p["g2"], p["b2"])
+    y2 = _conv(a2, p["w2"], 1)
+    a3 = _bnrelu(y2, p["g3"], p["b3"])
+    return _conv(a3, p["w3"], 0) + data
+
+
+def _fused(data, p, training=True):
+    c = data.shape[-1]
+    cq = c // 4
+    attrs = {"num_filter": c, "eps": EPS, "momentum": 0.9,
+             "_training": training, "layout": "NHWC"}
+    z = lambda n: jnp.zeros((n,), jnp.float32)
+    o = lambda n: jnp.ones((n,), jnp.float32)
+    return fused_bottleneck_unit(
+        attrs, data, p["g1"], p["b1"], p["w1"], p["g2"], p["b2"], p["w2"],
+        p["g3"], p["b3"], p["w3"], z(c), o(c), z(cq), o(cq), z(cq), o(cq))
+
+
+CASES = [(2, 8, 8, 32), (3, 7, 5, 16), (2, 14, 14, 64)]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_unit_forward_matches(case):
+    n, h, w, c = case
+    rng = np.random.RandomState(hash(case) % 2**31)
+    data = jnp.asarray(rng.standard_normal((n, h, w, c)).astype(np.float32))
+    p = _params(rng, c)
+    out_f = _fused(data, p)[0]
+    out_u = _unfused(data, p)
+    np.testing.assert_allclose(out_f, out_u, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_unit_grads_match(case):
+    n, h, w, c = case
+    rng = np.random.RandomState(hash(case) % 2**31)
+    data = jnp.asarray(rng.standard_normal((n, h, w, c)).astype(np.float32))
+    p = _params(rng, c)
+    keys = sorted(p)
+
+    def loss_f(data_, *vals):
+        q = dict(zip(keys, vals))
+        return jnp.sum(jnp.tanh(_fused(data_, q)[0]))
+
+    def loss_u(data_, *vals):
+        q = dict(zip(keys, vals))
+        return jnp.sum(jnp.tanh(_unfused(data_, q)))
+
+    vals = tuple(p[k] for k in keys)
+    nargs = tuple(range(len(vals) + 1))
+    gf = jax.grad(loss_f, argnums=nargs)(data, *vals)
+    gu = jax.grad(loss_u, argnums=nargs)(data, *vals)
+    for name, a, b in zip(["data"] + keys, gf, gu):
+        scale = float(jnp.abs(b).max()) + 1e-6
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=3e-5 * max(scale, 1.0),
+            err_msg=name)
+
+
+def test_unit_aux_updates_match():
+    """Moving-stat write-backs equal the unfused BatchNorm updates."""
+    n, h, w, c = 2, 8, 8, 32
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(rng.standard_normal((n, h, w, c)).astype(np.float32))
+    p = _params(rng, c)
+    outs = _fused(data, p)
+    mm1, mv1 = outs[1], outs[2]
+    mu0 = np.mean(np.asarray(data, np.float64), axis=(0, 1, 2))
+    var0 = np.var(np.asarray(data, np.float64), axis=(0, 1, 2))
+    np.testing.assert_allclose(mm1, 0.9 * 0 + 0.1 * mu0, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(mv1, 0.9 * 1 + 0.1 * var0, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_unit_eval_mode():
+    """Eval mode normalizes with the moving stats (reference BatchNorm
+    use_global_stats path) and leaves them unchanged."""
+    n, h, w, c = 2, 8, 8, 32
+    cq = c // 4
+    rng = np.random.RandomState(1)
+    data = jnp.asarray(rng.standard_normal((n, h, w, c)).astype(np.float32))
+    p = _params(rng, c)
+    outs = _fused(data, p, training=False)
+    # reference eval composition with the same (zero-mean, unit-var)
+    # moving stats
+    def ev(x, g, b):
+        return jnp.maximum(g * x / np.sqrt(1.0 + EPS) + b, 0)
+    a1 = ev(data, p["g1"], p["b1"])
+    y1 = _conv(a1, p["w1"], 0)
+    a2 = ev(y1, p["g2"], p["b2"])
+    y2 = _conv(a2, p["w2"], 1)
+    a3 = ev(y2, p["g3"], p["b3"])
+    ref = _conv(a3, p["w3"], 0) + data
+    np.testing.assert_allclose(outs[0], ref, rtol=2e-5, atol=2e-5)
+
+
+def test_model_fused_units_structure_and_training():
+    """ResNet-50 with unit_impl='fused': identical parameter/aux sets,
+    agreeing forward, and a short training run whose loss tracks the
+    plain graph (see module docstring for why elementwise grad
+    comparison at depth is not meaningful)."""
+    import zlib
+    from mxnet_tpu.models import get_resnet_symbol
+    kw = dict(num_classes=10, num_layers=50, image_shape=(3, 64, 64),
+              layout="NHWC")
+    net_a = get_resnet_symbol(**kw)
+    net_b = get_resnet_symbol(unit_impl="fused", **kw)
+    batch = 4
+    shapes = {"data": (batch, 64, 64, 3), "softmax_label": (batch,)}
+    exe = {t: n.simple_bind(mx.cpu(), **shapes)
+           for t, n in (("std", net_a), ("fused", net_b))}
+    assert set(exe["std"].arg_dict) == set(exe["fused"].arg_dict)
+    assert set(exe["std"].aux_dict) == set(exe["fused"].aux_dict)
+    rng = np.random.RandomState(0)
+    init = {n: np.random.RandomState((zlib.crc32(n.encode()) + 8) % 2**31)
+            .uniform(-0.1, 0.1, a.shape).astype(np.float32)
+            for n, a in exe["std"].arg_dict.items()
+            if n not in ("data", "softmax_label")}
+    data = rng.uniform(0, 1, shapes["data"]).astype(np.float32)
+    label = rng.randint(0, 10, (batch,)).astype(np.float32)
+    losses = {}
+    for t, ex in exe.items():
+        for n, a in ex.arg_dict.items():
+            a[:] = data if n == "data" else (
+                label if n == "softmax_label" else init[n])
+        traj = []
+        lr = 0.05
+        for _ in range(6):
+            (y,) = ex.forward(is_train=True)
+            probs = y.asnumpy()
+            traj.append(float(-np.log(
+                probs[np.arange(batch), label.astype(int)] + 1e-8).mean()))
+            ex.backward()
+            for n, g in ex.grad_dict.items():
+                if g is None or n in ("data", "softmax_label"):
+                    continue
+                arr = ex.arg_dict[n]
+                arr[:] = arr.asnumpy() - lr * g.asnumpy()
+        losses[t] = traj
+    # forward agreement on the first step (fresh identical params)
+    assert abs(losses["fused"][0] - losses["std"][0]) < 1e-3, losses
+    # both learn, and trajectories track each other
+    for t in losses:
+        assert losses[t][-1] < losses[t][0], losses
+    for a, b in zip(losses["fused"], losses["std"]):
+        assert abs(a - b) < 0.15 * max(1.0, abs(b)), losses
