@@ -554,19 +554,28 @@ def _fused_unit_core(eps, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
 
 
 def _fused_unit_fwd_impl(eps, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
-                         mu0, var0):
+                         mu0, var0, fixed_stats=None):
+    """The conv1 -> conv2 -> conv3+skip kernel chain.  Training mode
+    (fixed_stats None) finalizes each interior BN's batch stats from the
+    previous kernel's epilogue; eval passes the moving stats as
+    fixed_stats=(mu1, var1, mu2, var2) and skips the epilogues — ONE
+    chain serves both modes so they cannot drift."""
+    training = fixed_stats is None
     n, h, w_, c = data.shape
     rows = n * h * w_
     x2d = data.reshape(rows, c)
     sc1, sh1, _, _, _ = _bn_vectors(mu0, var0, g1, b1, eps)
-    y1_2d, s1, ss1 = _mm_fwd(x2d, _w2d(w1), sc1, sh1, True, data.dtype)
+    y1_2d, s1, ss1 = _mm_fwd(x2d, _w2d(w1), sc1, sh1, training,
+                             data.dtype)
     cq = w1.shape[0]
-    mu1, var1 = _stats_from_sums(s1, ss1, rows)
+    mu1, var1 = _stats_from_sums(s1, ss1, rows) if training \
+        else (fixed_stats[0], fixed_stats[1])
     sc2, sh2, _, _, _ = _bn_vectors(mu1, var1, g2, b2, eps)
     y1 = y1_2d.reshape(n, h, w_, cq)
     c3_fwd = _c3_fwd if _c3_use_pallas_fwd(h, w_, cq) else _c3_fwd_xla
     y2, s2, ss2 = c3_fwd(y1, _w4(w2), sc2, sh2, data.dtype)
-    mu2, var2 = _stats_from_sums(s2, ss2, rows)
+    mu2, var2 = _stats_from_sums(s2, ss2, rows) if training \
+        else (fixed_stats[2], fixed_stats[3])
     sc3, sh3, _, _, _ = _bn_vectors(mu2, var2, g3, b3, eps)
     out2d = _mm_skip_fwd(y2.reshape(rows, cq), _w2d(w3), sc3, sh3,
                          x2d, data.dtype)
@@ -696,19 +705,11 @@ def fused_bottleneck_unit(attrs, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
         return (out, upd(mm1, mu0), upd(mv1, var0),
                 upd(mm2, mu1), upd(mv2, var1),
                 upd(mm3, mu2), upd(mv3, var2))
-    # eval: moving statistics, forward only
-    sc1, sh1, _, _, _ = _bn_vectors(mm1.astype(jnp.float32),
-                                    mv1.astype(jnp.float32), g1, b1, eps)
-    x2d = data.reshape(rows, c)
-    y1_2d, _, _ = _mm_fwd(x2d, _w2d(w1), sc1, sh1, False, data.dtype)
-    cq = w1.shape[0]
-    sc2, sh2, _, _, _ = _bn_vectors(mm2.astype(jnp.float32),
-                                    mv2.astype(jnp.float32), g2, b2, eps)
-    c3_fwd = _c3_fwd if _c3_use_pallas_fwd(h, w_, cq) else _c3_fwd_xla
-    y2, _, _ = c3_fwd(y1_2d.reshape(n, h, w_, cq), _w4(w2), sc2, sh2,
-                      data.dtype)
-    sc3, sh3, _, _, _ = _bn_vectors(mm3.astype(jnp.float32),
-                                    mv3.astype(jnp.float32), g3, b3, eps)
-    out2d = _mm_skip_fwd(y2.reshape(rows, cq), _w2d(w3), sc3, sh3, x2d,
-                         data.dtype)
-    return (out2d.reshape(data.shape), mm1, mv1, mm2, mv2, mm3, mv3)
+    # eval: moving statistics through the SAME chain, forward only
+    f32 = jnp.float32
+    out, _, _, _, _ = _fused_unit_fwd_impl(
+        eps, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
+        mm1.astype(f32), mv1.astype(f32),
+        fixed_stats=(mm2.astype(f32), mv2.astype(f32),
+                     mm3.astype(f32), mv3.astype(f32)))
+    return (out, mm1, mv1, mm2, mv2, mm3, mv3)
