@@ -72,7 +72,7 @@ def _make_raw_rec(path, n, stored, seed=0):
     return path + ".rec"
 
 
-def main():
+def _device_main():
     import jax
     import jax.numpy as jnp
     import mxnet_tpu  # noqa: F401
@@ -154,7 +154,7 @@ def main():
     except Exception:
         step_flops = 0.0
 
-    # ---- measurement 1: compute-only ----
+    # ---- compute-only measurement (protocol: PROFILE_r04) ----
     # Corrected r4 protocol (PROFILE_r04.md finding 0): the r1-r3 K2-K1
     # marginal was deflated ~25% by the post-compile transient (first ~10
     # calls run 2-2.5x slow) landing in the K1 leg.  Now: warm up past the
@@ -169,6 +169,80 @@ def main():
     # and PROFILE_r04.md carries the conversion.
     loss, params, auxs = compiled(data_u8, labels, params, auxs, key)
     _ = float(np.asarray(loss))
+
+    # ---- overlapped end-to-end (before the long compute blocks) ----
+    # Host pipeline CAPABILITY keys are measured by the orchestrator in a
+    # clean process AFTER this one exits (see main()): a live tunnel
+    # session steals ~half of this 1-core host even while idle.  The
+    # overlapped number below must drive the device, so it runs here and
+    # carries that tunnel tax by necessity — it is the on-harness lower
+    # bound.  It runs before the compute blocks (whose own 20-step warmup
+    # makes them order-insensitive) while the process is at its quietest.
+    pipe_raw = pipe_raw_threads = pipe_jpeg = pipe_jpeg_f32 = None
+    e2e_jpeg = None
+
+    # end-to-end: JPEG decode OVERLAPPED with device train steps
+    # (VERDICT r4 weak #3).  Each iteration pulls the next decoded batch
+    # while the device runs a step; decoded pixels are NOT shipped
+    # device-ward on this harness (the ~5 MB/s dev tunnel would be the
+    # entire measurement; a co-located host streams via DMA).  Threaded
+    # pool: cv2 releases the GIL, and the multiprocess pool's slot
+    # coordination starves under the tunnel client (measured 66 img/s).
+    tmpdir = tempfile.mkdtemp(prefix="benchrec")
+    try:
+        n_rec = 2 * batch
+        rec = _make_raw_rec(os.path.join(tmpdir, "train"), n_rec, stored)
+        from mxnet_tpu import recordio as _rio
+        jrec = os.path.join(tmpdir, "train_jpg")
+        w = _rio.MXIndexedRecordIO(jrec + ".idx", jrec + ".rec", "w")
+        rd = _rio.MXIndexedRecordIO(None, rec, "r")
+        for k in rd.keys[:n_rec // 2]:
+            hdr, buf = _rio.unpack(rd.read_idx(k))
+            img = np.frombuffer(buf, np.uint8).reshape(stored, stored, 3)
+            w.write_idx(k, _rio.pack_img(hdr, img, quality=90))
+        w.close()
+        rd.close()
+        it_e2e = ImageRecordIterImpl(
+            path_imgrec=jrec + ".rec", data_shape=(3, image, image),
+            batch_size=batch, rand_crop=True, rand_mirror=True,
+            shuffle=True, layout="NHWC",
+            preprocess_threads=max(4, (os.cpu_count() or 1)),
+            prefetch_buffer=2, use_processes=False, dtype="uint8")
+        it_e2e.next()  # warm the pool
+
+        def _next_batch():
+            try:
+                return it_e2e.next()
+            except StopIteration:
+                it_e2e.reset()
+                return it_e2e.next()
+        n_e2e = 12 if not on_cpu else 2
+        for i in range(2):  # overlap warmup
+            _next_batch()
+            loss, params, auxs = compiled(
+                data_u8, labels, params, auxs,
+                jax.random.fold_in(key, 20_000 + i))
+        _ = float(np.asarray(loss))
+        t0 = time.perf_counter()
+        for i in range(n_e2e):
+            _next_batch()
+            loss, params, auxs = compiled(
+                data_u8, labels, params, auxs,
+                jax.random.fold_in(key, 30_000 + i))
+        _ = float(np.asarray(loss))  # sync
+        e2e_jpeg = n_e2e * batch / (time.perf_counter() - t0)
+        it_e2e.close()
+    except Exception as e:
+        # keep the compute result even if the pipeline bench breaks, but
+        # say so — a silently missing field would read as "not run"
+        import traceback
+        print("pipeline bench failed: %r" % e, file=sys.stderr)
+        traceback.print_exc()
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+
     k2 = 6 if on_cpu else 100
     warm = 1 if on_cpu else 20
     reps = 1 if on_cpu else 3
@@ -186,77 +260,8 @@ def main():
         averages.append((time.perf_counter() - t0) / k2)
     dt = min(averages)
 
-    # ---- measurement 2: input-pipeline streaming rate ----
-    def _pipeline_rate(rec, n_batches, use_processes=True, **kw):
-        it = ImageRecordIterImpl(
-            path_imgrec=rec, data_shape=(3, image, image), batch_size=batch,
-            rand_crop=True, rand_mirror=True, shuffle=True,
-            layout="NHWC",
-            preprocess_threads=max(2, (os.cpu_count() or 1)),
-            prefetch_buffer=2, use_processes=use_processes, **kw)
-        it.next()  # warm: page cache + pool spin-up
-        # single-core hosts make one-shot rates noisy (transient stalls only
-        # subtract); the max over reps estimates steady capability
-        best = 0.0
-        for _rep in range(2):
-            t0 = time.perf_counter()
-            done = 0
-            while done < n_batches:
-                try:
-                    it.next()
-                except StopIteration:
-                    it.reset()
-                    continue
-                done += 1
-            best = max(best, n_batches * batch / (time.perf_counter() - t0))
-        it.close()
-        return best
-
-    pipe_raw = pipe_raw_threads = pipe_jpeg = pipe_jpeg_f32 = None
-    tmpdir = tempfile.mkdtemp(prefix="benchrec")
-    try:
-        n_rec = 4 * batch
-        rec = _make_raw_rec(os.path.join(tmpdir, "train"), n_rec, stored)
-        pipe_raw = _pipeline_rate(rec, 8 if not on_cpu else 2,
-                                  raw_shape=(stored, stored, 3),
-                                  dtype="uint8")
-        # r1-r3 measured the threaded pool under this key; keep that
-        # measurement available so the pool switch is not read as a speedup
-        pipe_raw_threads = _pipeline_rate(rec, 8 if not on_cpu else 2,
-                                          use_processes=False,
-                                          raw_shape=(stored, stored, 3),
-                                          dtype="uint8")
-        # JPEG variant: same records re-encoded (decode cost included)
-        from mxnet_tpu import recordio as _rio
-        jrec = os.path.join(tmpdir, "train_jpg")
-        w = _rio.MXIndexedRecordIO(jrec + ".idx", jrec + ".rec", "w")
-        rd = _rio.MXIndexedRecordIO(None, rec, "r")
-        for k in rd.keys[:n_rec // 2]:
-            hdr, buf = _rio.unpack(rd.read_idx(k))
-            img = np.frombuffer(buf, np.uint8).reshape(stored, stored, 3)
-            w.write_idx(k, _rio.pack_img(hdr, img, quality=90))
-        w.close()
-        rd.close()
-        # uint8 end-to-end: the shape the fused step actually ingests (it
-        # casts+scales in-graph), so host float conversion is pure waste —
-        # measured 2.2x faster (PROFILE_r04.md pipeline section)
-        pipe_jpeg = _pipeline_rate(jrec + ".rec", 4 if not on_cpu else 1,
-                                   dtype="uint8")
-        # threads, not processes: measured the exact r3 way
-        pipe_jpeg_f32 = _pipeline_rate(jrec + ".rec", 4 if not on_cpu else 1,
-                                       use_processes=False,
-                                       dtype="float32", scale=1.0 / 255)
-    except Exception as e:
-        # keep the compute result even if the pipeline bench breaks, but
-        # say so — a silently missing field would read as "not run"
-        import traceback
-        print("pipeline bench failed: %r" % e, file=sys.stderr)
-        traceback.print_exc()
-    finally:
-        shutil.rmtree(tmpdir, ignore_errors=True)
-
-    # ---- measurement 3: kvstore/allreduce bandwidth (SURVEY acceptance
-    # number, tools/bandwidth/README.md 11.1 GB/s/GPU baseline) ----
+    # ---- kvstore/allreduce bandwidth (SURVEY acceptance number,
+    # tools/bandwidth/README.md 11.1 GB/s/GPU baseline) ----
     bw_kv = bw_psum8 = bw_err = None
     try:
         import re
@@ -318,6 +323,10 @@ def main():
     if pipe_jpeg:
         result["pipeline_jpeg_images_per_sec"] = round(pipe_jpeg, 2)
         result["input_bound_jpeg"] = bool(pipe_jpeg < imgs_per_sec)
+    if e2e_jpeg:
+        # decode pool overlapped with device training steps (transfer
+        # excluded: tunnel harness artifact, see comment at measurement)
+        result["train_jpeg_images_per_sec"] = round(e2e_jpeg, 2)
     if pipe_jpeg_f32:
         # r3's measurement for continuity (host-side float conversion)
         result["pipeline_jpeg_f32_images_per_sec"] = round(pipe_jpeg_f32, 2)
@@ -338,5 +347,69 @@ def main():
     print(json.dumps(result))
 
 
+def main():
+    """Two-phase orchestration.  A live TPU tunnel session steals ~half
+    of this 1-core host even while idle (measured: threaded-JPEG decode
+    745 img/s in a clean process vs ~360 with a tunnel-resident process
+    anywhere on the box), so the device phase runs in a SUBPROCESS that
+    fully exits before the host-pipeline capability probe runs.  On a
+    co-located TPU host (no tunnel client) the two phases coexist; the
+    overlapped `train_jpeg_images_per_sec` from the device phase is the
+    honest on-harness lower bound for that coexistence."""
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    dev = subprocess.run([sys.executable, os.path.abspath(__file__),
+                          "--device-phase"],
+                         capture_output=True, text=True, timeout=1800)
+    result = None
+    for line in reversed(dev.stdout.strip().splitlines() or []):
+        try:
+            result = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if result is None:
+        sys.stderr.write(dev.stdout[-2000:] + dev.stderr[-4000:])
+        raise SystemExit("device phase produced no result JSON")
+    try:
+        on_cpu = result.get("device", "") not in ("", None) and \
+            "TPU" not in str(result.get("device", ""))
+        probe_out = subprocess.run(
+            [sys.executable, os.path.join(here, "perf", "pipeline_probe.py"),
+             "--batch", str(result.get("batch", 256)),
+             "--image", "224" if not on_cpu else "64",
+             "--batches", "4" if not on_cpu else "1"],
+            capture_output=True, text=True, timeout=900).stdout
+        probe = json.loads(probe_out.strip().splitlines()[-1])
+        pipe_raw = max(probe.get("raw_u8_procs2", 0),
+                       probe.get("raw_u8_threads2", 0)) or None
+        pipe_jpeg = max(probe.get("jpeg_u8_procs1", 0),
+                        probe.get("jpeg_u8_procs2", 0),
+                        probe.get("jpeg_u8_procs4", 0),
+                        probe.get("jpeg_u8_threads2", 0)) or None
+        chip = result["value"]
+        if pipe_raw:
+            result["pipeline_images_per_sec"] = round(pipe_raw, 2)
+            result["pipeline_images_per_sec_threads"] = round(
+                probe.get("raw_u8_threads2", 0), 2)
+            piped = min(chip, pipe_raw)
+            result["piped_images_per_sec"] = round(piped, 2)
+            result["piped_mfu"] = round(
+                result.get("mfu", 0) * piped / chip, 4)
+            result["input_bound_raw_records"] = bool(pipe_raw < chip)
+        if pipe_jpeg:
+            result["pipeline_jpeg_images_per_sec"] = round(pipe_jpeg, 2)
+            result["input_bound_jpeg"] = bool(pipe_jpeg < chip)
+        if probe.get("jpeg_f32_threads2"):
+            result["pipeline_jpeg_f32_images_per_sec"] = round(
+                probe["jpeg_f32_threads2"], 2)
+    except Exception as e:
+        sys.stderr.write("pipeline probe failed: %r\n" % (e,))
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    if "--device-phase" in sys.argv:
+        _device_main()
+    else:
+        main()

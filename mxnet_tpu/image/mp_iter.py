@@ -48,6 +48,12 @@ def _worker_init(cfg):
     _W["slots"] = {}
 
 
+def _worker_ping(_i):
+    """No-op task used to force-boot all workers inside the parent's
+    JAX_PLATFORMS=cpu spawn window (see ProcessPool.__init__)."""
+    return True
+
+
 def _worker_reader():
     rd = _W.get("reader")
     if rd is None:
@@ -143,6 +149,21 @@ class ProcessPool(object):
         self._exe = ProcessPoolExecutor(
             max_workers=workers, mp_context=get_context(start_method),
             initializer=_worker_init, initargs=(cfg,))
+        # Boot every worker NOW, with JAX_PLATFORMS pinned to cpu in the
+        # inherited env: decode workers must never attach to the parent's
+        # accelerator (observed with the axon TPU tunnel: spawned workers
+        # re-importing jax against the tunnel die, and the pool's
+        # respawn churn starves the host).  The env tweak is scoped to
+        # the spawn window and restored immediately.
+        prev = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            list(self._exe.map(_worker_ping, range(workers)))
+        finally:
+            if prev is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = prev
         self._retired = None  # slot under the caller's feet (DataIter contract)
         self._it = it
 

@@ -565,6 +565,10 @@ class PipelineModule(object):
             else None
 
     def get_params(self):
+        """Homogeneous module: {name: (n_stages, ...) stacked array}.
+        Heterogeneous module: ([per-stage param dicts],
+        [per-stage aux dicts]) — per-stage pytrees are the natural
+        checkpoint unit when stages differ."""
         if self._hetero:
             return (self._unpack(self._packed),
                     self._hstep.unpack_aux(self._packed_aux))
