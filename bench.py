@@ -178,7 +178,6 @@ def _device_main():
     # carries that tunnel tax by necessity — it is the on-harness lower
     # bound.  It runs before the compute blocks (whose own 20-step warmup
     # makes them order-insensitive) while the process is at its quietest.
-    pipe_raw = pipe_raw_threads = pipe_jpeg = pipe_jpeg_f32 = None
     e2e_jpeg = None
 
     # end-to-end: JPEG decode OVERLAPPED with device train steps
@@ -217,7 +216,14 @@ def _device_main():
                 it_e2e.reset()
                 return it_e2e.next()
         n_e2e = 12 if not on_cpu else 2
-        for i in range(2):  # overlap warmup
+        # warm PAST the post-compile transient (the first ~10 calls run
+        # 2-2.5x slow; the r4 protocol finding applies here too), then
+        # two overlapped warm iterations for the decode pool
+        for i in range(18 if not on_cpu else 1):
+            loss, params, auxs = compiled(
+                data_u8, labels, params, auxs,
+                jax.random.fold_in(key, 19_000 + i))
+        for i in range(2):
             _next_batch()
             loss, params, auxs = compiled(
                 data_u8, labels, params, auxs,
@@ -306,30 +312,14 @@ def _device_main():
         "xla_gflops_per_step": round(step_flops / 1e9, 1),
         "peak_tflops": round(peak / 1e12, 1) if peak else None,
         "device": getattr(dev, "device_kind", dev.platform),
+        "platform": dev.platform,
         "host_cores": os.cpu_count(),
         "protocol": "r4_block_min",
     }
-    if pipe_raw:
-        result["pipeline_images_per_sec"] = round(pipe_raw, 2)
-    if pipe_raw_threads:
-        result["pipeline_images_per_sec_threads"] = round(pipe_raw_threads, 2)
-        piped = min(imgs_per_sec, pipe_raw)
-        result["piped_images_per_sec"] = round(piped, 2)
-        result["piped_mfu"] = round(mfu * piped / imgs_per_sec, 4)
-        # which side binds, per feed format: raw pre-decoded records vs
-        # JPEG decode (VERDICT r4 weak #3: one bare `input_bound` was read
-        # as covering both)
-        result["input_bound_raw_records"] = bool(pipe_raw < imgs_per_sec)
-    if pipe_jpeg:
-        result["pipeline_jpeg_images_per_sec"] = round(pipe_jpeg, 2)
-        result["input_bound_jpeg"] = bool(pipe_jpeg < imgs_per_sec)
     if e2e_jpeg:
         # decode pool overlapped with device training steps (transfer
         # excluded: tunnel harness artifact, see comment at measurement)
         result["train_jpeg_images_per_sec"] = round(e2e_jpeg, 2)
-    if pipe_jpeg_f32:
-        # r3's measurement for continuity (host-side float conversion)
-        result["pipeline_jpeg_f32_images_per_sec"] = round(pipe_jpeg_f32, 2)
     if bw_kv is not None:
         # per-key push/pull (the reference's kvstore-bandwidth acceptance
         # metric, tools/bandwidth/README.md).  tools/bandwidth.py measures
@@ -372,8 +362,7 @@ def main():
         sys.stderr.write(dev.stdout[-2000:] + dev.stderr[-4000:])
         raise SystemExit("device phase produced no result JSON")
     try:
-        on_cpu = result.get("device", "") not in ("", None) and \
-            "TPU" not in str(result.get("device", ""))
+        on_cpu = result.get("platform") == "cpu"
         probe_out = subprocess.run(
             [sys.executable, os.path.join(here, "perf", "pipeline_probe.py"),
              "--batch", str(result.get("batch", 256)),

@@ -1,0 +1,7 @@
+"""Contrib namespace: integrations that sit outside the core API.
+
+Reference: python/mxnet/contrib/ — here only the pieces with a
+TPU-relevant story live; contrib OPERATORS are registered in the main
+op registry (ops/contrib_*.py) and reachable as mx.sym._contrib_*.
+"""
+from . import tensorboard  # noqa: F401

@@ -1,0 +1,66 @@
+"""TensorBoard logging callback.
+
+Reference surface: python/mxnet/contrib/tensorboard.py
+LogMetricsCallback — a batch/epoch callback pushing every metric value
+to a TensorBoard event file.  The writer backend here is
+torch.utils.tensorboard (present in this environment); when no
+tensorboard backend is importable the callback degrades to a plain TSV
+event log in the same directory rather than failing training.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+__all__ = ["LogMetricsCallback"]
+
+
+class _TsvWriter:
+    """Fallback writer: scalars.tsv with (wall_time, tag, step, value)."""
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        self._f = open(os.path.join(logdir, "scalars.tsv"), "a")
+
+    def add_scalar(self, tag, value, global_step=None):
+        self._f.write("%f\t%s\t%s\t%f\n"
+                      % (time.time(), tag, global_step, float(value)))
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def _make_writer(logdir):
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(logdir)
+    except Exception:
+        return _TsvWriter(logdir)
+
+
+class LogMetricsCallback(object):
+    """Batch- or epoch-end callback streaming metric values to
+    TensorBoard.
+
+    Usage (same shape as the reference's):
+        tb = LogMetricsCallback('logs/train')
+        mod.fit(..., batch_end_callback=tb)
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self._prefix = prefix
+        self._step = 0
+        self._writer = _make_writer(logging_dir)
+
+    def __call__(self, param):
+        self._step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self._prefix:
+                name = "%s-%s" % (self._prefix, name)
+            self._writer.add_scalar(name, value, self._step)
+
+    def close(self):
+        self._writer.close()

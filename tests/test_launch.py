@@ -64,3 +64,82 @@ def test_mpi_hostfile_and_quoting(tmp_path):
     assert "--hostfile %s" % hf in cmd
     # args with spaces survive the bash -c shim (shlex quoting)
     assert "'run 1'" in cmd
+
+
+def test_parse_log_tool(tmp_path):
+    """tools/parse_log.py: fit()-style log -> per-epoch table (reference
+    tools/parse_log.py surface, + tsv/json)."""
+    import json
+    import subprocess
+    import sys
+    log = tmp_path / "train.log"
+    log.write_text("\n".join([
+        "INFO Epoch[0] Train-accuracy=0.5",
+        "INFO Epoch[0] Validation-accuracy=0.4",
+        "INFO Epoch[0] Time cost=10.0",
+        "INFO Epoch[1] Train-accuracy=0.8",
+        "INFO Epoch[1] Validation-accuracy=0.7",
+        "INFO Epoch[1] Time cost=9.0",
+        "noise line",
+    ]))
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "parse_log.py")
+    out = subprocess.run([sys.executable, tool, str(log), "--format",
+                          "json"], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    data = json.loads(out.stdout)
+    assert data["epochs"]["1"]["train-accuracy"] == 0.8
+    assert data["epochs"]["0"]["time"] == 10.0
+    md = subprocess.run([sys.executable, tool, str(log)],
+                        capture_output=True, text=True).stdout
+    assert "| epoch |" in md and "0.7" in md
+
+
+def test_kill_jobs_tool(tmp_path):
+    """tools/kill_jobs.py: kills processes matched by command-line
+    substring (reference tools/kill-mxnet.py surface), local mode."""
+    import subprocess
+    import sys
+    import time
+    marker = "mxtpu_kill_test_%d" % os.getpid()
+    victim = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys, time; time.sleep(300)  # " + marker, marker])
+    try:
+        time.sleep(0.5)
+        tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                            "kill_jobs.py")
+        out = subprocess.run([sys.executable, tool, marker],
+                             capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        try:
+            rc = victim.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            raise AssertionError("victim survived; tool said: %r / %r"
+                                 % (out.stdout, out.stderr))
+        assert rc != 0                      # SIGKILLed
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+
+
+def test_tensorboard_callback(tmp_path):
+    """contrib.tensorboard.LogMetricsCallback streams metric values
+    (reference python/mxnet/contrib/tensorboard.py surface)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+
+    class Param:
+        pass
+    metric = mx.metric.create("acc")
+    import numpy as np
+    metric.update([mx.nd.array(np.array([0, 1]))],
+                  [mx.nd.array(np.array([[0.9, 0.1], [0.2, 0.8]]))])
+    cb = LogMetricsCallback(str(tmp_path / "tb"))
+    p = Param()
+    p.eval_metric = metric
+    cb(p)
+    cb(p)
+    cb.close()
+    files = list((tmp_path / "tb").iterdir())
+    assert files, "no event files written"

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Tear down a distributed training job's worker processes.
+
+Reference surface: tools/kill-mxnet.py (ssh every host in a hostfile and
+kill the training program by name).  This version covers the launchers
+tools/launch.py supports: `local` kills on this machine, `ssh` walks the
+hostfile.  Matching is by command-line substring, with this process and
+its ancestors excluded so the tool never kills itself.
+
+Usage:
+    python tools/kill_jobs.py train.py                # local
+    python tools/kill_jobs.py train.py --hostfile hf  # ssh each host
+    python tools/kill_jobs.py train.py --signal TERM --dry-run
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def _ancestors():
+    """PIDs of this process and every ancestor (never kill the chain
+    that invoked the teardown)."""
+    pids = set()
+    pid = os.getpid()
+    while pid > 1 and pid not in pids:
+        pids.add(pid)
+        try:
+            with open("/proc/%d/stat" % pid) as f:
+                pid = int(f.read().split(")")[-1].split()[1])
+        except (OSError, ValueError, IndexError):
+            break
+    return pids
+
+
+def _local_pids(pattern):
+    # -ww + a huge COLUMNS: ps truncates args to the COLUMNS env var
+    # (pytest, CI runners and some shells set it to 80), which would
+    # silently hide matches past that width
+    env = dict(os.environ, COLUMNS="1000000")
+    out = subprocess.run(["ps", "-e", "-ww", "-o", "pid,args"],
+                         capture_output=True, text=True, env=env).stdout
+    skip = _ancestors()
+    pids = []
+    for line in out.splitlines()[1:]:
+        line = line.strip()
+        if not line:
+            continue
+        pid_s, _, args = line.partition(" ")
+        try:
+            pid = int(pid_s)
+        except ValueError:
+            continue
+        if pid in skip or "kill_jobs.py" in args:
+            continue
+        if pattern in args:
+            pids.append(pid)
+    return pids
+
+
+def kill_local(pattern, sig, dry_run):
+    pids = _local_pids(pattern)
+    for pid in pids:
+        if dry_run:
+            print("would kill %d (signal %s)" % (pid, sig))
+            continue
+        try:
+            os.kill(pid, sig)
+            print("killed %d" % pid)
+        except ProcessLookupError:
+            pass
+    return len(pids)
+
+
+def kill_ssh(hosts, pattern, signame, dry_run):
+    import shlex
+    # fixed-string substring matching, same semantics as the local path
+    # (pkill -f would be an ERE and needs no-self-match gymnastics)
+    cmd = ("ps -e -ww -o pid,args | grep -F -- %s | grep -v grep | "
+           "awk '{print $1}' | xargs -r kill -%s"
+           % (shlex.quote(pattern), signame))
+    total = 0
+    for host in hosts:
+        if dry_run:
+            print("would run on %s: %s" % (host, cmd))
+            continue
+        r = subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no",
+                            host, cmd], capture_output=True, text=True)
+        if r.returncode not in (0, 1, 123):  # 1/123: nothing matched
+            print("%s: %s" % (host, r.stderr.strip()), file=sys.stderr)
+        else:
+            total += 1
+            print("%s: done" % host)
+    return total
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("pattern", help="command-line substring to match")
+    ap.add_argument("--hostfile", default=None,
+                    help="file with one host per line -> ssh teardown")
+    ap.add_argument("--signal", default="KILL",
+                    help="signal name (default KILL)")
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args(argv)
+    signame = args.signal.upper().replace("SIG", "")
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [h.strip() for h in f if h.strip()
+                     and not h.startswith("#")]
+        kill_ssh(hosts, args.pattern, signame, args.dry_run)
+        return 0
+    sig = getattr(signal, "SIG" + signame)
+    n = kill_local(args.pattern, sig, args.dry_run)
+    print("%d process(es) matched" % n)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Summarize a training log into a per-epoch table.
+
+Reference surface: tools/parse_log.py (markdown table of train/valid
+accuracy + epoch time from the fit() logging format).  This version also
+emits TSV and JSON, and keeps whatever metric names the log carries
+instead of hard-coding accuracy.
+
+The lines it understands are the ones mxnet_tpu.callback/Speedometer and
+mxnet_tpu.model.score emit, e.g.:
+    Epoch[3] Train-accuracy=0.92
+    Epoch[3] Validation-accuracy=0.88
+    Epoch[3] Time cost=12.3
+
+Usage: python tools/parse_log.py train.log [--format markdown|tsv|json]
+"""
+import argparse
+import collections
+import json
+import re
+import sys
+
+_LINE = re.compile(
+    r"Epoch\[(?P<epoch>\d+)\]\s+"
+    r"(?:(?P<split>Train|Validation|Valid)-(?P<metric>[\w.-]+)"
+    r"|(?P<time>Time)\s+cost)"
+    r"=(?P<value>[-+.eE\d]+)")
+
+
+def parse(lines):
+    """-> {epoch: {column_name: mean value}} preserving column order."""
+    sums = collections.defaultdict(lambda: collections.defaultdict(float))
+    counts = collections.defaultdict(lambda: collections.defaultdict(int))
+    columns = []
+    for line in lines:
+        m = _LINE.search(line)
+        if not m:
+            continue
+        epoch = int(m.group("epoch"))
+        if m.group("time"):
+            col = "time"
+        else:
+            split = {"Valid": "valid", "Validation": "valid",
+                     "Train": "train"}[m.group("split")]
+            col = "%s-%s" % (split, m.group("metric"))
+        if col not in columns:
+            columns.append(col)
+        sums[epoch][col] += float(m.group("value"))
+        counts[epoch][col] += 1
+    table = {}
+    for epoch in sorted(sums):
+        table[epoch] = {c: sums[epoch][c] / counts[epoch][c]
+                        for c in columns if counts[epoch][c]}
+    return table, columns
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("logfile")
+    ap.add_argument("--format", default="markdown",
+                    choices=["markdown", "tsv", "json"])
+    args = ap.parse_args(argv)
+    with open(args.logfile) as f:
+        table, columns = parse(f)
+    if not table:
+        print("no Epoch[...] lines recognized", file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(json.dumps({"columns": columns, "epochs": table}))
+        return 0
+    sep = " | " if args.format == "markdown" else "\t"
+    header = ["epoch"] + columns
+    if args.format == "markdown":
+        print("| " + sep.join(header) + " |")
+        print("| " + sep.join("---" for _ in header) + " |")
+    else:
+        print(sep.join(header))
+    for epoch, row in table.items():
+        # raw Epoch[N] index, matching the JSON keys
+        cells = ["%d" % epoch] + [
+            ("%.6g" % row[c]) if c in row else "-" for c in columns]
+        if args.format == "markdown":
+            print("| " + sep.join(cells) + " |")
+        else:
+            print(sep.join(cells))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
