@@ -7,12 +7,15 @@ Reference: example/image-classification/symbols/resnet.py (He et al.
 from .. import symbol as sym
 
 
-def _fused_unit(data, num_filter, name, bn_mom):
+def _fused_unit(data, num_filter, name, bn_mom, height=0, width=0):
     """The stride-1 dim-match bottleneck unit as ONE fused op backed by
     the Pallas kernel tier (ops/fused_unit.py): BN+ReLU prologues and
     batch-stats/BN-reduction epilogues live inside the conv kernels, so
-    normalized activations never cross HBM.  Parameter and aux names
-    match the unfused subgraph exactly — checkpoints interchange."""
+    normalized activations never cross HBM.  With height/width set the
+    op takes/returns 2D (rows, C) activations so consecutive fused units
+    chain with no 4D<->2D relayout at their boundaries.  Parameter and
+    aux names match the unfused subgraph exactly — checkpoints
+    interchange."""
     v = sym.Variable
     return sym._contrib_FusedBottleneckUnit(
         data,
@@ -29,18 +32,15 @@ def _fused_unit(data, num_filter, name, bn_mom):
         moving_mean3=v(name + "_bn3_moving_mean"),
         moving_var3=v(name + "_bn3_moving_var"),
         num_filter=num_filter, eps=2e-5, momentum=bn_mom,
+        height=height, width=width,
         layout="NHWC", name=name + "_fused")
 
 
 def _residual_unit(data, num_filter, stride, dim_match, name,
                    bottle_neck=True, bn_mom=0.9, layout="NCHW",
                    bn_axis=1, unit_impl="plain"):
-    """Pre-activation residual unit (symbols/resnet.py residual_unit)."""
-    if (unit_impl == "fused" and bottle_neck and dim_match
-            and layout == "NHWC" and stride == (1, 1)):
-        from .. import config
-        if num_filter >= config.get("MXNET_FUSED_UNIT_MIN_FILTER"):
-            return _fused_unit(data, num_filter, name, bn_mom)
+    """Pre-activation residual unit (symbols/resnet.py residual_unit).
+    (Fused-unit dispatch lives in ONE place: _resnet's stage loop.)"""
     if bottle_neck:
         bn1 = sym.BatchNorm(data, fix_gamma=False, eps=2e-5, momentum=bn_mom,
                             name=name + "_bn1", axis=bn_axis)
@@ -168,20 +168,55 @@ def _resnet(units, num_stages, filter_list, num_classes, image_shape,
         body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
                            pool_type="max", layout=layout)
 
+    # exact running spatial dims (non-square capable; conv7/s2/p3 and
+    # pool3/s2/p1 both map x -> (x-1)//2 + 1, transition 3x3/s2/p1 the
+    # same) — the fused 2D chain needs the true shape, not height//4
+    width = image_shape[2]
+    if height > 32:
+        cur_h = ((height - 1) // 2 + 1 - 1) // 2 + 1
+        cur_w = ((width - 1) // 2 + 1 - 1) // 2 + 1
+    else:
+        cur_h, cur_w = height, width
+    from .. import config as _cfg
+    min_filter = _cfg.get("MXNET_FUSED_UNIT_MIN_FILTER")
     for i in range(num_stages):
         stride = (1, 1) if i == 0 and height > 32 else (2, 2) \
             if i > 0 else (1, 1)
+        if stride == (2, 2):
+            cur_h = (cur_h - 1) // 2 + 1
+            cur_w = (cur_w - 1) // 2 + 1
         body = _residual_unit(body, filter_list[i + 1], stride, False,
                               name="stage%d_unit%d" % (i + 1, 1),
                               bottle_neck=bottle_neck, bn_mom=bn_mom,
                               layout=layout, bn_axis=bn_axis,
                               unit_impl=unit_impl)
-        for j in range(units[i] - 1):
-            body = _residual_unit(body, filter_list[i + 1], (1, 1), True,
-                                  name="stage%d_unit%d" % (i + 1, j + 2),
-                                  bottle_neck=bottle_neck, bn_mom=bn_mom,
-                                  layout=layout, bn_axis=bn_axis,
-                                  unit_impl=unit_impl)
+        rest = units[i] - 1
+        fuse_run = (rest > 0 and unit_impl == "fused" and bottle_neck
+                    and layout == "NHWC"
+                    and filter_list[i + 1] >= min_filter)
+        if fuse_run:
+            # chain the whole dim-match run in the 2D row layout: ONE
+            # pair of reshapes per stage instead of relayout copies at
+            # every unit boundary (PROFILE_r05 blocker #2)
+            body = sym.Reshape(body, shape=(-1, filter_list[i + 1]),
+                               name="stage%d_rows" % (i + 1))
+            for j in range(rest):
+                body = _fused_unit(body, filter_list[i + 1],
+                                   "stage%d_unit%d" % (i + 1, j + 2),
+                                   bn_mom, height=cur_h, width=cur_w)
+            body = sym.Reshape(body,
+                               shape=(-1, cur_h, cur_w,
+                                      filter_list[i + 1]),
+                               name="stage%d_grid" % (i + 1))
+        else:
+            for j in range(rest):
+                body = _residual_unit(body, filter_list[i + 1], (1, 1),
+                                      True,
+                                      name="stage%d_unit%d" % (i + 1, j + 2),
+                                      bottle_neck=bottle_neck,
+                                      bn_mom=bn_mom, layout=layout,
+                                      bn_axis=bn_axis,
+                                      unit_impl=unit_impl)
     bn1 = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
                         name="bn1", axis=bn_axis)
     relu1 = sym.Activation(bn1, act_type="relu", name="relu1")

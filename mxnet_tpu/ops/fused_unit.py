@@ -144,6 +144,114 @@ def _k_conv3_fwd(x_ref, w_ref, sc_ref, sh_ref, y_ref, s_ref, ss_ref):
         ss_ref[...] += pss
 
 
+# --- 3x3 over the 2D row layout ------------------------------------------
+#
+# PROFILE_r05 isolated two blockers in the 4D 3x3 kernels: Mosaic's
+# strided spatial slicing of (BN,H,W,C) tiles runs far below line rate,
+# and every 4D<->2D crossing between Pallas and XLA pays a relayout
+# copy.  These kernels keep the SAME flattened (rows, C) layout the 1x1
+# sandwich kernels use: with blocks aligned to whole images, a 3x3 tap
+# is a STATIC row shift of (dh*W + dw) (pltpu.roll) gated by a per-row
+# validity mask computed from iota (rows where h+dh / w+dw leave the
+# image — which also kills roll wrap-around and cross-image leakage).
+
+def _tap_mask(rows, h, w, dh, dw):
+    r = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
+    hh = (r // w) % h + dh
+    ww = r % w + dw
+    ok = (hh >= 0) & (hh < h) & (ww >= 0) & (ww < w)
+    return ok.astype(jnp.float32)
+
+
+def _k_conv3_fwd_2d(x_ref, w_ref, sc_ref, sh_ref, y_ref, s_ref, ss_ref,
+                    *, h, w):
+    from jax.experimental.pallas import tpu as pltpu
+    i = pl.program_id(0)
+    rows, ci = x_ref.shape
+    co = w_ref.shape[-1]
+    x = x_ref[...].astype(jnp.float32)
+    a32 = jnp.maximum(x * sc_ref[...] + sh_ref[...], 0)
+    a = a32.astype(x_ref.dtype)
+    acc = jnp.zeros((rows, co), jnp.float32)
+    for dh in (-1, 0, 1):
+        for dw in (-1, 0, 1):
+            off = dh * w + dw
+            # Mosaic rotate is 32-bit-only: roll the f32 copy, cast after
+            shifted = pltpu.roll(a32, (-off) % rows, 0).astype(a.dtype) \
+                if off else a
+            m = _tap_mask(rows, h, w, dh, dw)
+            acc += jnp.dot(shifted, w_ref[dh + 1, dw + 1],
+                           preferred_element_type=jnp.float32) * m
+    y_ref[...] = acc.astype(y_ref.dtype)
+    ps = jnp.sum(acc, axis=0, keepdims=True)
+    pss = jnp.sum(acc * acc, axis=0, keepdims=True)
+
+    @pl.when(i == 0)
+    def _():
+        s_ref[...] = ps
+        ss_ref[...] = pss
+
+    @pl.when(i > 0)
+    def _():
+        s_ref[...] += ps
+        ss_ref[...] += pss
+
+
+def _k_conv3_bwd_2d(dpn_ref, y2_ref, c1_ref, u0_ref, u1_ref,
+                    y1_ref, wt_ref, sc_ref, sh_ref, xs_ref, xh_ref,
+                    dp_ref, dw_ref, db_ref, dg_ref, *, h, w):
+    """2D-row-layout 3x3 backward: finalize g (deferred bn3 vectors),
+    per-tap wgrad (dW_t = (M_t . S_t(a))^T g) and dgrad
+    (da = sum_t S_{-t}(M_t . (g @ W_t^T))), ReLU mask + BN reductions."""
+    from jax.experimental.pallas import tpu as pltpu
+    i = pl.program_id(0)
+    rows, ci = y1_ref.shape
+    co = y2_ref.shape[-1]
+    g = c1_ref[...] * dpn_ref[...].astype(jnp.float32) + u0_ref[...] \
+        + u1_ref[...] * y2_ref[...].astype(jnp.float32)
+    g = g.astype(dpn_ref.dtype)
+    x = y1_ref[...].astype(jnp.float32)
+    a32 = jnp.maximum(x * sc_ref[...] + sh_ref[...], 0)
+    a = a32.astype(y1_ref.dtype)
+    da = jnp.zeros((rows, ci), jnp.float32)
+    for dh in (-1, 0, 1):
+        for dw_ in (-1, 0, 1):
+            off = dh * w + dw_
+            m = _tap_mask(rows, h, w, dh, dw_)
+            sa = pltpu.roll(a32, (-off) % rows, 0).astype(a.dtype) \
+                if off else a
+            sam = sa * m.astype(sa.dtype)
+            part = lax.dot_general(sam, g, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+            @pl.when(i == 0)
+            def _(part=part, dh=dh, dw_=dw_):
+                dw_ref[dh + 1, dw_ + 1] = part
+
+            @pl.when(i > 0)
+            def _(part=part, dh=dh, dw_=dw_):
+                dw_ref[dh + 1, dw_ + 1] += part
+            tmp = jnp.dot(g, wt_ref[dh + 1, dw_ + 1],
+                          preferred_element_type=jnp.float32) * m
+            da += pltpu.roll(tmp, off % rows, 0) if off else tmp
+    mask = (a32 > 0).astype(jnp.float32)
+    dp = da * mask
+    dp_ref[...] = dp.astype(dp_ref.dtype)
+    dbp = jnp.sum(dp, axis=0, keepdims=True)
+    xhat = x * xs_ref[...] + xh_ref[...]
+    dgp = jnp.sum(dp * xhat, axis=0, keepdims=True)
+
+    @pl.when(i == 0)
+    def _():
+        db_ref[...] = dbp
+        dg_ref[...] = dgp
+
+    @pl.when(i > 0)
+    def _():
+        db_ref[...] += dbp
+        dg_ref[...] += dgp
+
+
 # ---------------------------------------------------------------------------
 # Backward kernels
 # ---------------------------------------------------------------------------
@@ -335,6 +443,80 @@ def _c3_fwd(x4d, w4, sc, sh, out_dtype):
     return y, s[0], ss[0]
 
 
+def _img_row_block(n, h, w, ci, co, n_temps):
+    """Row tile = whole images; batch-per-tile chosen by the calibrated
+    f32-temp liveness model against the 16MB scoped-VMEM budget."""
+    per_img = n_temps * h * w * (ci + co) * 4
+    for bn in (16, 8, 4, 2, 1):
+        if n % bn == 0 and bn * per_img <= 11 * 1024 * 1024:
+            return bn
+    return 1
+
+
+def _c3_fwd2d(x2d, w4, sc, sh, n, h, w, out_dtype):
+    rows, ci = x2d.shape
+    co = w4.shape[-1]
+    bn_ = _img_row_block(n, h, w, ci, co, 5)
+    br = bn_ * h * w
+    kern = functools.partial(_k_conv3_fwd_2d, h=h, w=w)
+    outs = [jax.ShapeDtypeStruct((rows, co), out_dtype),
+            jax.ShapeDtypeStruct((1, co), jnp.float32),
+            jax.ShapeDtypeStruct((1, co), jnp.float32)]
+    y, s, ss = pl.pallas_call(
+        kern,
+        name="fu_c3_fwd2d",
+        grid=(n // bn_,),
+        in_specs=[pl.BlockSpec((br, ci), lambda i: (i, 0)),
+                  pl.BlockSpec((3, 3, ci, co), lambda i: (0, 0, 0, 0)),
+                  pl.BlockSpec((1, ci), lambda i: (0, 0)),
+                  pl.BlockSpec((1, ci), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((br, co), lambda i: (i, 0)),
+                   pl.BlockSpec((1, co), lambda i: (0, 0)),
+                   pl.BlockSpec((1, co), lambda i: (0, 0))],
+        out_shape=outs,
+        interpret=_interpret())(x2d, w4, _vec(sc), _vec(sh))
+    return y, s[0], ss[0]
+
+
+def _c3_bwd2d(dpn2d, y2_2d, fin, y1_2d, w4, sc, sh, xs, xh,
+              n, h, w, dp_dtype):
+    rows, ci = y1_2d.shape
+    co = y2_2d.shape[-1]
+    c1, u0, u1 = fin
+    wt4 = jnp.transpose(w4, (0, 1, 3, 2))       # (3,3,Co,Ci) for dgrad
+    bn_ = _img_row_block(n, h, w, ci, co, 8)
+    br = bn_ * h * w
+    kern = functools.partial(_k_conv3_bwd_2d, h=h, w=w)
+    outs = [jax.ShapeDtypeStruct((rows, ci), dp_dtype),
+            jax.ShapeDtypeStruct((3, 3, ci, co), jnp.float32),
+            jax.ShapeDtypeStruct((1, ci), jnp.float32),
+            jax.ShapeDtypeStruct((1, ci), jnp.float32)]
+    dp, dw, db, dg = pl.pallas_call(
+        kern,
+        name="fu_c3_bwd2d",
+        grid=(n // bn_,),
+        in_specs=[pl.BlockSpec((br, co), lambda i: (i, 0)),
+                  pl.BlockSpec((br, co), lambda i: (i, 0)),
+                  pl.BlockSpec((1, co), lambda i: (0, 0)),
+                  pl.BlockSpec((1, co), lambda i: (0, 0)),
+                  pl.BlockSpec((1, co), lambda i: (0, 0)),
+                  pl.BlockSpec((br, ci), lambda i: (i, 0)),
+                  pl.BlockSpec((3, 3, co, ci), lambda i: (0, 0, 0, 0)),
+                  pl.BlockSpec((1, ci), lambda i: (0, 0)),
+                  pl.BlockSpec((1, ci), lambda i: (0, 0)),
+                  pl.BlockSpec((1, ci), lambda i: (0, 0)),
+                  pl.BlockSpec((1, ci), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((br, ci), lambda i: (i, 0)),
+                   pl.BlockSpec((3, 3, ci, co), lambda i: (0, 0, 0, 0)),
+                   pl.BlockSpec((1, ci), lambda i: (0, 0)),
+                   pl.BlockSpec((1, ci), lambda i: (0, 0))],
+        out_shape=outs,
+        interpret=_interpret())(
+            dpn2d, y2_2d, _vec(c1), _vec(u0), _vec(u1),
+            y1_2d, wt4, _vec(sc), _vec(sh), _vec(xs), _vec(xh))
+    return dp, dw, db[0], dg[0]
+
+
 def _mm_bwd(g2d, yraw2d, fin, x2d, wt2d, sc, sh, xs, xh, dp_dtype):
     """Returns dp (R, Ci), dW (Ci, Co) f32, dbeta (Ci,), dgamma (Ci,).
     wt2d is the weight in its native (Co, Ci) layout."""
@@ -447,29 +629,42 @@ def _c3_bwd_fits(h, w, cq):
 def _c3_mode():
     from .. import config
     mode = config.get("MXNET_FUSED_UNIT_C3").lower()
-    if mode not in ("auto", "xla"):
-        raise MXNetError("MXNET_FUSED_UNIT_C3 must be 'auto' or 'xla', "
-                         "got %r" % mode)
+    if mode not in ("auto", "2d", "4d", "xla"):
+        raise MXNetError("MXNET_FUSED_UNIT_C3 must be one of "
+                         "auto/2d/4d/xla, got %r" % mode)
     return mode
 
 
 def _c3_fwd_fits(h, w, cq):
-    """Forward liveness model (same calibration as _c3_bwd_fits, fewer
-    live temporaries): must fit at batch-tile 1, else XLA segment."""
+    """4D forward liveness model (same calibration as _c3_bwd_fits,
+    fewer live temporaries): must fit at batch-tile 1."""
     model = 4 * h * w * 2 * cq * 4 + 2 * (h + 2) * (w + 2) * 2 * cq * 2
     return 1.5 * model + 9 * cq * cq * 4 <= 14 * 1024 * 1024
 
 
-def _c3_use_pallas_fwd(h, w, cq):
-    if _c3_mode() == "xla":
-        return False
-    return cq <= _C3_PALLAS_MAX_WIDTH and _c3_fwd_fits(h, w, cq)
+def _c3_2d_fits(h, w, cq, bwd):
+    """2D-row-layout liveness: n_temps f32 tile copies per image plus the
+    resident weights (and the f32 wgrad block in backward)."""
+    n_temps = 8 if bwd else 5
+    per_img = n_temps * h * w * 2 * cq * 4
+    fixed = 9 * cq * cq * ((2 + 4 + 2) if bwd else 2)
+    return per_img + fixed <= 11 * 1024 * 1024
 
 
-def _c3_use_pallas_bwd(h, w, cq):
-    if _c3_mode() == "xla":
-        return False
-    return _c3_bwd_fits(h, w, cq)
+def _c3_impl(h, w, cq, bwd):
+    """-> '2d' | '4d' | 'xla' for the middle conv, per direction."""
+    mode = _c3_mode()
+    if mode == "xla":
+        return "xla"
+    if mode == "4d":
+        if cq > _C3_PALLAS_MAX_WIDTH:
+            return "xla"
+        ok = _c3_bwd_fits(h, w, cq) if bwd else _c3_fwd_fits(h, w, cq)
+        return "4d" if ok else "xla"
+    # auto / 2d: prefer the row-layout kernels
+    if cq <= _C3_PALLAS_MAX_WIDTH and _c3_2d_fits(h, w, cq, bwd):
+        return "2d"
+    return "xla"
 
 
 def _c3_fwd_xla(x4d, w4, sc, sh, out_dtype):
@@ -542,26 +737,31 @@ def _w4(w):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _fused_unit_core(eps, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
+def _fused_unit_core(cfg, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
                      mu0, var0):
-    """Returns (out, mu1, var1, mu2, var2): the interior batch stats are
-    REAL outputs (consumed, stop-gradiented, by the moving-average
-    updates) so the forward runs exactly once — no reliance on XLA
-    CSE-ing duplicated pallas custom-calls."""
+    """cfg = (eps, n, h, w); data may be 4D NHWC or 2D (n*h*w, c) — the
+    chain runs 2D internally either way.  Returns (out, mu1, var1, mu2,
+    var2): the interior batch stats are REAL outputs (consumed,
+    stop-gradiented, by the moving-average updates) so the forward runs
+    exactly once — no reliance on XLA CSE-ing duplicated pallas
+    custom-calls."""
     out, _, _, st1, st2 = _fused_unit_fwd_impl(
-        eps, data, g1, b1, w1, g2, b2, w2, g3, b3, w3, mu0, var0)
+        cfg, data, g1, b1, w1, g2, b2, w2, g3, b3, w3, mu0, var0)
     return (out,) + st1 + st2
 
 
-def _fused_unit_fwd_impl(eps, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
+def _fused_unit_fwd_impl(cfg, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
                          mu0, var0, fixed_stats=None):
     """The conv1 -> conv2 -> conv3+skip kernel chain.  Training mode
     (fixed_stats None) finalizes each interior BN's batch stats from the
     previous kernel's epilogue; eval passes the moving stats as
     fixed_stats=(mu1, var1, mu2, var2) and skips the epilogues — ONE
-    chain serves both modes so they cannot drift."""
+    chain serves both modes so they cannot drift.  Output shape follows
+    the input (2D in -> 2D out: consecutive fused units chain without
+    relayout copies at their boundaries)."""
     training = fixed_stats is None
-    n, h, w_, c = data.shape
+    eps, n, h, w_ = cfg
+    c = data.shape[-1]
     rows = n * h * w_
     x2d = data.reshape(rows, c)
     sc1, sh1, _, _, _ = _bn_vectors(mu0, var0, g1, b1, eps)
@@ -571,32 +771,38 @@ def _fused_unit_fwd_impl(eps, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
     mu1, var1 = _stats_from_sums(s1, ss1, rows) if training \
         else (fixed_stats[0], fixed_stats[1])
     sc2, sh2, _, _, _ = _bn_vectors(mu1, var1, g2, b2, eps)
-    y1 = y1_2d.reshape(n, h, w_, cq)
-    c3_fwd = _c3_fwd if _c3_use_pallas_fwd(h, w_, cq) else _c3_fwd_xla
-    y2, s2, ss2 = c3_fwd(y1, _w4(w2), sc2, sh2, data.dtype)
+    c3m = _c3_impl(h, w_, cq, bwd=False)
+    if c3m == "2d":
+        y2_2d, s2, ss2 = _c3_fwd2d(y1_2d, _w4(w2), sc2, sh2, n, h, w_,
+                                   data.dtype)
+    else:
+        c3_fwd = _c3_fwd if c3m == "4d" else _c3_fwd_xla
+        y2, s2, ss2 = c3_fwd(y1_2d.reshape(n, h, w_, cq), _w4(w2),
+                             sc2, sh2, data.dtype)
+        y2_2d = y2.reshape(rows, cq)
     mu2, var2 = _stats_from_sums(s2, ss2, rows) if training \
         else (fixed_stats[2], fixed_stats[3])
     sc3, sh3, _, _, _ = _bn_vectors(mu2, var2, g3, b3, eps)
-    out2d = _mm_skip_fwd(y2.reshape(rows, cq), _w2d(w3), sc3, sh3,
-                         x2d, data.dtype)
-    return (out2d.reshape(n, h, w_, c), y1, y2,
+    out2d = _mm_skip_fwd(y2_2d, _w2d(w3), sc3, sh3, x2d, data.dtype)
+    return (out2d.reshape(data.shape), y1_2d, y2_2d,
             (mu1, var1), (mu2, var2))
 
 
-def _fused_unit_fwd_vjp(eps, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
+def _fused_unit_fwd_vjp(cfg, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
                         mu0, var0):
     out, y1, y2, st1, st2 = _fused_unit_fwd_impl(
-        eps, data, g1, b1, w1, g2, b2, w2, g3, b3, w3, mu0, var0)
+        cfg, data, g1, b1, w1, g2, b2, w2, g3, b3, w3, mu0, var0)
     res = (data, y1, y2, st1, st2, g1, b1, w1, g2, b2, w2, g3, b3, w3,
            mu0, var0)
     return (out,) + st1 + st2, res
 
 
-def _fused_unit_bwd(eps, res, cots):
+def _fused_unit_bwd(cfg, res, cots):
     g_out = cots[0]   # stats outputs feed stop_gradient'd aux updates only
     (data, y1, y2, (mu1, var1), (mu2, var2),
      g1, b1, w1, g2, b2, w2, g3, b3, w3, mu0, var0) = res
-    n, h, w_, c = data.shape
+    eps, n, h, w_ = cfg
+    c = data.shape[-1]
     rows = n * h * w_
     cq = w1.shape[0]
     x2d = data.reshape(rows, c)
@@ -609,18 +815,27 @@ def _fused_unit_bwd(eps, res, cots):
     # conv3 backward: cotangent at `out` is final (the +skip add passes
     # g_out through to d(data) unchanged, added at the end)
     dp3, dw3, db3, dg3 = _mm_bwd(
-        g2d, None, None, y2.reshape(rows, cq),
+        g2d, None, None, y2,
         w3.reshape(w3.shape[0], -1), sc3, sh3, xs2, xh2, data.dtype)
     # conv2 backward: finalize bn3's backward in the prologue
     fin3 = _finalize_vectors(g3, inv2, mu2, db3, dg3, rows)
-    c3_bwd = _c3_bwd if _c3_use_pallas_bwd(h, w_, cq) else _c3_bwd_xla
-    dp2, dw2, db2, dg2 = c3_bwd(
-        dp3.reshape(n, h, w_, cq), y2, fin3, y1, _w4(w2), sc2, sh2,
-        xs1, xh1, data.dtype)
+    c3m = _c3_impl(h, w_, cq, bwd=True)
+    if c3m == "2d":
+        dp2, dw2, db2, dg2 = _c3_bwd2d(
+            dp3, y2, fin3, y1, _w4(w2), sc2, sh2, xs1, xh1,
+            n, h, w_, data.dtype)
+        dp2_2d = dp2
+    else:
+        c3_bwd = _c3_bwd if c3m == "4d" else _c3_bwd_xla
+        dp2, dw2, db2, dg2 = c3_bwd(
+            dp3.reshape(n, h, w_, cq), y2.reshape(n, h, w_, cq), fin3,
+            y1.reshape(n, h, w_, cq), _w4(w2), sc2, sh2,
+            xs1, xh1, data.dtype)
+        dp2_2d = dp2.reshape(rows, cq)
     # conv1 backward: finalize bn2's backward in the prologue
     fin2 = _finalize_vectors(g2, inv1, mu1, db2, dg2, rows)
     dp1, dw1, db1, dg1 = _mm_bwd(
-        dp2.reshape(rows, cq), y1.reshape(rows, cq), fin2, x2d,
+        dp2_2d, y1, fin2, x2d,
         w1.reshape(w1.shape[0], -1), sc1, sh1, xs0, xh0, data.dtype)
     # close: bn1's backward finalize + the skip path (one XLA fusion)
     c1v, u0v, u1v = _finalize_vectors(g1, inv0, mu0, db1, dg1, rows)
@@ -678,6 +893,7 @@ def _fbu_fill(attrs, in_shapes):
           mode_dependent=True, fill_shapes=_fbu_fill,
           params={"num_filter": P(int), "eps": P(float, EPS_DEFAULT),
                   "momentum": P(float, 0.9),
+                  "height": P(int, 0), "width": P(int, 0),
                   "layout": P("str_or_none", None)})
 def fused_bottleneck_unit(attrs, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
                           mm1, mv1, mm2, mv2, mm3, mv3):
@@ -686,19 +902,31 @@ def fused_bottleneck_unit(attrs, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
     fused Pallas kernel chain.  Parameter set matches the unfused
     subgraph (models/resnet.py _residual_unit) so checkpoints load
     either way.  NHWC only."""
-    if data.ndim != 4:
-        raise MXNetError("_contrib_FusedBottleneckUnit expects NHWC 4D data")
+    if data.ndim == 4:
+        n, h, w_, c = data.shape
+    elif data.ndim == 2:
+        # 2D chain form: consecutive fused units pass (n*h*w, c) rows so
+        # no 4D<->2D relayout copy exists at their boundary; the builder
+        # provides the spatial dims as attrs
+        h, w_ = attrs["height"], attrs["width"]
+        if not (h and w_):
+            raise MXNetError("_contrib_FusedBottleneckUnit with 2D data "
+                             "needs height/width attrs")
+        c = data.shape[-1]
+        n = data.shape[0] // (h * w_)
+    else:
+        raise MXNetError("_contrib_FusedBottleneckUnit expects NHWC 4D "
+                         "or (rows, C) 2D data")
     eps = attrs["eps"]
     mom = attrs["momentum"]
     training = attrs.get("_training", False)
-    n, h, w_, c = data.shape
-    rows = n * h * w_
+    cfg = (eps, n, h, w_)
     if training:
-        red = (0, 1, 2)
-        mu0 = jnp.mean(data.astype(jnp.float32), axis=red)
-        var0 = jnp.var(data.astype(jnp.float32), axis=red)
+        xf = data.astype(jnp.float32).reshape(-1, c)
+        mu0 = jnp.mean(xf, axis=0)
+        var0 = jnp.var(xf, axis=0)
         out, mu1, var1, mu2, var2 = _fused_unit_core(
-            eps, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
+            cfg, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
             lax.stop_gradient(mu0), lax.stop_gradient(var0))
         sg = lax.stop_gradient
         upd = lambda old, new: mom * old + (1 - mom) * sg(new)  # noqa: E731
@@ -708,7 +936,7 @@ def fused_bottleneck_unit(attrs, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
     # eval: moving statistics through the SAME chain, forward only
     f32 = jnp.float32
     out, _, _, _, _ = _fused_unit_fwd_impl(
-        eps, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
+        cfg, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
         mm1.astype(f32), mv1.astype(f32),
         fixed_stats=(mm2.astype(f32), mv2.astype(f32),
                      mm3.astype(f32), mv3.astype(f32)))

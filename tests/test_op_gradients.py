@@ -251,6 +251,21 @@ SPECS["_contrib_BNStemConv"] = S(
              np.zeros(3), np.ones(3)],
     {"num_filter": 4, "kernel": (3, 3), "stride": (2, 2), "pad": (1, 1)},
     wrt=[2, 3], training=True, eps=3e-3, rtol=3e-2, atol=3e-3)
+# fused bottleneck unit: whole-unit Pallas chain (interpret mode on CPU);
+# differentiable wrt data + all 9 params, aux (moving stats) excluded;
+# equivalence against the unfused composition is in tests/test_fused_unit.py
+# betas biased +0.8 so no pre-ReLU activation sits within the
+# finite-difference eps of its kink (the composite has 3 ReLUs; an
+# unlucky draw otherwise puts ~1 element of the numeric grad across a
+# kink — the vjp itself is equivalence-tested in tests/test_fused_unit.py)
+SPECS["_contrib_FusedBottleneckUnit"] = S(
+    lambda: [_u(2, 4, 4, 8), _pos(8), _u(8) + 0.8, _u(2, 1, 1, 8),
+             _pos(2), _u(2) + 0.8, _u(2, 3, 3, 2),
+             _pos(2), _u(2) + 0.8, _u(8, 1, 1, 2),
+             np.zeros(8), np.ones(8), np.zeros(2), np.ones(2),
+             np.zeros(2), np.ones(2)],
+    {"num_filter": 8, "layout": "NHWC"},
+    wrt=list(range(10)), training=True, eps=3e-3, rtol=3e-2, atol=3e-3)
 SPECS["LayerNorm"] = S(lambda: [_u(2, 5), _pos(5), _u(5)])
 SPECS["InstanceNorm"] = S(lambda: [_u(2, 3, 5), _pos(3), _u(3)],
                           rtol=5e-3, atol=1e-4)
