@@ -49,10 +49,13 @@ VARIABLES = {v.name: v for v in [
          "the Pallas block-kernel tier (models/resnet.py); narrower "
          "units keep the XLA path.  0 = fuse every eligible unit."),
     _Var("MXNET_FUSED_UNIT_C3", str, "auto",
-         "Middle-conv path inside fused units: 'auto' = Pallas 3x3 "
-         "where its VMEM model fits (ops/fused_unit.py _c3_bwd_fits), "
-         "'xla' = always the XLA segment (measured faster on v5e: the "
-         "Pallas 3x3 runs far below line rate at small spatial sizes)."),
+         "Middle-conv path inside fused units (ops/fused_unit.py): "
+         "'auto' = the 2D row-layout Pallas kernels where their VMEM "
+         "model fits, else the XLA segment; '2d'/'4d' force the row- or "
+         "spatial-layout Pallas kernels (subject to their fit gates); "
+         "'xla' = always the XLA segment.  PROFILE_r05.md carries the "
+         "per-path measurements (2d > 4d; all still behind plain XLA "
+         "units on v5e, hence unit_impl='fused' is off by default)."),
     _Var("MXNET_CPU_WORKER_NTHREADS", int, 4,
          "Default worker-thread count for host-side pipelines "
          "(ImageRecordIter preprocess_threads default; the reference's "

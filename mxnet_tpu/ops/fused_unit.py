@@ -443,12 +443,13 @@ def _c3_fwd(x4d, w4, sc, sh, out_dtype):
     return y, s[0], ss[0]
 
 
-def _img_row_block(n, h, w, ci, co, n_temps):
+def _img_row_block(n, h, w, ci, co, n_temps, fixed_bytes=0):
     """Row tile = whole images; batch-per-tile chosen by the calibrated
-    f32-temp liveness model against the 16MB scoped-VMEM budget."""
+    f32-temp liveness model (plus resident weight/wgrad blocks) against
+    the 16MB scoped-VMEM budget."""
     per_img = n_temps * h * w * (ci + co) * 4
     for bn in (16, 8, 4, 2, 1):
-        if n % bn == 0 and bn * per_img <= 11 * 1024 * 1024:
+        if n % bn == 0 and bn * per_img + fixed_bytes <= 11 * 1024 * 1024:
             return bn
     return 1
 
@@ -456,7 +457,8 @@ def _img_row_block(n, h, w, ci, co, n_temps):
 def _c3_fwd2d(x2d, w4, sc, sh, n, h, w, out_dtype):
     rows, ci = x2d.shape
     co = w4.shape[-1]
-    bn_ = _img_row_block(n, h, w, ci, co, 5)
+    bn_ = _img_row_block(n, h, w, ci, co, 5,
+                         fixed_bytes=9 * ci * co * 2)
     br = bn_ * h * w
     kern = functools.partial(_k_conv3_fwd_2d, h=h, w=w)
     outs = [jax.ShapeDtypeStruct((rows, co), out_dtype),
@@ -484,7 +486,8 @@ def _c3_bwd2d(dpn2d, y2_2d, fin, y1_2d, w4, sc, sh, xs, xh,
     co = y2_2d.shape[-1]
     c1, u0, u1 = fin
     wt4 = jnp.transpose(w4, (0, 1, 3, 2))       # (3,3,Co,Ci) for dgrad
-    bn_ = _img_row_block(n, h, w, ci, co, 8)
+    bn_ = _img_row_block(n, h, w, ci, co, 8,
+                         fixed_bytes=9 * ci * co * (2 + 4 + 2))
     br = bn_ * h * w
     kern = functools.partial(_k_conv3_bwd_2d, h=h, w=w)
     outs = [jax.ShapeDtypeStruct((rows, ci), dp_dtype),
@@ -913,6 +916,10 @@ def fused_bottleneck_unit(attrs, data, g1, b1, w1, g2, b2, w2, g3, b3, w3,
             raise MXNetError("_contrib_FusedBottleneckUnit with 2D data "
                              "needs height/width attrs")
         c = data.shape[-1]
+        if data.shape[0] % (h * w_):
+            raise MXNetError(
+                "_contrib_FusedBottleneckUnit 2D data: %d rows is not a "
+                "multiple of height*width = %d*%d" % (data.shape[0], h, w_))
         n = data.shape[0] // (h * w_)
     else:
         raise MXNetError("_contrib_FusedBottleneckUnit expects NHWC 4D "

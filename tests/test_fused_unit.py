@@ -198,3 +198,60 @@ def test_model_fused_units_structure_and_training():
         assert losses[t][-1] < losses[t][0], losses
     for a, b in zip(losses["fused"], losses["std"]):
         assert abs(a - b) < 0.15 * max(1.0, abs(b)), losses
+
+
+@pytest.mark.parametrize("c3", ["2d", "4d", "xla"])
+def test_unit_grads_match_each_c3_path(c3, monkeypatch):
+    """Every middle-conv implementation (2d row-layout Pallas, 4d Pallas,
+    XLA segment) must produce the same unit gradients — keeps the
+    non-default paths from rotting."""
+    monkeypatch.setenv("MXNET_FUSED_UNIT_C3", c3)
+    n, h, w, c = 2, 8, 8, 32
+    rng = np.random.RandomState(11)
+    data = jnp.asarray(rng.standard_normal((n, h, w, c)).astype(np.float32))
+    p = _params(rng, c)
+    keys = sorted(p)
+
+    def loss_f(data_, *vals):
+        q = dict(zip(keys, vals))
+        return jnp.sum(jnp.tanh(_fused(data_, q)[0]))
+
+    def loss_u(data_, *vals):
+        q = dict(zip(keys, vals))
+        return jnp.sum(jnp.tanh(_unfused(data_, q)))
+
+    vals = tuple(p[k] for k in keys)
+    nargs = tuple(range(len(vals) + 1))
+    gf = jax.grad(loss_f, argnums=nargs)(data, *vals)
+    gu = jax.grad(loss_u, argnums=nargs)(data, *vals)
+    for name, a, b in zip(["data"] + keys, gf, gu):
+        scale = float(jnp.abs(b).max()) + 1e-6
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0,
+            atol=3e-5 * max(scale, 1.0), err_msg="%s (%s)" % (name, c3))
+
+
+def test_unit_2d_data_form():
+    """The 2D (rows, C) op form with height/width attrs equals the 4D
+    form (the chain contract the symbol builder relies on)."""
+    n, h, w, c = 2, 6, 5, 16
+    cq = c // 4
+    rng = np.random.RandomState(12)
+    data4 = jnp.asarray(rng.standard_normal((n, h, w, c)).astype(np.float32))
+    p = _params(rng, c)
+    attrs4 = {"num_filter": c, "eps": EPS, "momentum": 0.9,
+              "_training": True, "layout": "NHWC"}
+    attrs2 = dict(attrs4, height=h, width=w)
+    z = lambda m: jnp.zeros((m,), jnp.float32)
+    o = lambda m: jnp.ones((m,), jnp.float32)
+    aux = (z(c), o(c), z(cq), o(cq), z(cq), o(cq))
+    args = (p["g1"], p["b1"], p["w1"], p["g2"], p["b2"], p["w2"],
+            p["g3"], p["b3"], p["w3"])
+    out4 = fused_bottleneck_unit(attrs4, data4, *args, *aux)
+    out2 = fused_bottleneck_unit(attrs2, data4.reshape(-1, c), *args, *aux)
+    assert out2[0].shape == (n * h * w, c)
+    np.testing.assert_allclose(np.asarray(out2[0]).reshape(data4.shape),
+                               np.asarray(out4[0]), rtol=1e-5, atol=1e-5)
+    for a4, a2 in zip(out4[1:], out2[1:]):
+        np.testing.assert_allclose(np.asarray(a4), np.asarray(a2),
+                                   rtol=1e-5, atol=1e-6)
