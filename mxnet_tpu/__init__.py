@@ -64,6 +64,7 @@ from . import rnn
 from . import rtc
 from . import predict
 from .predict import Predictor
+from . import serving
 from . import visualization
 from . import visualization as viz
 from . import test_utils

@@ -35,13 +35,33 @@ class CachedOp:
         self._jit = {}
         self._base_key = None
         self._step = 0
+        self._trace_count = 0
+        # serving dispatches one CachedOp from several threads (worker +
+        # warmup); the step counter must not hand two batches the same
+        # rng fold or "random" draws repeat bitwise across requests
+        import threading
+        self._key_lock = threading.Lock()
+
+    @property
+    def trace_count(self):
+        """Number of XLA traces so far — jax.jit retraces once per new
+        input-shape/dtype signature (the GetForwardGraph shape-keyed
+        cache, cached_op.cc:179), so this is the compile counter the
+        serving program cache exposes: warm traffic must not move it."""
+        return self._trace_count
 
     def _key(self):
         import jax
-        if self._base_key is None:
-            self._base_key = _random.next_key()
-        self._step += 1
-        return jax.random.fold_in(self._base_key, self._step)
+        with self._key_lock:
+            if self._base_key is None:
+                self._base_key = _random.next_key()
+            if not self._graph_fn.stochastic:
+                # deterministic subgraph: the key is a dead jit input —
+                # reuse one constant, skip the eager fold_in per call
+                return self._base_key
+            self._step += 1
+            step = self._step
+        return jax.random.fold_in(self._base_key, step)
 
     def _get_jit(self, training):
         import jax
@@ -51,6 +71,9 @@ class CachedOp:
             na = len(self.arg_names)
 
             def call(key, *flat_inputs):
+                # Python side effect runs once per trace == once per
+                # compiled program (never on cached dispatches)
+                self._trace_count += 1
                 args = flat_inputs[:na]
                 aux = flat_inputs[na:]
                 outs, new_aux = g(args, aux, key, training)

@@ -92,6 +92,44 @@ VARIABLES = {v.name: v for v in [
          "(XLA emits two fusions that each re-read dy from HBM; the step "
          "is bandwidth-bound, PROFILE_r04.md).  Off by default pending "
          "the measured verdict recorded there."),
+    _Var("MXNET_SERVE_MAX_BATCH", int, 8,
+         "Largest batch bucket the serving engine compiles and "
+         "coalesces to (mxnet_tpu/serving).  Rounded up to a power of "
+         "two; pending requests pad up to the smallest bucket that "
+         "fits, so at most log2(max_batch)+1 programs exist per input "
+         "signature."),
+    _Var("MXNET_SERVE_MAX_QUEUE", int, 256,
+         "Bound on the serving admission queue.  A full queue either "
+         "rejects new work (QueueFullError backpressure) or sheds the "
+         "oldest pending request, per MXNET_SERVE_OVERLOAD_POLICY."),
+    _Var("MXNET_SERVE_BATCH_TIMEOUT_MS", float, 2.0,
+         "Dynamic-batching window: a partial batch waits at most this "
+         "long (measured from its oldest request's enqueue) for more "
+         "compatible requests before dispatching undersized.  0 = "
+         "dispatch immediately, trading occupancy for latency."),
+    _Var("MXNET_SERVE_DEFAULT_DEADLINE_MS", float, 0.0,
+         "Default per-request deadline for serving requests that do "
+         "not pass deadline_ms explicitly; requests still queued past "
+         "their deadline fail with DeadlineExceededError.  0 = no "
+         "default deadline."),
+    _Var("MXNET_SERVE_OVERLOAD_POLICY", str, "reject",
+         "What the serving engine does when the admission queue is "
+         "full: 'reject' raises QueueFullError to the submitting "
+         "client (backpressure); 'shed-oldest' evicts the longest-"
+         "queued request (its future fails with ServerOverloadError) "
+         "to admit the new one — graceful degradation under overload."),
+    _Var("MXNET_SERVE_SEQ_BUCKETS", str, "",
+         "Comma-separated sequence-length buckets (e.g. '32,64,128') "
+         "for the serving engine.  When set, per-example axis 0 is "
+         "padded up to the next bucket so length-polymorphic traffic "
+         "shares programs; outputs are un-padded on the same axis "
+         "(model must be row-independent along it).  Empty = off: "
+         "every distinct example shape is its own bucket."),
+    _Var("MXNET_PROFILER_MAX_EVENTS", int, 1000000,
+         "Bound on the in-memory profiler event buffer.  Beyond it the "
+         "oldest events are dropped (and counted in the dump's "
+         "otherData.dropped_events) so always-on profiling of long "
+         "serving runs cannot grow host memory without limit."),
     _Var("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
          "Accepted for API parity; execution is always one fused XLA "
          "program (the engine bulking machinery this toggled does not "

@@ -97,6 +97,10 @@ def build_graph_fn(symbol, arg_names, aux_names):
             return out_vals, new_aux, tuple(captured[c] for c in capture)
         return out_vals, new_aux
 
+    # deterministic graphs never consume the key: callers use this to
+    # skip the per-dispatch eager fold_in (~0.35 ms on CPU — measured
+    # at ~45% of a small batched-inference dispatch, perf/serve_bench)
+    graph_fn.stochastic = bool(sto_index)
     return graph_fn
 
 
@@ -316,6 +320,10 @@ class Executor:
         import jax
         if self._base_key is None:
             self._base_key = _random.next_key()
+        if not self._graph_fn.stochastic:
+            # no stochastic ops: the key is a dead jit input, so reuse
+            # one constant instead of paying an eager fold_in per step
+            return self._base_key
         self._step += 1
         return jax.random.fold_in(self._base_key, self._step)
 

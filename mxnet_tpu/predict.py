@@ -22,6 +22,45 @@ from . import symbol as sym
 __all__ = ["Predictor", "load_checkpoint_predictor"]
 
 
+def _label_like(names):
+    """Loss-head label inputs, per the c_predict_api placeholder-label
+    convention: bound with dummy zeros, never read at inference.  The
+    single definition of the convention — Predictor construction,
+    Predictor.reshape, and serving.ProgramCache all share it."""
+    return [n for n in names if n.endswith("_label") or n == "label"]
+
+
+def _infer_label_shapes(symbol, data_shapes, labels):
+    """Shapes for the placeholder label buffers, inferred from the data
+    shapes alone."""
+    if not labels:
+        return {}
+    arg_shapes, _, _ = symbol.infer_shape(**data_shapes)
+    return {n: tuple(s) for n, s in
+            zip(symbol.list_arguments(), arg_shapes) if n in labels}
+
+
+def _assemble_args(symbol, data_shapes, ctx, params):
+    """The args dict for an inference bind: fresh zero buffers for the
+    data inputs and the inferred placeholder labels, everything else
+    taken from ``params`` AS-IS (already device-placed — callers choose
+    whether that means an ``as_in_context`` walk or sharing a bound
+    executor's buffers)."""
+    arg_names = symbol.list_arguments()
+    labels = _label_like(n for n in arg_names
+                         if n not in params and n not in data_shapes)
+    label_shapes = _infer_label_shapes(symbol, data_shapes, labels)
+    args = {}
+    for n in arg_names:
+        if n in data_shapes:
+            args[n] = nd.zeros(data_shapes[n], ctx=ctx)
+        elif n in label_shapes:
+            args[n] = nd.zeros(label_shapes[n], ctx=ctx)
+        else:
+            args[n] = params[n]
+    return args
+
+
 class Predictor(object):
     """Forward-only executor over a frozen graph (c_predict_api.cc)."""
 
@@ -33,39 +72,33 @@ class Predictor(object):
             # PartialOut: expose chosen internal outputs
             internals = symbol.get_internals()
             symbol = sym.Group([internals[n] for n in output_names])
-        self._sym = symbol
-        self._ctx = ctx or cpu()
+        ctx = ctx or cpu()
         data_shapes = dict(data_shapes)
-        self._data_names = list(data_shapes)
 
         arg_names = symbol.list_arguments()
         missing = [n for n in arg_names
                    if n not in arg_params and n not in data_shapes]
-        # loss-head label inputs get dummy zeros: inference never reads
-        # them (c_predict_api.cc binds heads with placeholder labels)
-        labels = [n for n in missing
-                  if n.endswith("_label") or n == "label"]
+        labels = _label_like(missing)
         missing = [n for n in missing if n not in labels]
         if missing:
             raise MXNetError("Predictor: params missing for %s" % missing)
-        label_shapes = {}
-        if labels:
-            arg_shapes, _, _ = symbol.infer_shape(**data_shapes)
-            label_shapes = {n: tuple(s) for n, s in
-                            zip(arg_names, arg_shapes) if n in labels}
-        args = {}
-        for n in arg_names:
-            if n in data_shapes:
-                args[n] = nd.zeros(data_shapes[n], ctx=self._ctx)
-            elif n in label_shapes:
-                args[n] = nd.zeros(label_shapes[n], ctx=self._ctx)
-            else:
-                args[n] = arg_params[n].as_in_context(self._ctx)
-        aux = {n: aux_params[n].as_in_context(self._ctx)
+        params = {n: arg_params[n].as_in_context(ctx) for n in arg_names
+                  if n in arg_params and n not in data_shapes}
+        aux = {n: aux_params[n].as_in_context(ctx)
                for n in symbol.list_auxiliary_states()}
+        self._bind(symbol, ctx, data_shapes,
+                   _assemble_args(symbol, data_shapes, ctx, params), aux)
+
+    def _bind(self, symbol, ctx, data_shapes, args, aux):
+        """Single place every Predictor instance — constructed or
+        reshape()d — gets its attributes and bound executor, so the two
+        paths cannot drift."""
+        self._sym = symbol
+        self._ctx = ctx
+        self._data_names = list(data_shapes)
         self._exec = symbol.bind(
-            self._ctx, args=args, aux_states=aux or None,
-            grad_req={n: "null" for n in arg_names})
+            ctx, args=args, aux_states=aux or None,
+            grad_req={n: "null" for n in symbol.list_arguments()})
         self._outputs = None
 
     def set_input(self, name=None, value=None, **named):
@@ -95,6 +128,18 @@ class Predictor(object):
             raise MXNetError("forward() has not run")
         return self._outputs[index].asnumpy()
 
+    def get_outputs(self, as_numpy=True):
+        """Fetch ALL outputs in one call.
+
+        ``as_numpy=False`` returns the device-resident NDArrays without
+        a host round-trip — callers chaining into further device work
+        (or the serving layer) skip len(outputs) asnumpy copies."""
+        if self._outputs is None:
+            raise MXNetError("forward() has not run")
+        if as_numpy:
+            return [o.asnumpy() for o in self._outputs]
+        return list(self._outputs)
+
     @property
     def output_shapes(self):
         shapes = {d: s for d, s in
@@ -105,14 +150,27 @@ class Predictor(object):
         return [tuple(s) for s in out_shapes]
 
     def reshape(self, data_shapes):
-        """Rebuild for new input shapes (MXPredReshape)."""
-        arg_params = {n: self._exec.arg_dict[n]
-                      for n in self._sym.list_arguments()
-                      if n not in self._data_names
-                      and not (n.endswith("_label") or n == "label")}
-        aux_params = dict(self._exec.aux_dict)
-        return Predictor(self._sym, arg_params, aux_params, data_shapes,
-                         ctx=self._ctx)
+        """Rebuild for new input shapes (MXPredReshape).
+
+        Fast path: params/aux are already device-resident in the bound
+        executor, so the new Predictor shares those NDArrays as-is — no
+        constructor re-validation, no ``as_in_context`` walk, and no
+        host→device re-upload (tests assert buffer identity).  Only the
+        data (and derived label) buffers are re-allocated."""
+        data_shapes = dict(data_shapes)
+        if set(data_shapes) != set(self._data_names):
+            raise MXNetError("reshape: data_shapes %s must cover exactly "
+                             "the bound inputs %s"
+                             % (sorted(data_shapes), self._data_names))
+        arg_names = self._sym.list_arguments()
+        labels = set(_label_like(arg_names))
+        params = {n: self._exec.arg_dict[n] for n in arg_names
+                  if n not in data_shapes and n not in labels}  # no copy
+        new = object.__new__(Predictor)
+        new._bind(self._sym, self._ctx, data_shapes,
+                  _assemble_args(self._sym, data_shapes, self._ctx, params),
+                  dict(self._exec.aux_dict))
+        return new
 
 
 def load_checkpoint_predictor(prefix, epoch, data_shapes, ctx=None,

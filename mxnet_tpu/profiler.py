@@ -19,18 +19,72 @@ design.  Instead:
 
 Spans are cheap (two perf_counter calls + list append when ON, one branch
 when OFF).
+
+The event buffer is BOUNDED (serving runs keep the profiler on for
+days): at most ``MXNET_PROFILER_MAX_EVENTS`` events are held, oldest
+dropped first; the drop count is reported in the dump's
+``otherData.dropped_events``.  ``clear()`` empties the buffer without
+writing a file.
 """
 import atexit
+import collections
 import json
 import os
 import threading
 import time
 
+
+def _default_max_events():
+    from . import config
+    return config.get("MXNET_PROFILER_MAX_EVENTS")
+
+
 _LOCK = threading.Lock()
-_EVENTS = []
+_EVENTS = collections.deque(maxlen=_default_max_events())
+_DROPPED = 0
 _STATE = {"running": False, "filename": "profile.json",
           "continuous_dump": False}
 _T0 = time.perf_counter()
+
+
+def _append(evt):
+    """Append under the lock, counting ring-buffer evictions."""
+    global _DROPPED
+    if len(_EVENTS) == _EVENTS.maxlen:
+        _DROPPED += 1
+    _EVENTS.append(evt)
+
+
+_MAX_EVENTS_OVERRIDDEN = False
+
+
+def set_max_events(n):
+    """Re-bound the event buffer (keeps the newest events if shrinking;
+    anything discarded counts toward ``dropped_events``).  An explicit
+    call pins the bound — profiler_set_state('run') stops re-reading
+    MXNET_PROFILER_MAX_EVENTS from the live config."""
+    global _EVENTS, _DROPPED, _MAX_EVENTS_OVERRIDDEN
+    with _LOCK:
+        _MAX_EVENTS_OVERRIDDEN = True
+        n = int(n)
+        if len(_EVENTS) > n:
+            _DROPPED += len(_EVENTS) - n
+        _EVENTS = collections.deque(_EVENTS, maxlen=n)
+
+
+def clear():
+    """Drop all buffered events and the eviction counter (long serving
+    runs call this after each periodic dump/scrape)."""
+    global _DROPPED
+    with _LOCK:
+        _EVENTS.clear()
+        _DROPPED = 0
+
+
+def dropped_events():
+    """Events evicted from the bounded buffer since the last clear."""
+    with _LOCK:
+        return _DROPPED
 
 
 def _now_us():
@@ -53,6 +107,17 @@ def set_config(**kwargs):
 def profiler_set_state(state="stop"):
     """'run' starts collecting host spans; 'stop' halts (ref :40)."""
     assert state in ("run", "stop")
+    if state == "run" and not _MAX_EVENTS_OVERRIDDEN:
+        # honor the live config like every other MXNET_* knob (the
+        # import-time default would ignore env changes made after
+        # `import mxnet_tpu`); an explicit set_max_events() wins
+        global _EVENTS, _DROPPED
+        with _LOCK:
+            n = _default_max_events()
+            if _EVENTS.maxlen != n:
+                if len(_EVENTS) > n:
+                    _DROPPED += len(_EVENTS) - n
+                _EVENTS = collections.deque(_EVENTS, maxlen=n)
     _STATE["running"] = state == "run"
 
 
@@ -86,7 +151,7 @@ class record_span:
         if _STATE["running"] and self._t0:
             t1 = _now_us()
             with _LOCK:
-                _EVENTS.append({
+                _append({
                     "name": self.name, "cat": self.cat, "ph": "X",
                     "ts": self._t0, "dur": t1 - self._t0,
                     "pid": os.getpid(),
@@ -98,7 +163,7 @@ def instant(name, cat="marker"):
     """Instant event (counter markers, epoch boundaries)."""
     if _STATE["running"]:
         with _LOCK:
-            _EVENTS.append({"name": name, "cat": cat, "ph": "i",
+            _append({"name": name, "cat": cat, "ph": "i",
                             "ts": _now_us(), "s": "g",
                             "pid": os.getpid(),
                             "tid": threading.get_ident() & 0xffff})
@@ -108,19 +173,23 @@ def counter(name, value, cat="counter"):
     """Counter sample (e.g. images/sec, loss)."""
     if _STATE["running"]:
         with _LOCK:
-            _EVENTS.append({"name": name, "cat": cat, "ph": "C",
+            _append({"name": name, "cat": cat, "ph": "C",
                             "ts": _now_us(), "pid": os.getpid(),
                             "args": {name: value}})
 
 
 def dump_profile(finished=True):
     """Write the Chrome trace JSON (ref MXDumpProfile / profiler.cc:147)."""
+    global _DROPPED
     with _LOCK:
         events = list(_EVENTS)
+        dropped = _DROPPED
         if finished:
             _EVENTS.clear()
+            _DROPPED = 0
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
-           "otherData": {"framework": "mxnet_tpu"}}
+           "otherData": {"framework": "mxnet_tpu",
+                         "dropped_events": dropped}}
     with open(_STATE["filename"], "w") as f:
         json.dump(doc, f)
     return _STATE["filename"]

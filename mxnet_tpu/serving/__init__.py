@@ -1,0 +1,29 @@
+"""mxnet_tpu.serving — dynamic-batching inference runtime.
+
+A new layer on top of the executor stack (no reference analog: the
+reference stops at the single-client C predict API).  Three parts:
+
+- :mod:`.engine`    — request queue + dynamic batcher + worker thread;
+- :mod:`.buckets`   — shape-bucket policy and the compile-once program
+  cache (CachedOp-backed, with a compile counter);
+- :mod:`.admission` — bounded queue, deadlines, overload shedding.
+
+Quick start::
+
+    eng = serving.ServingEngine.from_checkpoint(
+        "model", 20, data_shapes={"data": (6,)})
+    eng.warmup()                       # compile all buckets up front
+    fut = eng.submit(np.ones((6,), np.float32))
+    probs = fut.result()
+    eng.close()
+"""
+from .admission import (AdmissionController, Request, QueueFullError,
+                        DeadlineExceededError, ServerOverloadError,
+                        EngineClosedError)
+from .buckets import BucketPolicy, ProgramCache
+from .engine import ServingEngine
+
+__all__ = ["ServingEngine", "BucketPolicy", "ProgramCache",
+           "AdmissionController", "Request", "QueueFullError",
+           "DeadlineExceededError", "ServerOverloadError",
+           "EngineClosedError"]

@@ -1,0 +1,270 @@
+"""Admission control for the serving engine.
+
+Reference analog: the reference's engine has per-device bounded task
+queues (threaded_engine_pooled.cc) but no request-level admission — a
+serving runtime needs one.  This layer owns the *pending request* queue
+that sits in front of the compiled-program dispatcher:
+
+- **bounded queue / backpressure**: at most ``max_queue`` requests wait;
+  beyond that ``admit`` either raises :class:`QueueFullError` (policy
+  ``reject`` — push backpressure to the client) or evicts the oldest
+  pending request (policy ``shed-oldest`` — graceful degradation under
+  overload: old work is the least likely to still meet its deadline).
+- **deadlines**: each request may carry an absolute expiry; a sweep runs
+  on every queue interaction and inside the blocking ``take`` wait, so
+  an expired request fails fast with :class:`DeadlineExceededError`
+  instead of occupying a batch slot.
+- **coalescing pop**: ``take`` blocks until work is available, honors a
+  batching window measured from the oldest request's enqueue time, and
+  returns the oldest request plus every queued request in the same
+  shape *group* (set by the engine), oldest-first, up to ``max_batch``.
+
+All state is guarded by one condition variable; producers are client
+threads calling ``admit``, the single consumer is the engine worker.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from ..base import MXNetError
+
+__all__ = ["AdmissionController", "Request", "QueueFullError",
+           "DeadlineExceededError", "ServerOverloadError",
+           "EngineClosedError"]
+
+
+def _fail_future(fut, exc):
+    """Deliver ``exc`` to a pending future, tolerating client-side
+    ``cancel()``: a cancelled future has already delivered its outcome,
+    and ``set_exception`` on it raises InvalidStateError — which must
+    never propagate into the admission paths (it would kill the single
+    worker thread or surface to an innocent submitter)."""
+    if not fut.cancelled():
+        try:
+            fut.set_exception(exc)
+        except Exception:       # lost a cancel() race — outcome delivered
+            pass
+
+
+class QueueFullError(MXNetError):
+    """Raised to the submitting client when the bounded queue is full
+    and the overload policy is ``reject`` (backpressure)."""
+
+
+class DeadlineExceededError(MXNetError):
+    """Set on a request's future when its deadline passed while the
+    request was still queued."""
+
+
+class ServerOverloadError(MXNetError):
+    """Set on the future of a request shed under the ``shed-oldest``
+    overload policy."""
+
+
+class EngineClosedError(MXNetError):
+    """Raised/set when submitting to (or draining of) a closed engine."""
+
+
+class Request(object):
+    """One pending inference request.
+
+    ``inputs`` maps data-input name to a host ndarray (per-example, no
+    batch dim).  ``group`` is the engine-computed coalescing key (padded
+    per-example shapes after seq bucketing): only requests with equal
+    groups share a dispatched batch.  ``out_rows`` holds the per-example
+    output shapes the graph infers at the UNPADDED input, which the
+    engine slices dispatched rows back to (None when seq bucketing is
+    off).
+    """
+    __slots__ = ("inputs", "group", "future", "t_enqueue", "deadline",
+                 "out_rows")
+
+    def __init__(self, inputs, group, future, deadline=None,
+                 out_rows=None):
+        self.inputs = inputs
+        self.group = group
+        self.future = future
+        self.t_enqueue = time.monotonic()
+        self.deadline = deadline            # absolute time.monotonic()
+        self.out_rows = out_rows
+
+    def expired(self, now=None):
+        return self.deadline is not None and \
+            (now if now is not None else time.monotonic()) >= self.deadline
+
+
+class AdmissionController(object):
+    def __init__(self, max_queue=256, overload_policy="reject",
+                 sweep_interval=0.05, wake_hint=None):
+        if overload_policy not in ("reject", "shed-oldest", "shed_oldest"):
+            raise MXNetError("unknown overload policy %r "
+                             "(use 'reject' or 'shed-oldest')"
+                             % (overload_policy,))
+        self.max_queue = int(max_queue)
+        self.overload_policy = overload_policy.replace("_", "-")
+        self._sweep_interval = sweep_interval
+        # GIL-churn control: with a wake_hint (the engine's max_batch),
+        # admit only wakes the consumer when the queue STARTS (depth 1,
+        # so the batching-window timer can run) or plausibly FILLS a
+        # batch (depth >= hint); in between the consumer sleeps on its
+        # own timed wait.  Cuts consumer wakeups from one-per-admit to
+        # two-per-batch under bursty load.
+        self._wake_hint = int(wake_hint) if wake_hint else None
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        # monotonically increasing counters, guarded by _cond's lock
+        self.admitted = 0
+        self.rejected = 0
+        self.shed = 0
+        self.expired = 0
+
+    # ------------------------------------------------------------- producer
+    def admit(self, req):
+        """Enqueue a request or apply the overload policy.  Thread-safe;
+        called from client threads."""
+        failures, reject = [], None
+        with self._cond:
+            if self._closed:
+                raise EngineClosedError("serving engine is closed")
+            failures += self._sweep_locked()
+            if len(self._queue) >= self.max_queue:
+                if self.overload_policy == "shed-oldest":
+                    victim = self._queue.popleft()
+                    self.shed += 1
+                    failures.append((victim.future, ServerOverloadError(
+                        "request shed after %.1f ms queued: queue full "
+                        "(%d) under shed-oldest overload policy"
+                        % ((time.monotonic() - victim.t_enqueue) * 1e3,
+                           self.max_queue))))
+                else:
+                    self.rejected += 1
+                    reject = QueueFullError(
+                        "serving queue full (%d pending): backpressure"
+                        % self.max_queue)
+            if reject is None:
+                self._queue.append(req)
+                self.admitted += 1
+                if self._wake_hint is None or len(self._queue) == 1 \
+                        or len(self._queue) >= self._wake_hint:
+                    self._cond.notify()    # single consumer (the worker)
+        self._deliver(failures)
+        if reject is not None:
+            raise reject
+
+    # ------------------------------------------------------------- consumer
+    def take(self, max_batch, window_s):
+        """Block until a batch is ready; return the oldest request's
+        whole group (≤ ``max_batch``, oldest-first).
+
+        Returns ``None`` when the controller is closed and drained.  The
+        batching window runs from the oldest request's enqueue time: a
+        full group dispatches immediately, a partial one waits at most
+        ``window_s`` for company before going out undersized.
+        """
+        while True:
+            failures, batch, decided = [], None, False
+            with self._cond:
+                failures += self._sweep_locked()
+                if not self._queue:
+                    if self._closed:
+                        decided = True
+                    else:
+                        self._cond.wait(self._sweep_interval)
+                else:
+                    head = self._queue[0]
+                    now = time.monotonic()
+                    n_group = sum(1 for r in self._queue
+                                  if r.group == head.group)
+                    wait_until = head.t_enqueue + window_s
+                    if n_group >= max_batch or now >= wait_until \
+                            or self._closed:
+                        decided = True
+                        batch = self._pop_group_locked(head.group, max_batch)
+                    else:
+                        self._cond.wait(min(wait_until - now,
+                                            self._sweep_interval))
+            self._deliver(failures)
+            if decided:
+                return batch
+
+    def _pop_group_locked(self, group, max_batch):
+        taken, keep = [], collections.deque()
+        for r in self._queue:
+            if r.group == group and len(taken) < max_batch:
+                taken.append(r)
+            else:
+                keep.append(r)
+        self._queue = keep
+        return taken
+
+    # -------------------------------------------------------------- expiry
+    def _sweep_locked(self):
+        """Drop expired requests from the queue; RETURNS the (future,
+        exception) pairs for the caller to deliver AFTER releasing the
+        lock — concurrent.futures runs done-callbacks synchronously in
+        the completing thread, and a callback that re-enters this
+        controller (submit-on-failure retry) would deadlock on the
+        non-reentrant condition lock."""
+        if not any(r.deadline is not None for r in self._queue):
+            return []
+        now = time.monotonic()
+        live, failures = collections.deque(), []
+        for r in self._queue:
+            if r.expired(now):
+                self.expired += 1
+                failures.append((r.future, DeadlineExceededError(
+                    "deadline exceeded after %.1f ms in queue"
+                    % ((now - r.t_enqueue) * 1e3))))
+            else:
+                live.append(r)
+        self._queue = live
+        return failures
+
+    @staticmethod
+    def _deliver(failures):
+        """Fail futures OUTSIDE the condition lock (see _sweep_locked)."""
+        for fut, exc in failures:
+            _fail_future(fut, exc)
+
+    def sweep(self):
+        """Expire overdue queued requests now (also runs automatically
+        on every admit/take)."""
+        with self._cond:
+            failures = self._sweep_locked()
+        self._deliver(failures)
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self, drain=True):
+        """Stop admitting.  With ``drain`` the worker keeps taking until
+        the queue empties; otherwise pending futures fail immediately."""
+        failures = []
+        with self._cond:
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    r = self._queue.popleft()
+                    failures.append((r.future, EngineClosedError(
+                        "engine closed before dispatch")))
+            self._cond.notify_all()
+        self._deliver(failures)
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def __len__(self):
+        with self._cond:
+            return len(self._queue)
+
+    def stats(self):
+        with self._cond:
+            return {"queue_depth": len(self._queue),
+                    "max_queue": self.max_queue,
+                    "overload_policy": self.overload_policy,
+                    "admitted": self.admitted,
+                    "rejected": self.rejected,
+                    "shed": self.shed,
+                    "expired": self.expired}

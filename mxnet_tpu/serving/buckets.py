@@ -1,0 +1,227 @@
+"""Shape buckets + compile-once program cache for the serving engine.
+
+The compiler-first serving argument (PAPERS.md: "Compiler-First State
+Space Duality …" §portable caching; "Operator Fusion in XLA" §fusion
+amortization): XLA specializes one program per input-shape signature, so
+an engine that dispatched every request at its natural shape would
+retrace constantly.  Instead all traffic is quantized onto a small grid:
+
+- **batch buckets**: powers of two up to ``max_batch`` — a batch of n
+  requests pads up to the next power of two, so at most
+  log2(max_batch)+1 programs exist per input signature;
+- **seq buckets** (optional): a designated per-example axis is padded up
+  to the next configured bucket, for token/length-polymorphic models
+  whose outputs are row-independent along that axis.
+
+:class:`ProgramCache` reuses the :class:`~mxnet_tpu.cached_op.CachedOp`
+machinery — the same jit-per-signature compile path Gluon hybridize
+uses — rather than ``Predictor``'s bind path: params/aux live on device
+once, each bucket shape becomes one cached XLA program, and
+``CachedOp.trace_count`` is the **compile counter**: warm traffic must
+leave it unchanged, which tests and perf/serve_bench.py assert.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+from ..cached_op import CachedOp
+from ..predict import _infer_label_shapes, _label_like
+
+__all__ = ["BucketPolicy", "ProgramCache"]
+
+
+def _next_pow2(n):
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class BucketPolicy(object):
+    """Quantizes request-batch sizes (and optionally one per-example
+    axis) onto the bucket grid the program cache compiles for."""
+
+    def __init__(self, max_batch=8, seq_axis=None, seq_buckets=()):
+        if max_batch < 1:
+            raise MXNetError("max_batch must be >= 1, got %d" % max_batch)
+        self.max_batch = _next_pow2(int(max_batch))
+        self.seq_axis = seq_axis
+        self.seq_buckets = tuple(sorted(int(b) for b in seq_buckets))
+        if self.seq_buckets and seq_axis is None:
+            raise MXNetError("seq_buckets given without seq_axis")
+
+    @classmethod
+    def from_config(cls):
+        """Build from the MXNET_SERVE_* env tier (config.py)."""
+        from .. import config
+        raw = config.get("MXNET_SERVE_SEQ_BUCKETS").strip()
+        seq_buckets = tuple(int(t) for t in raw.split(",") if t.strip())
+        return cls(max_batch=config.get("MXNET_SERVE_MAX_BATCH"),
+                   seq_axis=0 if seq_buckets else None,
+                   seq_buckets=seq_buckets)
+
+    def batch_buckets(self):
+        out, b = [], 1
+        while b <= self.max_batch:
+            out.append(b)
+            b <<= 1
+        return out
+
+    def batch_bucket(self, n):
+        if n < 1:
+            raise MXNetError("empty batch")
+        if n > self.max_batch:
+            raise MXNetError("batch %d exceeds max_batch %d"
+                             % (n, self.max_batch))
+        return _next_pow2(n)
+
+    def seq_bucket(self, length):
+        """Smallest configured seq bucket >= length (identity when seq
+        bucketing is off)."""
+        if not self.seq_buckets:
+            return length
+        for b in self.seq_buckets:
+            if length <= b:
+                return b
+        raise MXNetError(
+            "sequence length %d exceeds largest seq bucket %d"
+            % (length, self.seq_buckets[-1]))
+
+    def example_shape(self, shape):
+        """Pad a per-example shape onto the bucket grid."""
+        if self.seq_axis is None:
+            return tuple(shape)
+        if self.seq_axis >= len(shape):
+            raise MXNetError("seq_axis %d out of range for shape %s"
+                             % (self.seq_axis, tuple(shape)))
+        s = list(shape)
+        s[self.seq_axis] = self.seq_bucket(s[self.seq_axis])
+        return tuple(s)
+
+
+class ProgramCache(object):
+    """Device-resident params + one compiled forward per bucket shape.
+
+    Not a second compile cache on top of jax.jit's: the jit trace cache
+    (inside the wrapped :class:`CachedOp`) IS the program store, keyed by
+    input shapes exactly as GetForwardGraph keys on shape signatures in
+    the reference (cached_op.cc:179).  This class contributes the fixed
+    input plumbing around it (param/aux placement, dummy label buffers
+    per bucket) plus observability: ``compile_count`` (the CachedOp
+    trace counter) and the set of bucket signatures seen.
+    """
+
+    def __init__(self, symbol, arg_params, aux_params, data_names,
+                 ctx=None, dtype=np.float32):
+        from ..context import cpu
+        self._ctx = ctx or cpu()
+        self._sym = symbol
+        self._dtype = np.dtype(dtype)
+        self.data_names = list(data_names)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        missing = [n for n in arg_names
+                   if n not in (arg_params or {})
+                   and n not in self.data_names]
+        # loss-head label inputs get per-bucket dummy zeros (the
+        # c_predict_api placeholder-label convention, predict._label_like)
+        self._label_names = _label_like(missing)
+        missing = [n for n in missing if n not in self._label_names]
+        if missing:
+            raise MXNetError("ProgramCache: params missing for %s" % missing)
+        self._params = {n: arg_params[n].as_in_context(self._ctx)
+                        for n in arg_names
+                        if n not in self.data_names
+                        and n not in self._label_names}
+        self._aux = {n: (aux_params or {})[n].as_in_context(self._ctx)
+                     for n in aux_names}
+        self._op = CachedOp(symbol)
+        # flat-input template in the kernel's order (args then aux):
+        # params/aux slots hold their device-resident jax array once,
+        # data and label slots are filled per shape key / per dispatch —
+        # driving the CachedOp's jit kernel directly skips the
+        # per-dispatch NDArray wrapping of the imperative front end
+        # (measured ~0.3 ms/batch on CPU, perf/serve_bench.py)
+        order = self._op.arg_names + self._op.aux_names
+        self._data_pos = {n: i for i, n in enumerate(order)
+                          if n in self.data_names}
+        self._label_pos = {n: i for i, n in enumerate(order)
+                           if n in self._label_names}
+        self._template = [None] * len(order)
+        for i, n in enumerate(order):
+            if n in self._params:
+                self._template[i] = self._params[n]._data
+            elif n in self._aux:
+                self._template[i] = self._aux[n]._data
+        self._n_out = len(symbol._outputs)
+        self._plans = {}         # full data-shape key -> prefilled flat
+        self._keys = set()       # bucket signatures dispatched so far
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    @property
+    def compile_count(self):
+        """Number of XLA traces so far — one per (bucket shapes) program.
+        Warm traffic over already-seen buckets must not move this."""
+        return self._op.trace_count
+
+    @property
+    def bucket_keys(self):
+        with self._lock:
+            return sorted(self._keys)
+
+    def _plan_for(self, shape_key, data_shapes):
+        """Prefilled flat-input list + kernel + rng key for one bucket
+        signature: everything per-dispatch work can reuse verbatim.
+        Built once per signature under the lock; dispatches only copy
+        the list and fill the data slots."""
+        plan = self._plans.get(shape_key)
+        if plan is None:
+            with self._lock:
+                plan = self._plans.get(shape_key)
+                if plan is None:
+                    flat = list(self._template)
+                    if self._label_names:
+                        import jax.numpy as jnp
+                        shapes = _infer_label_shapes(
+                            self._sym, data_shapes, self._label_names)
+                        for n, pos in self._label_pos.items():
+                            flat[pos] = jnp.zeros(shapes[n], jnp.float32)
+                    # deterministic graphs can freeze the (dead) rng key
+                    # into the plan; stochastic ones must fold a fresh
+                    # key per dispatch or every batch on this bucket
+                    # replays identical draws
+                    key = (None if self._op._graph_fn.stochastic
+                           else self._op._key())
+                    plan = (flat, self._op._get_jit(False), key,
+                            sorted(self._data_pos.items()))
+                    self._plans[shape_key] = plan
+                    self._keys.add(shape_key)
+        return plan
+
+    def run(self, feeds):
+        """Dispatch one padded batch: ``feeds`` maps data name -> host
+        ndarray WITH batch dim, already padded to bucket shapes.
+        Returns the outputs as host ndarrays (still batch-padded).
+
+        Hot path: drives the CachedOp's jit kernel directly — the graph
+        is frozen, so aux write-back and autograd bookkeeping are
+        skipped, the non-data input slots come from the prebuilt
+        device-resident template, and the whole non-data plumbing is a
+        cached per-signature plan (no lock, no rebuild on warm keys)."""
+        shape_key = tuple(sorted((k, v.shape) for k, v in feeds.items()))
+        plan = self._plans.get(shape_key)
+        if plan is None:
+            plan = self._plan_for(
+                shape_key, {k: tuple(v.shape) for k, v in feeds.items()})
+        template, kernel, key, data_pos = plan
+        if key is None:
+            key = self._op._key()       # stochastic graph: fresh draws
+        flat = list(template)
+        for n, pos in data_pos:
+            flat[pos] = feeds[n]        # jit commits host arrays itself
+        outs = kernel(key, *flat)
+        return [np.asarray(o) for o in outs[:self._n_out]]

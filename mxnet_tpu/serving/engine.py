@@ -1,0 +1,362 @@
+"""ServingEngine — dynamic-batching inference runtime.
+
+The ROADMAP north star serves heavy multi-user traffic; the unit of
+efficiency on an XLA device is the *compiled program dispatch*, not the
+request (PAPERS.md fusion-amortization argument).  This engine turns
+many concurrent single-example requests into few large dispatches:
+
+    client threads --submit()--> AdmissionController (bounded queue,
+        deadlines, shedding)  --take()--> worker thread: coalesce the
+        oldest request's shape group, pad to the bucket grid
+        (BucketPolicy), ONE CachedOp dispatch per batch (ProgramCache),
+        scatter unpadded rows back to per-request futures.
+
+Contrast with :class:`~mxnet_tpu.predict.Predictor`: the Predictor is a
+blocking single-client executor that rebinds on shape change; the engine
+is thread-safe, batches across clients, and never compiles off the
+bucket grid — after ``warmup()`` the compile counter stays flat.
+
+Observability: every enqueue/coalesce/dispatch emits a Chrome-trace span
+through :mod:`mxnet_tpu.profiler` ('serve' lane) plus queue-depth and
+batch-occupancy counters; ``stats()`` returns a point-in-time snapshot
+including p50/p99 request latency.
+
+Env knobs (config.py): ``MXNET_SERVE_MAX_BATCH``,
+``MXNET_SERVE_MAX_QUEUE``, ``MXNET_SERVE_BATCH_TIMEOUT_MS``,
+``MXNET_SERVE_DEFAULT_DEADLINE_MS``, ``MXNET_SERVE_OVERLOAD_POLICY``,
+``MXNET_SERVE_SEQ_BUCKETS``.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import profiler
+from .admission import (AdmissionController, Request, EngineClosedError,
+                        _fail_future)
+from .buckets import BucketPolicy, ProgramCache
+
+__all__ = ["ServingEngine"]
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[k]
+
+
+class ServingEngine(object):
+    """Thread-safe batched-inference front end over one frozen graph.
+
+    Parameters
+    ----------
+    symbol, arg_params, aux_params : the frozen graph + trained weights
+        (same checkpoint artifacts ``Predictor`` consumes).
+    data_shapes : dict name -> per-EXAMPLE shape (no batch dim); the
+        reference signature requests are validated against.  With seq
+        bucketing, the axis named by the policy may vary per request.
+    policy : BucketPolicy, default built from the MXNET_SERVE_* env tier.
+    start : spawn the worker thread immediately (tests pass False to
+        stage requests against a stopped engine).
+    """
+
+    def __init__(self, symbol, arg_params, aux_params, data_shapes,
+                 ctx=None, policy=None, max_queue=None,
+                 batch_timeout_ms=None, default_deadline_ms=None,
+                 overload_policy=None, dtype=np.float32, start=True):
+        from .. import config
+        self._policy = policy or BucketPolicy.from_config()
+        if max_queue is None:
+            max_queue = config.get("MXNET_SERVE_MAX_QUEUE")
+        if batch_timeout_ms is None:
+            batch_timeout_ms = config.get("MXNET_SERVE_BATCH_TIMEOUT_MS")
+        if default_deadline_ms is None:
+            default_deadline_ms = config.get("MXNET_SERVE_DEFAULT_DEADLINE_MS")
+        if overload_policy is None:
+            overload_policy = config.get("MXNET_SERVE_OVERLOAD_POLICY")
+        self._window_s = float(batch_timeout_ms) / 1e3
+        self._default_deadline_s = float(default_deadline_ms) / 1e3
+        self._sym = symbol
+        self._data_shapes = {k: tuple(v) for k, v in dict(data_shapes).items()}
+        self._dtype = np.dtype(dtype)
+        self._adm = AdmissionController(max_queue=max_queue,
+                                        overload_policy=overload_policy,
+                                        wake_hint=self._policy.max_batch)
+        self._cache = ProgramCache(symbol, arg_params, aux_params,
+                                   list(self._data_shapes), ctx=ctx,
+                                   dtype=dtype)
+        self._lock = threading.Lock()
+        self._group_cache = {}   # exact input shapes -> validated group
+        self._lat_ms = collections.deque(maxlen=4096)
+        self._batches = 0
+        self._requests_served = 0
+        self._occupancy_sum = 0.0
+        self._warmup_batches = 0
+        self._worker = None
+        if start:
+            self.start()
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, data_shapes, **kwargs):
+        """Build from Module checkpoint artifacts
+        (``prefix-symbol.json`` + ``prefix-%04d.params``)."""
+        from ..model import load_checkpoint
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return cls(symbol, arg_params, aux_params, data_shapes, **kwargs)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        if self._adm.closed:
+            raise EngineClosedError(
+                "engine is closed; build a new ServingEngine")
+        if self._worker is None:
+            self._worker = threading.Thread(target=self._run,
+                                            name="mxnet-serve-worker",
+                                            daemon=True)
+            self._worker.start()
+        return self
+
+    def close(self, drain=True):
+        """Stop admitting; with ``drain`` finish queued work first.
+        Closing is PERMANENT (``start()`` afterwards raises — build a
+        new engine).  Draining waits for the worker as long as the
+        queue needs; the no-drain path fails pending futures and bounds
+        the wait.  The worker handle is only cleared once the thread is
+        actually dead."""
+        self._adm.close(drain=drain)
+        if self._worker is not None:
+            self._worker.join(timeout=None if drain else 60)
+            if not self._worker.is_alive():
+                self._worker = None
+        elif drain:
+            self._run()    # never started: drain on the caller's thread
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -------------------------------------------------------------- client
+    def _group_for(self, feeds):
+        """Validate one request's inputs and compute its coalescing key
+        (bucket-padded per-example shapes, name-sorted).  Memoized on
+        the exact input shapes — warm traffic repeats a handful of
+        shapes, so the hot submit path is one dict probe."""
+        try:
+            sig = tuple(sorted((k, v.shape) for k, v in feeds.items()))
+            hit = self._group_cache.get(sig)
+            if hit is not None:
+                return hit
+        except TypeError:
+            sig = None
+        if set(feeds) != set(self._data_shapes):
+            raise MXNetError("inputs %s do not match engine data inputs %s"
+                             % (sorted(feeds), sorted(self._data_shapes)))
+        group = []
+        for name in sorted(feeds):
+            x = feeds[name]
+            ref = self._data_shapes[name]
+            if x.ndim != len(ref):
+                raise MXNetError(
+                    "input %r: rank %d does not match reference %s "
+                    "(per-example shapes, no batch dim)"
+                    % (name, x.ndim, ref))
+            for ax, (got, want) in enumerate(zip(x.shape, ref)):
+                if ax == self._policy.seq_axis:
+                    continue
+                if got != want:
+                    raise MXNetError(
+                        "input %r: axis %d is %d, engine serves %d "
+                        "(only the seq axis may vary per request)"
+                        % (name, ax, got, want))
+            padded = self._policy.example_shape(x.shape)
+            group.append((name, padded))
+        # With seq bucketing, outputs must be sliced back to exactly what
+        # the graph would produce at the UNPADDED input — inferred from
+        # the symbol, never guessed from axis sizes (an output axis that
+        # merely coincides with the pad length must not be cut).
+        out_rows = None
+        if self._policy.seq_axis is not None:
+            _, out_shapes, _ = self._sym.infer_shape(
+                **{k: (1,) + v.shape for k, v in feeds.items()})
+            out_rows = tuple(tuple(s[1:]) for s in out_shapes)
+        out = tuple(group), out_rows
+        if sig is not None:
+            self._group_cache[sig] = out
+        return out
+
+    def submit(self, value=None, deadline_ms=None, **feeds):
+        """Enqueue one request; returns a ``concurrent.futures.Future``
+        resolving to the per-request output array (list of arrays for
+        multi-output graphs).
+
+        Raises :class:`QueueFullError` immediately under backpressure;
+        the future fails with :class:`DeadlineExceededError` /
+        :class:`ServerOverloadError` for expiry / shedding.
+        """
+        if value is not None:
+            if len(self._data_shapes) != 1:
+                raise MXNetError("positional submit needs a single-input "
+                                 "graph; pass inputs by name")
+            if feeds:
+                raise MXNetError("pass the input either positionally or "
+                                 "by name, not both")
+            feeds = {next(iter(self._data_shapes)): value}
+        feeds = {k: np.asarray(v, dtype=self._dtype)
+                 for k, v in feeds.items()}
+        group, out_rows = self._group_for(feeds)
+        if deadline_ms is None and self._default_deadline_s > 0:
+            deadline_ms = self._default_deadline_s * 1e3
+        deadline = None if not deadline_ms else \
+            time.monotonic() + float(deadline_ms) / 1e3
+        fut = Future()
+        req = Request(feeds, group, fut, deadline=deadline,
+                      out_rows=out_rows)
+        if profiler.is_running():
+            with profiler.record_span("serve.enqueue", "serve"):
+                self._adm.admit(req)
+            profiler.counter("serve.queue_depth", len(self._adm))
+        else:
+            self._adm.admit(req)
+        return fut
+
+    def predict(self, value=None, timeout=None, deadline_ms=None, **feeds):
+        """Synchronous convenience wrapper around :meth:`submit`."""
+        return self.submit(value, deadline_ms=deadline_ms,
+                           **feeds).result(timeout=timeout)
+
+    # -------------------------------------------------------------- worker
+    def _run(self):
+        while True:
+            try:
+                reqs = self._adm.take(self._policy.max_batch,
+                                      self._window_s)
+            except Exception:              # defense: never lose the worker
+                continue
+            if reqs is None:
+                return                     # closed and drained
+            if not reqs:
+                continue
+            if profiler.is_running():
+                # true coalescing latency (oldest enqueue -> dispatch),
+                # NOT a span around the blocking take(), which would be
+                # dominated by idle queue-wait on a quiet engine
+                profiler.counter("serve.coalesce_ms",
+                                 (time.monotonic()
+                                  - reqs[0].t_enqueue) * 1e3)
+            try:
+                self._dispatch(reqs)
+            except Exception as e:         # fail the batch, keep serving
+                for r in reqs:
+                    if not r.future.done():
+                        _fail_future(r.future, e)
+
+    def _dispatch(self, reqs):
+        # claim every future up front: a claimed (RUNNING) future can no
+        # longer be cancel()ed out from under the scatter, and requests
+        # the client already cancelled drop out of the batch here
+        reqs = [r for r in reqs if r.future.set_running_or_notify_cancel()]
+        if not reqs:
+            return
+        n = len(reqs)
+        b = self._policy.batch_bucket(n)
+        group = dict(reqs[0].group)
+        feeds = {}
+        for name, ex_shape in group.items():
+            arr = np.zeros((b,) + ex_shape, dtype=self._dtype)
+            for i, r in enumerate(reqs):
+                x = r.inputs[name]
+                arr[(i,) + tuple(slice(0, d) for d in x.shape)] = x
+            feeds[name] = arr
+        with profiler.record_span("serve.dispatch[b=%d,n=%d]" % (b, n),
+                                  "serve"):
+            outs = self._cache.run(feeds)
+        now = time.monotonic()
+        # scatter first: unblock the waiting clients before doing any
+        # stats bookkeeping (closed-loop clients resubmit ~0.1 ms sooner)
+        for i, r in enumerate(reqs):
+            res = [self._unpad(o[i], r, j) for j, o in enumerate(outs)]
+            r.future.set_result(res if len(res) > 1 else res[0])
+        with self._lock:
+            self._batches += 1
+            self._requests_served += n
+            self._occupancy_sum += n / float(b)
+            for r in reqs:
+                self._lat_ms.append((now - r.t_enqueue) * 1e3)
+        if profiler.is_running():
+            profiler.counter("serve.batch_occupancy", n / float(b))
+
+    def _unpad(self, row, req, j):
+        """Slice output ``j``'s row back to the shape the graph infers
+        at the request's UNPADDED input (row-independent models).  An
+        output whose inferred shape is pad-invariant — even one whose
+        axis size coincides with the pad length — passes through."""
+        if req.out_rows is None:
+            return row
+        want = req.out_rows[j]
+        if row.shape == want:
+            return row
+        return row[tuple(slice(0, d) for d in want)]
+
+    # ------------------------------------------------------------- observe
+    def warmup(self):
+        """Compile every configured bucket program up front (one dummy
+        dispatch per batch-bucket × seq-bucket combination) so live
+        traffic never pays a trace.  Returns the compile count."""
+        seq_shapes = [self._data_shapes]
+        if self._policy.seq_axis is not None and self._policy.seq_buckets:
+            seq_shapes = []
+            for sb in self._policy.seq_buckets:
+                shapes = {}
+                for name, ex in self._data_shapes.items():
+                    s = list(ex)
+                    s[self._policy.seq_axis] = sb
+                    shapes[name] = tuple(s)
+                seq_shapes.append(shapes)
+        for shapes in seq_shapes:
+            for bb in self._policy.batch_buckets():
+                feeds = {name: np.zeros((bb,) + ex, dtype=self._dtype)
+                         for name, ex in shapes.items()}
+                with profiler.record_span(
+                        "serve.warmup[b=%d]" % bb, "serve"):
+                    self._cache.run(feeds)
+                with self._lock:
+                    self._warmup_batches += 1
+        return self.compile_count
+
+    @property
+    def compile_count(self):
+        return self._cache.compile_count
+
+    def stats(self):
+        """Point-in-time snapshot of engine health: admission counters,
+        dispatch/occupancy aggregates, program-cache state, and request
+        latency percentiles (ms) over the last ≤4096 completions."""
+        snap = self._adm.stats()
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            snap.update({
+                "batches": self._batches,
+                "warmup_batches": self._warmup_batches,
+                "requests_served": self._requests_served,
+                "batch_occupancy": (self._occupancy_sum / self._batches
+                                    if self._batches else 0.0),
+                "compile_count": self.compile_count,
+                "bucket_keys": len(self._cache.bucket_keys),
+                "max_batch": self._policy.max_batch,
+                "latency_ms": {
+                    "count": len(lat),
+                    "mean": float(np.mean(lat)) if lat else 0.0,
+                    "p50": _percentile(lat, 0.50),
+                    "p99": _percentile(lat, 0.99),
+                },
+            })
+        return snap

@@ -1,0 +1,169 @@
+"""Offered-load sweep for the serving engine (mxnet_tpu/serving).
+
+Compares two ways of serving the same request stream over one frozen
+MLP:
+
+- **serial**: the pre-serving baseline — a single-request
+  ``Predictor.forward()`` loop, one batch-1 program dispatch per
+  request;
+- **engine**: ``offered_batch`` closed-loop client threads against the
+  ``ServingEngine`` — requests coalesce into bucket-padded batches, one
+  program dispatch per batch.
+
+Reported per offered load: throughput (req/s) for both paths, speedup,
+mean batch occupancy, p50/p99 request latency, and the compile counter
+split into warmup compiles vs post-warmup retraces (the compile-once
+contract demands retraces == 0).
+
+  python perf/serve_bench.py                     # sweep 1,2,4,8
+  python perf/serve_bench.py --offered 8 --requests 2048
+  python perf/serve_bench.py --check-speedup 3   # exit 1 if batch-8
+                                                 # speedup < 3x
+
+A fast smoke variant runs in the tier-1 suite
+(tests/test_serving.py::test_serve_bench_smoke).
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(feature=512, hidden=1024, classes=10, seed=0):
+    import mxnet_tpu as mx
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.default_rng(seed)
+    params = {
+        "fc1_weight": mx.nd.array(
+            rng.standard_normal((hidden, feature)).astype(np.float32)),
+        "fc1_bias": mx.nd.zeros((hidden,)),
+        "fc2_weight": mx.nd.array(
+            rng.standard_normal((classes, hidden)).astype(np.float32)),
+        "fc2_bias": mx.nd.zeros((classes,)),
+    }
+    return net, params
+
+
+def run_bench(requests=512, offered_batch=8, feature=512, hidden=1024,
+              classes=10, batch_timeout_ms=2.0, repeats=3):
+    """One sweep point: serial Predictor loop vs engine at an offered
+    load of ``offered_batch`` concurrent closed-loop clients.
+
+    Both paths are timed ``repeats`` times over the same request stream
+    and the BEST (minimum) elapsed wins, timeit-style.  The rounds are
+    INTERLEAVED (serial, engine, serial, engine, …) so drift on a
+    shared machine — a noisy neighbor during one phase — hits both
+    paths instead of deciding the speedup gate.  The zero-retrace
+    contract is checked across ALL engine rounds."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    net, params = build_model(feature, hidden, classes)
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((requests, feature)).astype(np.float32)
+
+    # serial baseline: one batch-1 dispatch per request
+    pred = mx.predict.Predictor(net, params, {}, {"data": (1, feature)},
+                                ctx=mx.cpu())
+    for i in range(min(8, requests)):                       # warm the jit
+        pred.forward(data=X[i][None]).get_output(0)
+    # engine under offered load
+    eng = serving.ServingEngine(net, params, {}, {"data": (feature,)},
+                                ctx=mx.cpu(),
+                                batch_timeout_ms=batch_timeout_ms)
+    warm_compiles = eng.warmup()
+
+    def engine_round():
+        results = [None] * requests
+
+        def client(tid):
+            for i in range(tid, requests, offered_batch):
+                results[i] = eng.predict(X[i], timeout=120)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(offered_batch)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        assert all(r is not None for r in results)
+        return dt
+
+    serial_s = engine_s = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for i in range(requests):
+            pred.forward(data=X[i][None]).get_output(0)
+        serial_s = min(serial_s, time.perf_counter() - t0)
+        engine_s = min(engine_s, engine_round())
+    stats = eng.stats()
+    retraces = eng.compile_count - warm_compiles
+    eng.close()
+    return {
+        "offered_batch": offered_batch,
+        "requests": requests,
+        "serial_rps": round(requests / serial_s, 1),
+        "engine_rps": round(requests / engine_s, 1),
+        "speedup": round(serial_s / engine_s, 2),
+        "batch_occupancy": round(stats["batch_occupancy"], 3),
+        "batches": stats["batches"],
+        "p50_ms": round(stats["latency_ms"]["p50"], 2),
+        "p99_ms": round(stats["latency_ms"]["p99"], 2),
+        "warmup_compiles": warm_compiles,
+        "retraces": retraces,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=512)
+    ap.add_argument("--offered", type=int, action="append", default=[],
+                    help="offered load (concurrent clients); repeatable; "
+                         "default sweep 1,2,4,8")
+    ap.add_argument("--feature", type=int, default=512)
+    ap.add_argument("--hidden", type=int, default=1024)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--window-ms", type=float, default=2.0)
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="time each path this many times, best wins")
+    ap.add_argument("--check-speedup", type=float, default=None,
+                    help="exit 1 unless the largest offered load's "
+                         "speedup is at least this factor")
+    args = ap.parse_args()
+
+    offered = args.offered or [1, 2, 4, 8]
+    rows = []
+    for ob in offered:
+        row = run_bench(requests=args.requests, offered_batch=ob,
+                        feature=args.feature, hidden=args.hidden,
+                        classes=args.classes,
+                        batch_timeout_ms=args.window_ms,
+                        repeats=args.repeats)
+        rows.append(row)
+        print(json.dumps(row))
+        if row["retraces"]:
+            print("FAIL: %d retraces after warmup" % row["retraces"])
+            sys.exit(1)
+    if args.check_speedup is not None:
+        final = rows[-1]["speedup"]
+        if final < args.check_speedup:
+            print("FAIL: speedup %.2fx < required %.2fx"
+                  % (final, args.check_speedup))
+            sys.exit(1)
+        print("OK: speedup %.2fx >= %.2fx" % (final, args.check_speedup))
+
+
+if __name__ == "__main__":
+    main()

@@ -4,6 +4,7 @@ Reference: include/mxnet/c_predict_api.h contract — build from checkpoint
 artifacts, set input, forward, get output; partial outputs; reshape.
 """
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 
@@ -60,3 +61,62 @@ def test_predictor_reshape(tmp_path):
     assert out.shape == (16, 3)
     ref = pred.forward(data=X[:8]).get_output(0)
     np.testing.assert_allclose(out[:8], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_reshape_no_param_reupload(tmp_path):
+    """Regression: reshape must reuse the device-resident params of the
+    bound executor — the SAME NDArray objects backed by the SAME jax
+    buffers, with no host→device re-upload and no as_in_context walk
+    (predict.py reshape fast path)."""
+    X, _, _ = _train_and_checkpoint(tmp_path)
+    pred = mx.predict.load_checkpoint_predictor(
+        str(tmp_path / "m"), 20, {"data": (8, 6)}, ctx=mx.cpu())
+    import jax
+    puts = []
+    orig_put = jax.device_put
+
+    def counting_put(x, *a, **kw):
+        puts.append(getattr(x, "shape", None))
+        return orig_put(x, *a, **kw)
+
+    jax.device_put = counting_put
+    try:
+        big = pred.reshape({"data": (16, 6)})
+    finally:
+        jax.device_put = orig_put
+    param_names = [n for n in pred._sym.list_arguments()
+                   if n != "data" and not (n.endswith("_label")
+                                           or n == "label")]
+    assert param_names
+    for n in param_names:
+        # shared object AND shared device buffer: nothing was copied
+        assert big._exec.arg_dict[n] is pred._exec.arg_dict[n]
+        assert big._exec.arg_dict[n]._data is pred._exec.arg_dict[n]._data
+    for n, arr in pred._exec.aux_dict.items():
+        assert big._exec.aux_dict[n]._data is arr._data
+    # no param-sized host array crossed to the device during reshape
+    param_shapes = {tuple(pred._exec.arg_dict[n].shape)
+                    for n in param_names}
+    assert not [s for s in puts if s in param_shapes]
+    # and the reshaped predictor still computes the same function
+    out = big.forward(data=X[:16]).get_output(0)
+    ref = pred.forward(data=X[:8]).get_output(0)
+    np.testing.assert_allclose(out[:8], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_get_outputs(tmp_path):
+    X, _, _ = _train_and_checkpoint(tmp_path)
+    pred = mx.predict.load_checkpoint_predictor(
+        str(tmp_path / "m"), 20, {"data": (4, 6)}, ctx=mx.cpu(),
+        output_names=["relu1_output", "softmax_output"])
+    with pytest.raises(mx.MXNetError):
+        pred.get_outputs()                       # before forward
+    pred.forward(data=X[:4])
+    outs = pred.get_outputs()
+    assert isinstance(outs, list) and len(outs) == 2
+    np.testing.assert_array_equal(outs[0], pred.get_output(0))
+    np.testing.assert_array_equal(outs[1], pred.get_output(1))
+    # as_numpy=False hands back the device-resident NDArrays themselves
+    dev = pred.get_outputs(as_numpy=False)
+    assert all(d is o for d, o in zip(dev, pred._outputs))
+    np.testing.assert_array_equal(dev[1].asnumpy(), outs[1])

@@ -52,6 +52,65 @@ def test_profiler_off_records_nothing(tmp_path):
     assert json.load(open(path))["traceEvents"] == []
 
 
+def test_profiler_bounded_buffer_drops_oldest(tmp_path):
+    """Long serving runs keep the profiler on: the event buffer must be
+    a ring — newest events kept, evictions counted and reported in the
+    dump's otherData.dropped_events."""
+    profiler.clear()
+    profiler.set_max_events(8)
+    try:
+        profiler.profiler_set_config(filename=str(tmp_path / "b.json"))
+        profiler.profiler_set_state("run")
+        for i in range(20):
+            profiler.instant("e%d" % i)
+        profiler.profiler_set_state("stop")
+        assert profiler.dropped_events() == 12
+        doc = json.load(open(profiler.dump_profile()))
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert names == ["e%d" % i for i in range(12, 20)]
+        assert doc["otherData"]["dropped_events"] == 12
+        # finished dump resets both buffer and eviction counter
+        assert profiler.dropped_events() == 0
+    finally:
+        profiler.set_max_events(
+            mx.config.get("MXNET_PROFILER_MAX_EVENTS"))
+        profiler.clear()
+
+
+def test_profiler_shrink_counts_drops(tmp_path):
+    """Shrinking the buffer below its fill discards oldest events —
+    those must count toward dropped_events like ring evictions do."""
+    profiler.clear()
+    profiler.set_max_events(16)
+    try:
+        profiler.profiler_set_config(filename=str(tmp_path / "s.json"))
+        profiler.profiler_set_state("run")
+        for i in range(10):
+            profiler.instant("e%d" % i)
+        profiler.profiler_set_state("stop")
+        profiler.set_max_events(4)
+        assert profiler.dropped_events() == 6
+        doc = json.load(open(profiler.dump_profile()))
+        assert [e["name"] for e in doc["traceEvents"]] == \
+            ["e%d" % i for i in range(6, 10)]
+        assert doc["otherData"]["dropped_events"] == 6
+    finally:
+        profiler.set_max_events(
+            mx.config.get("MXNET_PROFILER_MAX_EVENTS"))
+        profiler.clear()
+
+
+def test_profiler_clear(tmp_path):
+    profiler.profiler_set_config(filename=str(tmp_path / "c.json"))
+    profiler.profiler_set_state("run")
+    profiler.instant("kept_then_cleared")
+    profiler.profiler_set_state("stop")
+    profiler.clear()
+    doc = json.load(open(profiler.dump_profile()))
+    assert doc["traceEvents"] == []
+    assert doc["otherData"]["dropped_events"] == 0
+
+
 def test_monitor_collects_stats():
     mon = mx.Monitor(interval=1, pattern=".*output")
     X = np.random.rand(8, 6).astype(np.float32)
