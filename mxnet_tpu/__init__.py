@@ -62,6 +62,7 @@ from . import monitor
 from .monitor import Monitor
 from . import rnn
 from . import rtc
+from . import analysis
 from . import predict
 from .predict import Predictor
 from . import serving

@@ -1,0 +1,86 @@
+"""mxnet_tpu.analysis — static analysis over the Symbol IR.
+
+A pass-based pre-compile layer (the TVM/Relay idea from PAPERS.md: a
+typed graph IR makes framework-level checking tractable *before*
+codegen) over the NNVM-style ``Symbol`` DAG.  The reference framework
+discovers graph problems only at bind/dispatch time, deep inside
+executor.py/cached_op.py; these passes find them up front, each finding
+pinned to a named node with a dataflow provenance trace.
+
+Pass families (``DEFAULT_PASSES`` order):
+
+- ``verify``  — IR well-formedness: cycles, dangling output edges,
+  duplicate argument names, registry/arity consistency, typed attr
+  schema validation (verifier.py);
+- ``shapes``  — shape/dtype abstract interpretation: the infer_shape
+  fixed point re-run as a diagnosing pass with per-node provenance
+  (shapes.py);
+- ``retrace`` — retrace-hazard linter + host-sync detector: unbucketed
+  dynamic dims, shape-literal attrs downstream of them, jit-cache-
+  busting attr values, host-callback ops in hot paths (retrace.py);
+- ``padding`` — padding-soundness: classifies the graph row-local vs
+  cross-position along serving's zero-padded axes (padding.py).
+
+Entry points::
+
+    report, ctx = analysis.analyze(sym, data_shapes={"data": (8, 6)})
+    report.raise_if_errors(strict=True)
+
+    # what serving runs at engine construction:
+    verdicts, report = analysis.check_serving_graph(
+        sym, {"data": (6,)}, policy)
+
+CLI: ``tools/graph_lint.py`` runs the suite on a saved symbol JSON or a
+named model-zoo graph (``--strict`` exits nonzero on any finding).
+Runtime wiring: ``ServingEngine``/``Predictor`` construction verifies by
+default — warn, or raise with ``MXNET_ANALYSIS_STRICT=1``.
+"""
+from .diagnostics import Severity, Diagnostic, Report, AnalysisError
+from .core import (AnalysisContext, AnalysisPass, analyze, register_pass,
+                   get_pass, list_passes, DEFAULT_PASSES)
+from .graph import GraphView, find_cycle
+from .verifier import VerifierPass
+from .shapes import ShapeDtypePass
+from .retrace import RetraceHazardPass
+from .padding import PaddingSoundnessPass, classify_padding
+
+__all__ = [
+    "Severity", "Diagnostic", "Report", "AnalysisError",
+    "AnalysisContext", "AnalysisPass", "analyze", "register_pass",
+    "get_pass", "list_passes", "DEFAULT_PASSES",
+    "GraphView", "find_cycle",
+    "VerifierPass", "ShapeDtypePass", "RetraceHazardPass",
+    "PaddingSoundnessPass", "classify_padding", "check_serving_graph",
+    "verify",
+]
+
+
+def verify(symbol):
+    """Run just the IR verifier; returns the Report."""
+    report, _ = analyze(symbol, passes=("verify",))
+    return report
+
+
+def check_serving_graph(symbol, data_shapes, policy, training=False):
+    """The engine-construction check: verify + shapes + padding over the
+    axes serving actually zero-pads.
+
+    ``data_shapes`` are per-EXAMPLE shapes (no batch dim), exactly what
+    ``ServingEngine`` receives; graph coordinates gain the batch axis at
+    0, so the padded axes are batch=0 and, when the policy seq-buckets,
+    ``policy.seq_axis + 1``.  Returns ({label: verdict}, Report) with
+    labels "batch" and "seq".
+    """
+    full = {}
+    for name, ex in data_shapes.items():
+        try:
+            ex = policy.example_shape(tuple(ex))
+        except Exception:
+            ex = tuple(ex)      # off-grid reference shape: analyze as-is
+        full[name] = (policy.max_batch,) + ex
+    pad_axes = {"batch": {name: 0 for name in data_shapes}}
+    if policy.seq_axis is not None and policy.seq_buckets:
+        pad_axes["seq"] = {name: policy.seq_axis + 1
+                           for name in data_shapes}
+    return classify_padding(symbol, full, pad_axes, training=training,
+                            policy=policy)

@@ -1,0 +1,128 @@
+"""Pass framework: context, registry, and the `analyze` driver.
+
+Modeled on TVM's pass infrastructure (PAPERS.md: Relay's compile-time
+checking over a typed graph IR): each pass is a named unit that reads a
+shared :class:`AnalysisContext` and appends :class:`Diagnostic`s.  The
+driver owns ordering and the structural gate — if the verifier finds the
+graph is not a DAG, later passes (which all assume topological order)
+are skipped rather than fed garbage.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .diagnostics import Diagnostic, Report, Severity
+from .graph import GraphView
+
+__all__ = ["AnalysisContext", "AnalysisPass", "register_pass", "get_pass",
+           "list_passes", "analyze", "DEFAULT_PASSES"]
+
+_PASSES = {}
+
+#: driver order: structural soundness first, then the abstract
+#: interpreter (whose shape environment the linters reuse), then lints.
+DEFAULT_PASSES = ("verify", "shapes", "retrace", "padding")
+
+
+class AnalysisContext(object):
+    """Everything a pass may read, plus cross-pass products.
+
+    ``data_shapes`` maps input-variable name -> shape tuple; entries may
+    contain 0/None for a dynamic (per-request varying) dim — the retrace
+    linter keys on those.  ``policy`` is an optional
+    :class:`~mxnet_tpu.serving.BucketPolicy` describing how serving
+    quantizes those dynamic dims.  ``pad_axes`` maps input name -> set of
+    graph-coordinate axes that serving zero-pads (batch axis and the
+    bucketed seq axis).  ``training`` selects which mode the abstract
+    interpretation models (BatchNorm batch-stats vs moving-stats, ...).
+    """
+
+    def __init__(self, symbol, data_shapes=None, dtypes=None, policy=None,
+                 pad_axes=None, training=False):
+        self.symbol = symbol
+        self.data_shapes = {k: (tuple(v) if v is not None else None)
+                            for k, v in (data_shapes or {}).items()}
+        self.dtypes = dict(dtypes or {})
+        self.policy = policy
+        self.pad_axes = pad_axes
+        self.training = training
+        self.view = None          # GraphView, set once certified acyclic
+        self.structural_ok = None # verifier verdict; gates later passes
+        # products of the shape/dtype abstract interpreter, keyed
+        # (id(node), out_idx) exactly like symbol._infer_graph
+        self.shapes = {}
+        self.node_dtypes = {}
+        # padding pass verdicts: axis label -> "row-local"|"cross-position"
+        self.pad_verdicts = {}
+
+    def ensure_view(self):
+        if self.view is None:
+            self.view = GraphView(self.symbol)
+        return self.view
+
+
+class AnalysisPass(object):
+    """Base class: subclasses set ``name`` and implement ``run``."""
+
+    name = None
+
+    def run(self, ctx, report):
+        raise NotImplementedError
+
+
+def register_pass(cls):
+    """Class decorator registering an AnalysisPass by its ``name``."""
+    if not cls.name:
+        raise MXNetError("analysis pass %r has no name" % cls)
+    _PASSES[cls.name] = cls
+    return cls
+
+
+def get_pass(name):
+    if name not in _PASSES:
+        raise MXNetError("unknown analysis pass %r (known: %s)"
+                         % (name, sorted(_PASSES)))
+    return _PASSES[name]
+
+
+def list_passes():
+    return sorted(_PASSES)
+
+
+def analyze(symbol, data_shapes=None, dtypes=None, policy=None,
+            pad_axes=None, training=False, passes=None):
+    """Run a pass pipeline over ``symbol``; returns (Report, ctx).
+
+    ``passes`` is an ordered iterable of pass names (default: the full
+    suite).  The verifier always runs first even when not requested —
+    every other pass assumes a certified DAG.
+    """
+    names = list(passes if passes is not None else DEFAULT_PASSES)
+    if "padding" in names and "shapes" not in names:
+        # the padding rules resolve axes/ranks from the shape
+        # environment; without it they degrade to blanket conservatism
+        names.insert(names.index("padding"), "shapes")
+    if "verify" not in names:
+        names.insert(0, "verify")
+    elif names[0] != "verify":
+        names.remove("verify")
+        names.insert(0, "verify")
+    ctx = AnalysisContext(symbol, data_shapes=data_shapes, dtypes=dtypes,
+                          policy=policy, pad_axes=pad_axes,
+                          training=training)
+    report = Report()
+    for name in names:
+        if name != "verify" and ctx.structural_ok is False:
+            break       # graph is not a DAG; nothing downstream is safe
+        p = get_pass(name)()        # unknown pass names DO raise
+        try:
+            p.run(ctx, report)
+        except Exception as e:      # a linter crash must never take down
+            #                         the construction path it guards —
+            #                         WARNING, not ERROR, so strict-mode
+            #                         construction still builds valid
+            #                         graphs (CI --strict still fails)
+            report.add(Diagnostic(
+                Severity.WARNING, name,
+                "analysis pass crashed: %r — please report; remaining "
+                "checks of this pass were skipped" % (e,)))
+    return report, ctx

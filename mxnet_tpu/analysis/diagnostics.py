@@ -1,0 +1,136 @@
+"""Diagnostics: typed findings with node-level provenance.
+
+The reference surfaces graph errors as a bare ``MXNetError`` thrown from
+deep inside bind/dispatch (c_api_symbolic.cc unwinds the C++ stack into
+one string); Relay/TVM instead attach a span to every IR node so a
+failing pass can say *where*.  Our Symbol nodes carry stable names
+(NameManager), which play the role of spans: every diagnostic pins the
+node it is about plus the input-variable path that feeds it, so "rank
+mismatch" becomes "rank mismatch at `fc1` flowing from `data` via
+`conv0`".
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["Severity", "Diagnostic", "Report", "AnalysisError"]
+
+
+class AnalysisError(MXNetError):
+    """Raised by ``Report.raise_if_errors`` in strict mode; the message
+    is the formatted report, so the failing node names survive into the
+    exception text."""
+
+
+class Severity(object):
+    ERROR = "error"       # graph is malformed / provably unsound
+    WARNING = "warning"   # likely-unintended behaviour (retrace storm,
+    #                       pad contamination, host sync in a hot path)
+    INFO = "info"         # observations (program-count estimates, ...)
+
+    _ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+
+class Diagnostic(object):
+    """One finding, pinned to a node.
+
+    ``provenance`` is a chain of node names from a graph input to the
+    node (producer path), so messages read as a dataflow trace rather
+    than a lone name.
+    """
+    __slots__ = ("severity", "pass_name", "node", "op", "message",
+                 "provenance")
+
+    def __init__(self, severity, pass_name, message, node=None, op=None,
+                 provenance=()):
+        self.severity = severity
+        self.pass_name = pass_name
+        self.message = message
+        self.node = node            # node name, or None for graph-level
+        self.op = op                # op name, or None for variables
+        self.provenance = tuple(provenance)
+
+    def __str__(self):
+        loc = ""
+        if self.node is not None:
+            loc = " @ %s" % self.node
+            if self.op:
+                loc += " (%s)" % self.op
+        via = ""
+        if self.provenance:
+            via = "  [%s]" % " -> ".join(self.provenance)
+        return "[%s] %s%s: %s%s" % (self.severity, self.pass_name, loc,
+                                    self.message, via)
+
+    def __repr__(self):
+        return "<Diagnostic %s>" % self
+
+
+class Report(object):
+    """Ordered collection of diagnostics from one or more passes."""
+
+    def __init__(self, diagnostics=()):
+        self.diagnostics = list(diagnostics)
+
+    # -- building ----------------------------------------------------------
+    def add(self, diag):
+        self.diagnostics.append(diag)
+        return diag
+
+    def extend(self, diags):
+        self.diagnostics.extend(diags)
+        return self
+
+    # -- querying ----------------------------------------------------------
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def by_severity(self, severity):
+        return [d for d in self.diagnostics if d.severity == severity]
+
+    @property
+    def errors(self):
+        return self.by_severity(Severity.ERROR)
+
+    @property
+    def warnings(self):
+        return self.by_severity(Severity.WARNING)
+
+    def by_pass(self, pass_name):
+        return [d for d in self.diagnostics if d.pass_name == pass_name]
+
+    @property
+    def ok(self):
+        """No errors (warnings/infos allowed)."""
+        return not self.errors
+
+    def clean(self, strict=False):
+        """Nothing to report at the chosen bar: strict counts warnings
+        as failures (the CLI ``--strict`` contract)."""
+        return not self.errors and not (strict and self.warnings)
+
+    # -- output ------------------------------------------------------------
+    def format(self, min_severity=Severity.INFO):
+        keep = Severity._ORDER[min_severity]
+        lines = [str(d) for d in sorted(
+            self.diagnostics, key=lambda d: Severity._ORDER[d.severity])
+            if Severity._ORDER[d.severity] <= keep]
+        if not lines:
+            return "graph analysis: clean"
+        head = "graph analysis: %d error(s), %d warning(s)" % (
+            len(self.errors), len(self.warnings))
+        return "\n".join([head] + ["  " + ln for ln in lines])
+
+    def __str__(self):
+        return self.format()
+
+    def raise_if_errors(self, strict=False):
+        """Raise :class:`AnalysisError` when the report fails the bar
+        (errors always; warnings too under ``strict``)."""
+        if not self.clean(strict=strict):
+            raise AnalysisError(self.format(
+                Severity.WARNING if strict else Severity.ERROR))
+        return self

@@ -50,6 +50,14 @@ class CachedOp:
         serving program cache exposes: warm traffic must not move it."""
         return self._trace_count
 
+    def lint(self, data_shapes=None, **kwargs):
+        """Run the static-analysis suite (mxnet_tpu.analysis) over this
+        op's symbol graph — the pre-compile view of what __call__ will
+        jit.  Returns the :class:`~mxnet_tpu.analysis.Report`."""
+        from .analysis import analyze
+        report, _ = analyze(self._sym, data_shapes=data_shapes, **kwargs)
+        return report
+
     def _key(self):
         import jax
         with self._key_lock:

@@ -125,6 +125,22 @@ VARIABLES = {v.name: v for v in [
          "shares programs; outputs are un-padded on the same axis "
          "(model must be row-independent along it).  Empty = off: "
          "every distinct example shape is its own bucket."),
+    _Var("MXNET_ANALYSIS_ON", bool, True,
+         "Run the static-analysis passes (mxnet_tpu.analysis) at "
+         "Predictor/ServingEngine construction: the IR verifier always, "
+         "plus the padding-soundness classifier for the engine's padded "
+         "axes.  Findings warn by default; see MXNET_ANALYSIS_STRICT."),
+    _Var("MXNET_ANALYSIS_STRICT", bool, False,
+         "Escalate construction-time analysis findings from warnings to "
+         "MXNetError: malformed graphs refuse to build, and a serving "
+         "graph classified cross-position along a padded axis refuses "
+         "the unsound bucketing instead of degrading it."),
+    _Var("MXNET_SERVE_PAD_CHECK", bool, False,
+         "Runtime padding-soundness probe (debug; doubles dispatch "
+         "cost): every serving batch is dispatched twice — zero pads "
+         "and sentinel-filled pads — and live output rows must match "
+         "bitwise, catching cross-position contamination the static "
+         "pass could not prove (serving/buckets.py run_pad_probe)."),
     _Var("MXNET_PROFILER_MAX_EVENTS", int, 1000000,
          "Bound on the in-memory profiler event buffer.  Beyond it the "
          "oldest events are dropped (and counted in the dump's "

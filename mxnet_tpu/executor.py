@@ -653,7 +653,15 @@ class Executor:
     def _simple_bind(symbol, ctx, grad_req, type_dict, shapes,
                      shared_exec=None):
         import jax.numpy as jnp
-        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
+        try:
+            arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
+        except MXNetError as e:
+            # the message already names the failing/blocked node
+            # (symbol._infer_shape_impl); point at the analysis CLI for
+            # the full dataflow trace instead of burying it here
+            raise MXNetError(
+                "simple_bind: %s  (tools/graph_lint.py --shapes ... "
+                "prints per-node provenance for this graph)" % e) from None
         type_kwargs = {k: v for k, v in (type_dict or {}).items()}
         arg_types, _, aux_types = symbol.infer_type(**type_kwargs)
         ctx = ctx or current_context()

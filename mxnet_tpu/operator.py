@@ -101,7 +101,7 @@ class _CustomOpDef(OpDef):
 
     def __init__(self):
         super().__init__("Custom", self._impl, params={}, nin=1, nout=1,
-                         mode_dependent=True)
+                         mode_dependent=True, host_sync=True)
 
     # arbitrary user kwargs ride through untouched (reference passes all
     # Custom kwargs as strings to the prop constructor)

@@ -41,7 +41,8 @@ class OpDef:
                  input_names=None, variable_inputs=False, stochastic=False,
                  mode_dependent=False, mutate_aux=None, fill_shapes=None,
                  num_visible_outputs=None, key_var_num_args=None,
-                 aux_inputs=(), sparse_aware=False, sparse_grad=None, doc=""):
+                 aux_inputs=(), sparse_aware=False, sparse_grad=None,
+                 host_sync=False, doc=""):
         self.name = name
         self.impl = impl
         self.params = params or {}
@@ -74,6 +75,11 @@ class OpDef:
         # it differentiates a zero probe added to the op's output instead and
         # hands the probe cotangent to "bwd" (see Executor._get_fwd_bwd).
         self.sparse_grad = sparse_grad or {}
+        # declares that the impl round-trips to host Python per dispatch
+        # (a pure_callback bridge like the Custom op): the analysis
+        # host-sync detector (analysis/retrace.py) trusts this flag and
+        # only falls back to impl-source scanning when it is unset
+        self.host_sync = host_sync
         self.doc = doc or (impl.__doc__ or "")
         self._jit_cache = {}
 

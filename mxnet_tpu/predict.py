@@ -12,14 +12,32 @@ format Module writes (`prefix-symbol.json` + `prefix-%04d.params`).
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 from .base import MXNetError
 from .context import cpu
+from . import config
 from . import ndarray as nd
 from . import symbol as sym
 
 __all__ = ["Predictor", "load_checkpoint_predictor"]
+
+
+def _verify_graph(symbol, what):
+    """Construction-time IR verification (mxnet_tpu.analysis): catch a
+    malformed graph here, with node provenance, instead of deep inside
+    bind/dispatch.  Warn by default; MXNET_ANALYSIS_STRICT=1 raises."""
+    if not config.get("MXNET_ANALYSIS_ON"):
+        return
+    from .analysis import verify, AnalysisError
+    report = verify(symbol)
+    if not report.ok:
+        if config.get("MXNET_ANALYSIS_STRICT"):
+            raise AnalysisError(report.format())
+        warnings.warn("%s: graph verification failed:\n%s"
+                      % (what, report.format()))
 
 
 def _label_like(names):
@@ -74,6 +92,7 @@ class Predictor(object):
             symbol = sym.Group([internals[n] for n in output_names])
         ctx = ctx or cpu()
         data_shapes = dict(data_shapes)
+        _verify_graph(symbol, "Predictor")
 
         arg_names = symbol.list_arguments()
         missing = [n for n in arg_names

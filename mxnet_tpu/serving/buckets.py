@@ -225,3 +225,30 @@ class ProgramCache(object):
             flat[pos] = feeds[n]        # jit commits host arrays itself
         outs = kernel(key, *flat)
         return [np.asarray(o) for o in outs[:self._n_out]]
+
+    def run_pad_probe(self, feeds, live_masks, sentinel=7.5):
+        """Runtime padding-soundness assert (MXNET_SERVE_PAD_CHECK) —
+        the dynamic complement of analysis/padding.py: dispatch the
+        batch twice, once as given (zero pads) and once with every pad
+        slot set to ``sentinel``.  A graph that is truly row-local
+        along the padded axes computes live outputs from live inputs
+        only, so the two runs must agree bitwise on live rows (same
+        compiled program, same live operands — no float slop); any
+        divergence is contamination.  Returns (base_outs, probed_outs);
+        the engine compares per-request live regions and raises.
+
+        ``live_masks`` maps input name -> bool ndarray (batch-padded
+        shape), True on live slots.  Both dispatches share one bucket
+        signature, so the probe never compiles extra programs.
+        """
+        base = self.run(feeds)
+        probed_feeds = {}
+        for name, arr in feeds.items():
+            mask = live_masks.get(name)
+            if mask is None:
+                probed_feeds[name] = arr
+            else:
+                probed_feeds[name] = np.where(
+                    mask, arr, np.asarray(sentinel, arr.dtype))
+        probed = self.run(probed_feeds)
+        return base, probed

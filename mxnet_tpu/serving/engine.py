@@ -31,6 +31,7 @@ from __future__ import annotations
 import collections
 import threading
 import time
+import warnings
 from concurrent.futures import Future
 
 import numpy as np
@@ -85,6 +86,15 @@ class ServingEngine(object):
         self._sym = symbol
         self._data_shapes = {k: tuple(v) for k, v in dict(data_shapes).items()}
         self._dtype = np.dtype(dtype)
+        # static pre-flight: IR verifier + padding-soundness over the
+        # axes this engine will zero-pad.  A cross-position graph gets
+        # its unsound bucketing REFUSED (strict) or de-fanged (warn +
+        # fall back to exact-shape dispatch) instead of silently
+        # returning contaminated values (ROADMAP padded-axis item).
+        self.analysis_report = None
+        self._pad_check = config.get("MXNET_SERVE_PAD_CHECK")
+        if config.get("MXNET_ANALYSIS_ON"):
+            self._preflight(symbol, config.get("MXNET_ANALYSIS_STRICT"))
         self._adm = AdmissionController(max_queue=max_queue,
                                         overload_policy=overload_policy,
                                         wake_hint=self._policy.max_batch)
@@ -101,6 +111,56 @@ class ServingEngine(object):
         self._worker = None
         if start:
             self.start()
+
+    def _preflight(self, symbol, strict):
+        """Construction-time static analysis (mxnet_tpu.analysis).
+
+        Verifier errors and cross-position verdicts raise under
+        ``MXNET_ANALYSIS_STRICT``; otherwise they warn, and the engine
+        degrades the affected bucketing to stay sound:
+
+        - cross-position along **seq**: seq buckets are dropped — each
+          exact length compiles its own program (correct, more traces);
+        - cross-position along **batch**: requests stop coalescing at
+          all (``max_batch=1``) — with positions mixing across the
+          batch axis, even unpadded batching would blend requests.
+        """
+        from ..analysis import check_serving_graph, AnalysisError
+        verdicts, report = check_serving_graph(
+            symbol, self._data_shapes, self._policy)
+        self.analysis_report = report
+        if report.errors:
+            if strict:
+                raise AnalysisError(report.format())
+            warnings.warn("ServingEngine: graph verification failed:\n%s"
+                          % report.format())
+        cross = [lb for lb, v in verdicts.items() if v == "cross-position"]
+        if not cross:
+            return
+        detail = "\n".join(
+            "  " + str(d) for d in report.warnings) or "  (see report)"
+        if strict:
+            raise AnalysisError(
+                "ServingEngine: graph is cross-position along padded "
+                "axis(es) %s — zero-pad slots would bleed into live "
+                "outputs:\n%s" % (cross, detail))
+        if "seq" in cross:
+            warnings.warn(
+                "ServingEngine: graph is cross-position along the "
+                "bucketed seq axis; disabling seq buckets (lengths "
+                "still vary per request, but each exact length now "
+                "compiles its own program):\n%s" % detail)
+            self._policy = BucketPolicy(
+                max_batch=self._policy.max_batch,
+                seq_axis=self._policy.seq_axis, seq_buckets=())
+        if "batch" in cross:
+            warnings.warn(
+                "ServingEngine: graph mixes positions across the BATCH "
+                "axis; disabling request coalescing (max_batch=1) so "
+                "requests cannot contaminate each other:\n%s" % detail)
+            self._policy = BucketPolicy(
+                max_batch=1, seq_axis=self._policy.seq_axis,
+                seq_buckets=self._policy.seq_buckets)
 
     @classmethod
     def from_checkpoint(cls, prefix, epoch, data_shapes, **kwargs):
@@ -278,7 +338,10 @@ class ServingEngine(object):
             feeds[name] = arr
         with profiler.record_span("serve.dispatch[b=%d,n=%d]" % (b, n),
                                   "serve"):
-            outs = self._cache.run(feeds)
+            if self._pad_check:
+                outs = self._pad_probe(feeds, reqs)
+            else:
+                outs = self._cache.run(feeds)
         now = time.monotonic()
         # scatter first: unblock the waiting clients before doing any
         # stats bookkeeping (closed-loop clients resubmit ~0.1 ms sooner)
@@ -293,6 +356,33 @@ class ServingEngine(object):
                 self._lat_ms.append((now - r.t_enqueue) * 1e3)
         if profiler.is_running():
             profiler.counter("serve.batch_occupancy", n / float(b))
+
+    def _pad_probe(self, feeds, reqs):
+        """MXNET_SERVE_PAD_CHECK: dispatch twice via the ProgramCache
+        probe hook and require bitwise-equal live regions (see
+        buckets.ProgramCache.run_pad_probe).  Debug knob — doubles
+        dispatch cost, compiles nothing extra."""
+        live_masks = {}
+        for name, arr in feeds.items():
+            mask = np.zeros(arr.shape, dtype=bool)
+            for i, r in enumerate(reqs):
+                x = r.inputs[name]
+                mask[(i,) + tuple(slice(0, d) for d in x.shape)] = True
+            live_masks[name] = mask
+        base, probed = self._cache.run_pad_probe(feeds, live_masks)
+        for j, (o0, o1) in enumerate(zip(base, probed)):
+            for i, r in enumerate(reqs):
+                a = self._unpad(o0[i], r, j)
+                bb = self._unpad(o1[i], r, j)
+                if not np.array_equal(a, bb, equal_nan=True):
+                    raise MXNetError(
+                        "padding contamination detected at runtime: "
+                        "output %d of request %d changed when pad "
+                        "slots were perturbed — the graph is "
+                        "cross-position along a padded axis.  Run "
+                        "`tools/graph_lint.py --passes padding` for "
+                        "the offending node" % (j, i))
+        return base
 
     def _unpad(self, row, req, j):
         """Slice output ``j``'s row back to the shape the graph infers

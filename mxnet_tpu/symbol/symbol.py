@@ -200,7 +200,22 @@ class Symbol:
         if not partial and any(s is None for s in arg_shapes + out_shapes):
             missing = [nm for nm, s in zip(self.list_arguments(), arg_shapes)
                        if s is None]
-            raise MXNetError("infer_shape: incomplete; unknown args %s" % missing)
+            # name the first node the fixed point could not get past —
+            # "which node failed" is the actionable half of the message
+            # (the analysis shape pass builds on the same provenance)
+            blocked = ""
+            for n in topo:
+                if n.op is None:
+                    continue
+                if all((id(n), i) in shapes for i in range(n.num_outputs())):
+                    continue
+                unknown = [inp.name for (inp, ix) in n.inputs
+                           if (id(inp), ix) not in shapes]
+                blocked = "; first blocked node %r (%s) waiting on " \
+                          "input(s) %s" % (n.name, n.op.name, unknown)
+                break
+            raise MXNetError("infer_shape: incomplete; unknown args %s%s"
+                             % (missing, blocked))
         return arg_shapes, out_shapes, aux_shapes
 
     def infer_type(self, *args, **kwargs):

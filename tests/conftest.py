@@ -24,6 +24,17 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # registered here (no pytest.ini): tier-1 filters on `-m 'not slow'`,
+    # and the graph-lint CI step tags its end-to-end analyzer sweeps
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1 runs")
+    config.addinivalue_line(
+        "markers",
+        "lint_graphs: CI step running tools/graph_lint.py --strict over "
+        "the model-zoo exemplar graphs")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     import mxnet_tpu as mx

@@ -1,0 +1,474 @@
+"""Static-analysis suite tests (mxnet_tpu/analysis + tools/graph_lint.py).
+
+No reference analog — the reference discovers graph problems at
+bind/dispatch time.  Coverage per the subsystem contract: each pass
+family (verifier, shape/dtype abstract interpretation, retrace-hazard,
+padding-soundness) must catch a seeded defect with a node-level
+provenance message, clean graphs must lint clean, and the CLI --strict
+exit codes must hold.
+"""
+import json
+import subprocess
+import sys
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import Severity
+from mxnet_tpu.serving import BucketPolicy
+from mxnet_tpu.symbol.symbol import SymNode, Symbol
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _findings(report, pass_name, severity=None):
+    out = report.by_pass(pass_name)
+    if severity is not None:
+        out = [d for d in out if d.severity == severity]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# verifier
+# ---------------------------------------------------------------------------
+
+def test_verifier_clean_graph():
+    report = analysis.verify(_mlp())
+    assert report.ok and not report.warnings
+
+
+def test_verifier_catches_cycle():
+    net = _mlp()
+    # seed a cycle: fc1's data input becomes the softmax head itself
+    head = net._outputs[0][0]
+    topo = [n for n in analysis.GraphView(net).topo]
+    fc1 = next(n for n in topo if n.name == "fc1")
+    fc1.inputs[0] = (head, 0)
+    report = analysis.verify(net)
+    errs = _findings(report, "verify", Severity.ERROR)
+    assert errs and "cycle" in errs[0].message
+    assert "fc1" in errs[0].message and "softmax" in errs[0].message
+    # structural failure gates the rest of the pipeline
+    full, ctx = analysis.analyze(net, data_shapes={"data": (2, 4)})
+    assert ctx.structural_ok is False
+    assert not full.by_pass("shapes")
+
+
+def test_verifier_catches_duplicate_argument_name():
+    a = mx.sym.Variable("x")
+    b = mx.sym.Variable("x")        # distinct node, same name
+    net = a + b
+    report = analysis.verify(net)
+    errs = _findings(report, "verify", Severity.ERROR)
+    assert errs and "duplicate argument name 'x'" in errs[0].message
+
+
+def test_verifier_catches_dangling_output_reference():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Activation(data, act_type="relu", name="act")
+    node = net._outputs[0][0]
+    node.inputs[0] = (node.inputs[0][0], 3)     # var has 1 output
+    report = analysis.verify(net)
+    errs = _findings(report, "verify", Severity.ERROR)
+    assert errs and "dangling" in errs[0].message
+    assert errs[0].node == "act" and errs[0].op == "Activation"
+
+
+def test_verifier_catches_arity_mismatch():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Activation(data, act_type="relu", name="act")
+    node = net._outputs[0][0]
+    node.inputs.append((mx.sym.Variable("extra")._outputs[0][0], 0))
+    report = analysis.verify(net)
+    errs = _findings(report, "verify", Severity.ERROR)
+    assert any("arity mismatch" in e.message for e in errs)
+
+
+def test_verifier_catches_attr_schema_violation():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Activation(data, act_type="relu", name="act")
+    node = net._outputs[0][0]
+    node.attrs["act_type"] = "warp_drive"       # not a valid choice
+    report = analysis.verify(net)
+    errs = _findings(report, "verify", Severity.ERROR)
+    assert errs and "attr schema" in errs[0].message
+    assert errs[0].node == "act"
+
+
+def test_verifier_catches_unregistered_op():
+    from mxnet_tpu.ops.registry import OpDef
+    rogue = OpDef("not_a_real_op", lambda attrs, x: x)
+    node = SymNode(rogue, "rogue0", {},
+                   [(mx.sym.Variable("data")._outputs[0][0], 0)])
+    report = analysis.verify(Symbol([(node, 0)]))
+    errs = _findings(report, "verify", Severity.ERROR)
+    assert errs and "not in the registry" in errs[0].message
+
+
+# ---------------------------------------------------------------------------
+# shape/dtype abstract interpretation
+# ---------------------------------------------------------------------------
+
+def test_shape_pass_provenance_on_rank_mismatch():
+    """The ISSUE exemplar: a conv feeding an op that rejects its rank —
+    the diagnostic must name the failing node, show the concrete input
+    shapes, and carry the dataflow path."""
+    x = mx.sym.Variable("data")
+    c = mx.sym.Convolution(x, kernel=(3, 3), num_filter=4, name="conv0")
+    f = mx.sym.FullyConnected(c, num_hidden=10, name="fc1")
+    bad = mx.sym.dot(f, f, name="bad_dot")      # (8,10)x(8,10): mismatch
+    report, _ = analysis.analyze(bad,
+                                 data_shapes={"data": (8, 3, 24, 24)})
+    errs = _findings(report, "shapes", Severity.ERROR)
+    assert len(errs) == 1
+    d = errs[0]
+    assert d.node == "bad_dot" and d.op == "dot"
+    assert "lhs=(8, 10)" in d.message           # concrete shapes shown
+    assert d.provenance[0] == "data" and "conv0" in d.provenance
+
+
+def test_shape_pass_clean_and_fills_context():
+    report, ctx = analysis.analyze(_mlp(), data_shapes={"data": (4, 6)})
+    assert report.ok
+    head = ctx.view.heads[0]
+    assert ctx.shapes[(id(head[0]), 0)] == (4, 3)
+
+
+def test_shape_pass_reports_first_blocked_node():
+    net = _mlp()
+    report, _ = analysis.analyze(net, data_shapes={})   # nothing known
+    blocked = _findings(report, "shapes", Severity.WARNING)
+    assert blocked and blocked[0].node == "fc1"
+    assert "data" in blocked[0].message
+
+
+def test_infer_shape_error_names_blocked_node():
+    """Satellite: Symbol.infer_shape itself now says WHICH node the
+    fixed point stalled on, not only the missing-args list."""
+    net = _mlp()
+    with pytest.raises(mx.MXNetError) as ei:
+        net.infer_shape()                       # no shapes at all
+    msg = str(ei.value)
+    assert "'fc1'" in msg and "FullyConnected" in msg
+    assert "data" in msg
+
+
+def test_shape_pass_dynamic_dim_abstraction_notes():
+    report, _ = analysis.analyze(_mlp(), data_shapes={"data": (0, 6)})
+    infos = [d for d in report.by_pass("shapes")
+             if d.severity == Severity.INFO]
+    assert any("abstracted" in d.message for d in infos)
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard linter + host-sync detector
+# ---------------------------------------------------------------------------
+
+def test_retrace_flags_unbucketed_dynamic_dim():
+    """A non-pow2 dynamic dim with no bucket policy = one compile per
+    distinct size under live traffic."""
+    report, _ = analysis.analyze(_mlp(), data_shapes={"data": (0, 6)})
+    warns = _findings(report, "retrace", Severity.WARNING)
+    assert warns and warns[0].node == "data"
+    assert "new XLA program" in warns[0].message
+
+
+def test_retrace_dynamic_dim_covered_by_buckets_is_quiet():
+    policy = BucketPolicy(max_batch=4, seq_axis=0, seq_buckets=(4, 8))
+    net = mx.sym.Activation(mx.sym.Variable("data"), act_type="tanh")
+    report, _ = analysis.analyze(net, data_shapes={"data": (2, 0, 3)},
+                                 policy=policy)
+    assert not _findings(report, "retrace", Severity.WARNING)
+    infos = _findings(report, "retrace", Severity.INFO)
+    assert any("program" in d.message for d in infos)
+
+
+def test_retrace_flags_shape_literal_downstream_of_dynamic_dim():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Reshape(data, shape=(4, 6), name="rigid")
+    report, _ = analysis.analyze(net, data_shapes={"data": (0, 24)})
+    warns = _findings(report, "retrace", Severity.WARNING)
+    assert any(d.node == "rigid" and "shape-literal" in d.message
+               for d in warns)
+    # wildcard reshape stays quiet
+    net2 = mx.sym.Reshape(data, shape=(-1, 6), name="poly")
+    report2, _ = analysis.analyze(net2, data_shapes={"data": (0, 24)})
+    assert not any(d.node == "poly" for d in
+                   _findings(report2, "retrace", Severity.WARNING))
+
+
+def test_retrace_flags_jit_cache_busting_attr():
+    net = mx.sym.Activation(mx.sym.Variable("data"), act_type="relu",
+                            name="act")
+    net._outputs[0][0].attrs["lookup"] = np.zeros((3,))
+    report, _ = analysis.analyze(net, data_shapes={"data": (2, 3)},
+                                 passes=("verify", "retrace"))
+    warns = _findings(report, "retrace", Severity.WARNING)
+    assert any("jit cache" in d.message and d.node == "act"
+               for d in warns)
+
+
+def test_host_sync_detector_flags_custom_op():
+    import mxnet_tpu.operator as op_mod
+
+    class Prop(op_mod.CustomOpProp):
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Op(op_mod.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0].asnumpy())
+            return Op()
+
+    op_mod.register("lint_probe_op")(Prop)
+    net = mx.sym.Custom(mx.sym.Variable("data"), op_type="lint_probe_op",
+                        name="hostcall")
+    report, _ = analysis.analyze(net, data_shapes={"data": (2, 3)},
+                                 passes=("verify", "retrace"))
+    warns = _findings(report, "retrace", Severity.WARNING)
+    assert any("host" in d.message.lower() and d.node == "hostcall"
+               for d in warns)
+
+
+# ---------------------------------------------------------------------------
+# padding-soundness
+# ---------------------------------------------------------------------------
+
+def test_padding_row_local_mlp():
+    verdicts, report = analysis.check_serving_graph(
+        _mlp(), {"data": (6,)}, BucketPolicy(max_batch=4))
+    assert verdicts == {"batch": "row-local"}
+    assert not report.warnings
+
+
+def test_padding_cross_position_softmax_over_batch():
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=0, name="sm0")
+    verdicts, report = analysis.check_serving_graph(
+        net, {"data": (6,)}, BucketPolicy(max_batch=4))
+    assert verdicts["batch"] == "cross-position"
+    warns = [d for d in report.warnings if d.node == "sm0"]
+    assert warns and "softmax" in warns[0].message
+    assert warns[0].provenance == ("data", "sm0")
+
+
+def test_padding_seq_axis_sum_absorbs_but_mean_mixes():
+    """Zero pads are absorbing for sum (exact — the engine's existing
+    unpad test relies on it) but not for mean."""
+    data = mx.sym.Variable("data")
+    policy = BucketPolicy(max_batch=2, seq_axis=0, seq_buckets=(4,))
+    ok = mx.sym.Group([mx.sym.sum(data, axis=1, name="pool"),
+                       mx.sym.Activation(data, act_type="tanh")])
+    verdicts, _ = analysis.check_serving_graph(ok, {"data": (4, 3)},
+                                               policy)
+    assert verdicts["seq"] == "row-local"
+    bad = mx.sym.mean(data, axis=1, name="avg")
+    verdicts, report = analysis.check_serving_graph(bad, {"data": (4, 3)},
+                                                    policy)
+    assert verdicts["seq"] == "cross-position"
+    assert any(d.node == "avg" for d in report.warnings)
+
+
+def test_padding_zero_chain_tracking():
+    """sigmoid(0) != 0, so a sum over the padded axis AFTER a sigmoid is
+    no longer absorbing — the zero bit must degrade along the chain."""
+    data = mx.sym.Variable("data")
+    policy = BucketPolicy(max_batch=2, seq_axis=0, seq_buckets=(4,))
+    net = mx.sym.sum(mx.sym.Activation(data, act_type="sigmoid"),
+                     axis=1, name="pool")
+    verdicts, report = analysis.check_serving_graph(net, {"data": (4, 3)},
+                                                    policy)
+    assert verdicts["seq"] == "cross-position"
+    assert any(d.node == "pool" and "no longer zero" in d.message
+               for d in report.warnings)
+    # relu keeps zeros -> still exact
+    net2 = mx.sym.sum(mx.sym.Activation(data, act_type="relu"),
+                      axis=1, name="pool")
+    verdicts2, _ = analysis.check_serving_graph(net2, {"data": (4, 3)},
+                                                policy)
+    assert verdicts2["seq"] == "row-local"
+
+
+def test_padding_unknown_op_is_conservative():
+    from mxnet_tpu.ops.registry import register
+
+    @register("_lint_mystery_op")
+    def _mystery(attrs, x):
+        return x
+
+    from mxnet_tpu.symbol.symbol import _create
+    net = _create("_lint_mystery_op", [mx.sym.Variable("data")],
+                  {}, name="mystery")
+    verdicts, report = analysis.check_serving_graph(
+        net, {"data": (6,)}, BucketPolicy(max_batch=2))
+    assert verdicts["batch"] == "cross-position"
+    assert any("no padding-soundness rule" in d.message
+               for d in report.warnings)
+
+
+def test_padding_training_batchnorm_mixes():
+    data = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(data, name="bn0")
+    policy = BucketPolicy(max_batch=4)
+    # inference: moving stats, row-local
+    v_inf, _ = analysis.check_serving_graph(net, {"data": (3, 5, 5)},
+                                            policy)
+    assert v_inf["batch"] == "row-local"
+    # training: batch statistics fold pad rows into every output
+    v_tr, report = analysis.check_serving_graph(
+        net, {"data": (3, 5, 5)}, policy, training=True)
+    assert v_tr["batch"] == "cross-position"
+    assert any(d.node == "bn0" for d in report.warnings)
+
+
+def test_padding_reorder_along_padded_axis():
+    net = mx.sym.reverse(mx.sym.Variable("data"), axis=(0,), name="flip")
+    verdicts, report = analysis.check_serving_graph(
+        net, {"data": (6,)}, BucketPolicy(max_batch=4))
+    assert verdicts["batch"] == "cross-position"
+    assert any(d.node == "flip" and "reorder" in d.message
+               for d in report.warnings)
+
+
+def test_model_zoo_exemplars_row_local():
+    from mxnet_tpu.models.lenet import get_lenet
+    from mxnet_tpu.models.resnet import get_resnet_symbol
+    pol = BucketPolicy(max_batch=4)
+    for net, shp in [(get_lenet(), (1, 28, 28)),
+                     (get_resnet_symbol(num_classes=10, num_layers=18,
+                                        image_shape=(3, 32, 32)),
+                      (3, 32, 32))]:
+        verdicts, report = analysis.check_serving_graph(
+            net, {"data": shp}, pol)
+        assert report.clean(strict=True), report.format()
+        assert verdicts["batch"] == "row-local"
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _run_lint(args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graph_lint.py")]
+        + args, capture_output=True, text=True, env=env, cwd=REPO)
+
+
+def test_cli_strict_clean_graph_exits_zero(tmp_path):
+    path = str(tmp_path / "mlp-symbol.json")
+    _mlp().save(path)
+    r = _run_lint([path, "--shapes", "data=8,6", "--strict"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "row-local" in r.stdout
+
+
+def test_cli_strict_flags_defect_nonzero(tmp_path):
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=0, name="sm0")
+    path = str(tmp_path / "bad-symbol.json")
+    net.save(path)
+    r = _run_lint([path, "--shapes", "data=8,6", "--strict"])
+    assert r.returncode == 1
+    assert "sm0" in r.stdout and "cross-position" in r.stdout
+    # non-strict: warnings alone do not fail the run
+    r2 = _run_lint([path, "--shapes", "data=8,6"])
+    assert r2.returncode == 0
+
+
+def test_cli_unknown_graph_exits_two():
+    r = _run_lint(["no_such_model_or_file"])
+    assert r.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+
+def test_padding_sequence_mask_value_controls_zero_bit():
+    """SequenceMask(value=0) restores the zero invariant on its axis
+    (sum-over-pads exact again); any other value destroys it."""
+    data = mx.sym.Variable("data")
+    slen = mx.sym.Variable("slen")
+    shapes = {"data": (2, 8, 3), "slen": (2,)}
+    spec = {"seq": {"data": 1}}
+    for value, want in [(0.0, "row-local"), (5.0, "cross-position")]:
+        m = mx.sym.SequenceMask(data, slen, use_sequence_length=True,
+                                value=value, axis=1, name="mask")
+        net = mx.sym.sum(m, axis=1, name="pool")
+        verdicts, _ = analysis.classify_padding(net, shapes, spec)
+        assert verdicts["seq"] == want, (value, verdicts)
+
+
+def test_padding_batch_dot_is_row_local_over_batch_axis():
+    """Attention-style batch_dot must NOT be mistaken for a contraction
+    of the batch axis (that misclassification would silently disable
+    request coalescing for every attention model)."""
+    q, k = mx.sym.Variable("q"), mx.sym.Variable("k")
+    att = mx.sym.batch_dot(q + 1.0, k + 1.0, name="scores")
+    shapes = {"q": (4, 5, 6), "k": (4, 6, 5)}
+    verdicts, report = analysis.classify_padding(
+        att, shapes, {"batch": {"q": 0, "k": 0}})
+    assert verdicts["batch"] == "row-local", report.format()
+    # contracting a padded (nonzero) axis still flags
+    verdicts2, _ = analysis.classify_padding(
+        att, shapes, {"seq": {"q": 2, "k": 1}})
+    assert verdicts2["seq"] == "cross-position"
+
+
+def test_padding_pass_alone_pulls_in_shape_environment():
+    """`--passes padding` (the invocation the runtime probe's error
+    message recommends) must resolve negative softmax axes — the shapes
+    pass is auto-inserted as its dependency."""
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=-1, name="sm")
+    _, ctx = analysis.analyze(net, data_shapes={"data": (4, 6)},
+                              pad_axes={"seq": {"data": 1}},
+                              passes=("verify", "padding"))
+    assert ctx.pad_verdicts["seq"] == "cross-position"
+
+
+def test_retrace_adjacent_dynamic_dim_not_masked_by_seq_coverage():
+    """A dynamic dim NEXT TO the bucketed seq axis must still warn
+    (coverage is exact, not seq_axis +/- 1)."""
+    policy = BucketPolicy(max_batch=2, seq_axis=0, seq_buckets=(8,))
+    net = mx.sym.Activation(mx.sym.Variable("data"), act_type="tanh")
+    report, _ = analysis.analyze(net, data_shapes={"data": (0, 0, 0)},
+                                 policy=policy)
+    warns = _findings(report, "retrace", Severity.WARNING)
+    assert len(warns) == 1 and "dim 2" in warns[0].message
+    # batch axis 0 and seq graph-axis 1 are covered by the grid
+    assert not any("dim 0" in d.message or "dim 1" in d.message
+                   for d in warns)
+
+
+def test_crashed_pass_degrades_to_warning(monkeypatch):
+    """An analyzer bug must never brick strict-mode construction of a
+    valid graph: crashes surface as warnings (CI --strict still fails),
+    not errors."""
+    from mxnet_tpu.analysis import ShapeDtypePass
+
+    def boom(self, ctx, report):
+        raise RuntimeError("kaput")
+
+    monkeypatch.setattr(ShapeDtypePass, "run", boom)
+    report, _ = analysis.analyze(_mlp(), data_shapes={"data": (2, 6)},
+                                 passes=("verify", "shapes"))
+    assert report.ok
+    assert any("crashed" in d.message for d in report.warnings)
+
+
+def test_cli_shape_parse_trailing_comma(tmp_path):
+    path = str(tmp_path / "mlp-symbol.json")
+    _mlp().save(path)
+    r = _run_lint([path, "--shapes", "data=8,6,", "--strict"])
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,57 @@
+"""CI lint step: every PR runs the full analyzer end-to-end.
+
+`tools/graph_lint.py --strict` sweeps the model-zoo exemplar graphs
+(symbolic models/ builders AND a gluon model_zoo block traced to a
+Symbol), so a regression anywhere in the pass pipeline — verifier,
+shape interpreter, retrace linter, padding classifier, CLI plumbing —
+fails the suite, not just a user's terminal.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "tools", "graph_lint.py")
+
+
+def _lint(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, LINT] + list(args),
+                          capture_output=True, text=True, env=env,
+                          cwd=REPO)
+
+
+@pytest.mark.lint_graphs
+def test_model_zoo_exemplars_lint_clean_strict():
+    """The acceptance bar: all exemplar graphs pass --strict (exit 0,
+    no errors, no warnings, batch-axis verdict row-local)."""
+    r = _lint("mlp", "lenet", "resnet18", "--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("row-local") >= 3
+    assert "cross-position" not in r.stdout
+
+
+@pytest.mark.lint_graphs
+def test_gluon_model_zoo_graph_lints_clean_strict():
+    """Gluon blocks compose symbolically; the traced resnet18_v1 graph
+    must lint clean too (exercises BatchNorm/Pooling/Flatten rules on
+    the gluon op mix)."""
+    r = _lint("resnet18_v1", "--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "row-local" in r.stdout
+
+
+@pytest.mark.lint_graphs
+def test_lint_step_catches_seeded_defect(tmp_path):
+    """The step must FAIL when the analyzer regresses: a graph with a
+    known defect (softmax over the batch axis) exits 1 under --strict
+    with the node named."""
+    import mxnet_tpu as mx
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=0, name="sm0")
+    path = str(tmp_path / "defect-symbol.json")
+    net.save(path)
+    r = _lint(path, "--shapes", "data=8,6", "--strict")
+    assert r.returncode == 1
+    assert "sm0" in r.stdout

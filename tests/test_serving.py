@@ -404,3 +404,102 @@ def test_serve_bench_smoke():
     assert res["requests"] == 48
     assert 0 < res["batch_occupancy"] <= 1.0
     assert res["p99_ms"] >= res["p50_ms"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# padding-soundness guards (analysis wiring + runtime probe)
+# ---------------------------------------------------------------------------
+
+def test_cross_position_batch_head_served_uncontaminated():
+    """Satellite regression (ROADMAP padded-axis item): a head that
+    normalizes over the BATCH axis.  Batch padding (and coalescing
+    itself) would blend requests; the construction-time padding pass
+    must catch it, warn, and degrade to per-request dispatch so every
+    answer still matches a batch-1 Predictor bitwise."""
+    import warnings as _w
+    data = mx.sym.Variable("data")
+    net = mx.sym.softmax(data, axis=0, name="sm_batch")
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((5, 6)).astype(np.float32)
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        eng = serving.ServingEngine(net, {}, {}, {"data": (6,)},
+                                    ctx=mx.cpu(), batch_timeout_ms=2.0,
+                                    start=False)
+    assert any("BATCH" in str(c.message) for c in caught)
+    assert eng._policy.max_batch == 1        # coalescing disabled
+    assert eng.analysis_report is not None
+    assert any(d.node == "sm_batch"
+               for d in eng.analysis_report.warnings)
+    futs = [eng.submit(X[i]) for i in range(len(X))]
+    eng.start()
+    outs = [f.result(timeout=30) for f in futs]
+    eng.close()
+    pred = mx.predict.Predictor(net, {}, {}, {"data": (1, 6)},
+                                ctx=mx.cpu())
+    for i in range(len(X)):
+        ref = pred.forward(data=X[i][None]).get_output(0)[0]
+        np.testing.assert_array_equal(outs[i], ref)
+
+
+def test_cross_position_seq_graph_refuses_bucket():
+    """softmax over the bucketed seq axis: the engine drops the seq
+    buckets (exact-length programs) instead of returning probabilities
+    scaled down by the zero pads' exp(0) mass."""
+    import warnings as _w
+    data = mx.sym.Variable("data")
+    net = mx.sym.softmax(data, axis=1, name="sm_seq")
+    policy = BucketPolicy(max_batch=2, seq_axis=0, seq_buckets=(4,))
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        eng = serving.ServingEngine(net, {}, {}, {"data": (4, 3)},
+                                    ctx=mx.cpu(), policy=policy,
+                                    batch_timeout_ms=2.0, start=False)
+    assert any("seq" in str(c.message) for c in caught)
+    assert eng._policy.seq_buckets == ()     # bucket refused
+    x = np.random.default_rng(8).standard_normal((3, 3)).astype(np.float32)
+    fut = eng.submit(x)                      # served at its exact length
+    eng.start()
+    out = fut.result(timeout=30)
+    eng.close()
+    pred = mx.predict.Predictor(net, {}, {}, {"data": (1, 3, 3)},
+                                ctx=mx.cpu())
+    ref = pred.forward(data=x[None]).get_output(0)[0]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_strict_mode_refuses_cross_position_engine(monkeypatch):
+    monkeypatch.setenv("MXNET_ANALYSIS_STRICT", "1")
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=0, name="sm0")
+    with pytest.raises(mx.MXNetError):
+        serving.ServingEngine(net, {}, {}, {"data": (6,)}, ctx=mx.cpu(),
+                              start=False)
+
+
+def test_runtime_pad_probe_catches_contamination(monkeypatch):
+    """MXNET_SERVE_PAD_CHECK (the runtime half of the padding-soundness
+    story): with the static pass off, the sentinel-pad probe must catch
+    a cross-position graph at dispatch time — and stay silent on a
+    row-local one."""
+    monkeypatch.setenv("MXNET_ANALYSIS_ON", "0")
+    monkeypatch.setenv("MXNET_SERVE_PAD_CHECK", "1")
+    bad = mx.sym.softmax(mx.sym.Variable("data"), axis=0, name="sm0")
+    eng = serving.ServingEngine(bad, {}, {}, {"data": (6,)}, ctx=mx.cpu(),
+                                batch_timeout_ms=2.0, start=False)
+    futs = [eng.submit(np.ones((6,), np.float32)) for _ in range(3)]
+    eng.start()
+    with pytest.raises(mx.MXNetError, match="contamination"):
+        futs[0].result(timeout=30)
+    eng.close(drain=False)
+
+    net, params = _mlp()
+    with _engine(net, params, {"data": (6,)}) as eng2:
+        out = eng2.predict(np.ones((6,), np.float32), timeout=30)
+    assert out.shape == (3,)
+
+
+def test_analysis_report_attached_to_clean_engine():
+    net, params = _mlp()
+    with _engine(net, params, {"data": (6,)}) as eng:
+        rep = eng.analysis_report
+        assert rep is not None and rep.ok and not rep.warnings

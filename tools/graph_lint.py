@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Graph linter: run the mxnet_tpu.analysis pass suite from the shell.
+
+No reference analog — the reference has no pre-compile analysis layer
+at all (errors surface at bind/dispatch).  This CLI runs the IR
+verifier, the shape/dtype abstract interpreter, the retrace-hazard
+linter, and the padding-soundness classifier over a serialized symbol
+JSON or a named model-zoo graph, and prints every finding with its
+node-level provenance.
+
+Usage:
+    # lint a checkpoint graph at a concrete input shape
+    python tools/graph_lint.py model-symbol.json \
+        --shapes data=8,3,224,224
+
+    # lint exemplar graphs by name (models/ + gluon model_zoo)
+    python tools/graph_lint.py mlp resnet18_v1 --strict
+
+    # serving-shaped question: is seq bucketing sound for this graph?
+    python tools/graph_lint.py model-symbol.json \
+        --shapes data=8,0,64 --seq-axis 1 --seq-buckets 32,64
+
+Dynamic dims are written as 0 (or '?') in --shapes; the retrace linter
+keys on them.  --strict exits nonzero on warnings too (CI bar: the
+model-zoo exemplars must lint clean — tests/test_graph_lint.py).
+
+Exit codes: 0 clean at the chosen bar, 1 findings, 2 could not load.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+if __package__ is None or __package__ == "":       # script invocation
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# model-zoo exemplars the CI lint step sweeps (name -> builder, shapes)
+_ZOO = {
+    "mlp": ("mxnet_tpu.models.lenet", "get_mlp", {"data": (8, 784)}),
+    "lenet": ("mxnet_tpu.models.lenet", "get_lenet",
+              {"data": (8, 1, 28, 28)}),
+    "resnet18": ("mxnet_tpu.models.resnet", "get_resnet_symbol",
+                 {"data": (4, 3, 32, 32)}),
+    "resnet50": ("mxnet_tpu.models.resnet", "get_resnet_symbol",
+                 {"data": (4, 3, 32, 32)}),
+}
+_ZOO_KWARGS = {
+    "resnet18": dict(num_classes=10, num_layers=18, image_shape=(3, 32, 32)),
+    "resnet50": dict(num_classes=10, num_layers=50, image_shape=(3, 32, 32)),
+}
+
+
+def _load_graph(spec):
+    """Resolve one positional arg: a symbol JSON path, a models/ name,
+    or a gluon model_zoo name.  Returns (symbol, default_shapes)."""
+    import importlib
+    if spec.endswith(".json") or os.path.sep in spec or \
+            os.path.exists(spec):
+        from mxnet_tpu import symbol as sym
+        return sym.load(spec), {}
+    if spec in _ZOO:
+        mod_name, fn_name, shapes = _ZOO[spec]
+        builder = getattr(importlib.import_module(mod_name), fn_name)
+        return builder(**_ZOO_KWARGS.get(spec, {})), dict(shapes)
+    # gluon model_zoo names (resnet18_v1, mobilenet1.0, ...): blocks
+    # compose symbolically, so feeding a Variable traces the Symbol
+    from mxnet_tpu import sym as _s
+    from mxnet_tpu.gluon.model_zoo import get_model
+    net = get_model(spec)
+    return net(_s.Variable("data")), {"data": (4, 3, 224, 224)}
+
+
+def _parse_shapes(entries):
+    shapes = {}
+    for e in entries or ():
+        if "=" not in e:
+            raise ValueError("--shapes entries look like name=1,3,224,224"
+                             " (got %r)" % e)
+        name, dims = e.split("=", 1)
+        # dynamic dims are spelled 0 or ?; empty segments (a trailing
+        # comma) are ignored rather than read as phantom dynamic dims
+        shape = tuple(0 if d.strip() == "?" else int(d)
+                      for d in dims.split(",") if d.strip())
+        shapes[name.strip()] = shape
+    return shapes
+
+
+def _build_policy(args):
+    if args.seq_axis is None and not args.seq_buckets:
+        if args.max_batch is None:
+            return None
+        from mxnet_tpu.serving import BucketPolicy
+        return BucketPolicy(max_batch=args.max_batch)
+    from mxnet_tpu.serving import BucketPolicy
+    buckets = tuple(int(b) for b in (args.seq_buckets or "").split(",")
+                    if b.strip())
+    return BucketPolicy(max_batch=args.max_batch or 8,
+                        seq_axis=args.seq_axis if buckets else None,
+                        seq_buckets=buckets)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="static analysis over Symbol graphs "
+                    "(mxnet_tpu.analysis)")
+    ap.add_argument("graphs", nargs="+",
+                    help="symbol JSON path(s) and/or model names: %s or "
+                         "any gluon model_zoo name" % sorted(_ZOO))
+    ap.add_argument("--shapes", action="append", metavar="NAME=D0,D1,..",
+                    help="input shapes; 0 or ? marks a dynamic dim "
+                         "(repeatable)")
+    ap.add_argument("--passes", default=None,
+                    help="comma list (default: verify,shapes,retrace,"
+                         "padding)")
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="declare the serving batch-bucket grid")
+    ap.add_argument("--seq-axis", type=int, default=None,
+                    help="graph axis the serving seq buckets pad")
+    ap.add_argument("--seq-buckets", default="",
+                    help="comma list of seq bucket sizes")
+    ap.add_argument("--training", action="store_true",
+                    help="analyze training mode (BatchNorm batch stats "
+                         "etc.); default is inference")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on warnings too, not just errors")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="print only graphs with findings")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mxnet_tpu import analysis
+
+    try:
+        cli_shapes = _parse_shapes(args.shapes)
+        policy = _build_policy(args)
+    except Exception as e:
+        print("graph_lint: %s" % e, file=sys.stderr)
+        return 2
+
+    passes = tuple(p.strip() for p in args.passes.split(",")
+                   if p.strip()) if args.passes else None
+    worst = 0
+    for spec in args.graphs:
+        try:
+            graph, shapes = _load_graph(spec)
+        except Exception as e:
+            print("graph_lint: cannot load %r: %s" % (spec, e),
+                  file=sys.stderr)
+            return 2
+        shapes.update(cli_shapes)
+        pad_axes = None
+        if policy is not None and policy.seq_axis is not None:
+            pad_axes = {"batch": {n: 0 for n in shapes},
+                        "seq": {n: policy.seq_axis for n in shapes}}
+        report, ctx = analysis.analyze(
+            graph, data_shapes=shapes, policy=policy, pad_axes=pad_axes,
+            training=args.training, passes=passes)
+        failed = not report.clean(strict=args.strict)
+        if failed or not args.quiet:
+            print("== %s ==" % spec)
+            print(report.format())
+            for label, verdict in sorted(ctx.pad_verdicts.items()):
+                print("  padded %s axis: %s" % (label, verdict))
+        if failed:
+            worst = 1
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())
