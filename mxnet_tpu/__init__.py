@@ -58,6 +58,7 @@ from . import model
 from . import callback
 from . import module
 from . import profiler
+from . import telemetry
 from . import monitor
 from .monitor import Monitor
 from . import rnn

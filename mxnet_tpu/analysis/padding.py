@@ -118,7 +118,7 @@ _SCALAR_ZERO = {
     "_maximum_scalar": lambda c: c <= 0, "_minimum_scalar": lambda c: c >= 0,
     "_hypot_scalar": lambda c: c == 0,
     "_equal_scalar": lambda c: c != 0, "_not_equal_scalar": lambda c: c == 0,
-    "_greater_scalar": lambda c: True,          # 0 > c is 0 when c >= 0
+    "_greater_scalar": lambda c: c >= 0,        # 0 > c is 0 when c >= 0
     "_lesser_scalar": lambda c: c <= 0,
 }
 

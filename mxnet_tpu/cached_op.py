@@ -13,6 +13,8 @@ CachedOp as a single opaque op.
 """
 from __future__ import annotations
 
+import contextlib
+
 from .base import MXNetError
 from . import autograd
 from . import random as _random
@@ -82,6 +84,8 @@ class CachedOp:
                 # Python side effect runs once per trace == once per
                 # compiled program (never on cached dispatches)
                 self._trace_count += 1
+                from .executor import _count_xla_trace
+                _count_xla_trace()
                 args = flat_inputs[:na]
                 aux = flat_inputs[na:]
                 outs, new_aux = g(args, aux, key, training)
@@ -109,13 +113,23 @@ class CachedOp:
         n_out = len(self._sym._outputs)
 
         recording = autograd.is_recording() and autograd.any_traced(ordered)
-        if recording:
-            import jax
-            flat, raw_vjp = jax.vjp(primal, *jax_ins)
-            vjp_fn = lambda cots, _v=raw_vjp: _v(tuple(cots))  # noqa: E731
-        else:
-            flat = primal(*jax_ins)
-            vjp_fn = None
+        from . import telemetry
+        # one contextvar probe on the common no-trace path: the span
+        # name formatting and contextmanager only exist under an
+        # active trace (near-zero-cost-when-disabled discipline)
+        tc = telemetry.current_trace()
+        span = (tc.span("CachedOp(%s)" % (self._sym.name or "graph"),
+                        "op")
+                if tc is not None and not tc.finished
+                else contextlib.nullcontext())
+        with span:
+            if recording:
+                import jax
+                flat, raw_vjp = jax.vjp(primal, *jax_ins)
+                vjp_fn = lambda cots, _v=raw_vjp: _v(tuple(cots))  # noqa: E731,E501
+            else:
+                flat = primal(*jax_ins)
+                vjp_fn = None
 
         ctx = ordered[0].context if ordered else None
         out_nds = [_wrap(o, ctx) for o in flat[:n_out]]

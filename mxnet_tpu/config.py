@@ -141,6 +141,33 @@ VARIABLES = {v.name: v for v in [
          "and sentinel-filled pads — and live output rows must match "
          "bitwise, catching cross-position contamination the static "
          "pass could not prove (serving/buckets.py run_pad_probe)."),
+    _Var("MXNET_TELEMETRY_ON", bool, True,
+         "Master switch for the runtime telemetry registry "
+         "(mxnet_tpu.telemetry): metrics counters/gauges/histograms and "
+         "request-scoped tracing across serving, executor, kvstore, and "
+         "the input pipeline.  Off = instrumented call sites hold no "
+         "instruments and make zero registry calls per request."),
+    _Var("MXNET_TELEMETRY_SNAPSHOT_SECS", float, 0.0,
+         "Interval for the periodic telemetry snapshot thread (0 = "
+         "off).  Every interval the current metrics snapshot is "
+         "written to MXNET_TELEMETRY_SNAPSHOT_PATH (atomic replace) or "
+         "stdout, in MXNET_TELEMETRY_SNAPSHOT_FORMAT."),
+    _Var("MXNET_TELEMETRY_SNAPSHOT_PATH", str, "",
+         "Destination file for periodic telemetry snapshots; empty "
+         "writes to stdout."),
+    _Var("MXNET_TELEMETRY_SNAPSHOT_FORMAT", str, "prom",
+         "Snapshot format: 'prom' (Prometheus text exposition) or "
+         "'json' (metrics + finished traces, the document "
+         "tools/telemetry_dump.py renders)."),
+    _Var("MXNET_TELEMETRY_TRACE_SAMPLE", int, 64,
+         "Request-tracing sample period for the serving engine: every "
+         "Nth request carries a TraceContext and yields a full span "
+         "tree (queue-wait/coalesce/pad/dispatch/unpad) retrievable by "
+         "trace id.  1 traces every request; 0 disables tracing."),
+    _Var("MXNET_TELEMETRY_TRACE_CAPACITY", int, 256,
+         "Bound on the in-process finished-trace store; beyond it the "
+         "oldest span trees are evicted (long serving runs must not "
+         "grow host memory without limit)."),
     _Var("MXNET_PROFILER_MAX_EVENTS", int, 1000000,
          "Bound on the in-memory profiler event buffer.  Beyond it the "
          "oldest events are dropped (and counted in the dump's "

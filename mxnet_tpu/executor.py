@@ -32,6 +32,37 @@ from .symbol.symbol import Symbol, _topo
 __all__ = ["Executor", "build_graph_fn"]
 
 
+_TM_CACHE = {}          # memoized instrument children (see telemetry.bound)
+
+
+def _count_xla_trace():
+    """Trace-time side effect shared by the executor's jitted programs
+    (same contract as CachedOp's counter: fires once per XLA compile,
+    never on cached dispatches)."""
+    from . import telemetry
+    if telemetry.enabled():
+        telemetry.bound(
+            _TM_CACHE, "xla_traces",
+            lambda: telemetry.counter(
+                "mxnet_xla_traces_total",
+                "XLA program traces (compiles) across the process's "
+                "jitted graph programs (CachedOp + Executor); cached "
+                "dispatches never move this")).inc()
+
+
+def _count_dispatch(kind):
+    """One executor graph dispatch (forward / forward_backward);
+    memoized child, no registry lock on the warm path."""
+    from . import telemetry
+    if telemetry.enabled():
+        telemetry.bound(
+            _TM_CACHE, ("dispatch", kind),
+            lambda: telemetry.counter(
+                "mxnet_executor_dispatch_total",
+                "Executor graph dispatches by kind",
+                labelnames=("kind",)).labels(kind=kind)).inc()
+
+
 def build_graph_fn(symbol, arg_names, aux_names):
     """Compile a Symbol DAG into a pure function
     ``fn(arg_vals, aux_vals, key, training) -> (outputs, new_aux)``.
@@ -332,7 +363,12 @@ class Executor:
         fn = self._fwd_jit.get(training)
         if fn is None:
             g = self._graph_fn
-            fn = jax.jit(lambda a, x, k: g(a, x, k, training))
+
+            def fwd(a, x, k):
+                _count_xla_trace()  # side effect: once per compile
+                return g(a, x, k, training)
+
+            fn = jax.jit(fwd)
             self._fwd_jit[training] = fn
         return fn
 
@@ -368,6 +404,7 @@ class Executor:
             mirror = config.get("MXNET_BACKWARD_DO_MIRROR")
 
             def fwd_bwd(arg_vals, aux_vals, key, head_grads, old_grads):
+                _count_xla_trace()  # side effect: once per compile
                 if cap_ids:
                     # trace-time shape probe: the consumer outputs' avals
                     # give each probe's shape/dtype
@@ -495,10 +532,13 @@ class Executor:
             self.outputs = _LazyOutputs(self)
             return self.outputs
         from . import profiler
-        with profiler.record_span("forward", "forward"):
-            outs, new_aux = self._get_fwd(False)(self._arg_vals(),
-                                                 self._aux_vals(),
-                                                 self._key())
+        from . import telemetry
+        _count_dispatch("forward")
+        with telemetry.maybe_span("executor.forward", "executor"):
+            with profiler.record_span("forward", "forward"):
+                outs, new_aux = self._get_fwd(False)(self._arg_vals(),
+                                                     self._aux_vals(),
+                                                     self._key())
         self._set_outputs(outs)
         self._pending_train_fwd = False
         return self.outputs
@@ -510,10 +550,13 @@ class Executor:
         if key is None:
             key = self._key()
         from . import profiler
+        from . import telemetry
+        _count_dispatch("forward_backward")
         fn = self._get_fwd_bwd(out_grads is not None)
         grad_names = self._grad_names
         old = tuple(self.grad_dict[n]._data for n in self._dense_grad_names)
-        with profiler.record_span("forward_backward", "backward"):
+        with telemetry.maybe_span("executor.forward_backward", "executor"), \
+                profiler.record_span("forward_backward", "backward"):
             if out_grads is None:
                 outs, new_aux, new_grads = fn(self._arg_vals(),
                                               self._aux_vals(), key, old)
@@ -549,6 +592,7 @@ class Executor:
     def _materialize_pending(self):
         if self._pending_train_fwd and not getattr(self, "_materialized", True):
             self._materialized = True
+            _count_dispatch("forward")  # lazy path is a real dispatch
             outs, new_aux = self._get_fwd(True)(self._arg_vals(),
                                                 self._aux_vals(),
                                                 self._pending_key)

@@ -10,6 +10,7 @@ serialization machinery; the batch lands on device once per step.
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from itertools import islice
@@ -18,6 +19,7 @@ import numpy as np
 
 from ... import ndarray as nd
 from .sampler import SequentialSampler, RandomSampler, BatchSampler, Sampler
+
 
 __all__ = ["DataLoader", "default_batchify_fn"]
 
@@ -70,9 +72,19 @@ class DataLoader(object):
         self._batchify_fn = batchify_fn
 
     def __iter__(self):
+        from ... import telemetry
+        # io.py's helper, so the shared mxnet_io_batch_latency_ms
+        # family/doc/buckets cannot diverge (labeled by class name)
+        from ...io import _observe_batch
+        rec = telemetry.enabled()
         if self._num_workers == 0:
             for batch in self._batch_sampler:
-                yield self._batchify_fn([self._dataset[idx] for idx in batch])
+                t0 = time.perf_counter() if rec else 0.0
+                out = self._batchify_fn(
+                    [self._dataset[idx] for idx in batch])
+                if rec:
+                    _observe_batch(self, t0)
+                yield out
             return
 
         def _load(b):
@@ -91,7 +103,14 @@ class DataLoader(object):
                 nxt = next(batches, None)
                 if nxt is not None:
                     window.append(pool.submit(_load, nxt))
-                yield f.result()
+                # consumer-visible latency: how long THIS thread stalls
+                # for the prefetched batch (0 when workers kept up) —
+                # the pipeline-bubble signal, not the worker decode time
+                t0 = time.perf_counter() if rec else 0.0
+                out = f.result()
+                if rec:
+                    _observe_batch(self, t0)
+                yield out
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -18,6 +18,7 @@ import os
 import struct
 import gzip
 import threading
+import time
 from collections import namedtuple, OrderedDict
 
 import numpy as np
@@ -25,6 +26,28 @@ import numpy as np
 from .base import MXNetError
 from .ndarray import NDArray, array
 from .ndarray.sparse import CSRNDArray, csr_matrix
+
+
+_BATCH_HIST = {}        # iterator label -> memoized histogram child
+
+
+def _observe_batch(iter_obj, t0):
+    """Record one produced batch's host latency against the telemetry
+    registry, labeled by iterator class (callers gate on
+    telemetry.enabled()).  Wrappers that delegate next() to an inner
+    iterator (MNISTIter/CSVIter) pin their own label on the inner via
+    ``_telemetry_label`` so traffic is attributed to the class the
+    user built."""
+    from . import telemetry
+    label = getattr(iter_obj, "_telemetry_label",
+                    None) or type(iter_obj).__name__
+    child = telemetry.bound(
+        _BATCH_HIST, label,
+        lambda: telemetry.histogram(
+            "mxnet_io_batch_latency_ms",
+            "host input-pipeline time to produce one batch, by iterator",
+            ("iter",)).labels(iter=label))
+    child.observe((time.perf_counter() - t0) * 1e3)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
            "PrefetchingIter", "NDArrayIter", "MNISTIter", "CSVIter",
@@ -95,9 +118,15 @@ class DataIter(object):
         pass
 
     def next(self):
+        from . import telemetry
+        rec = telemetry.enabled()
+        t0 = time.perf_counter() if rec else 0.0
         if self.iter_next():
-            return DataBatch(data=self.getdata(), label=self.getlabel(),
-                             pad=self.getpad(), index=self.getindex())
+            batch = DataBatch(data=self.getdata(), label=self.getlabel(),
+                              pad=self.getpad(), index=self.getindex())
+            if rec:
+                _observe_batch(self, t0)
+            return batch
         raise StopIteration
 
     def __next__(self):
@@ -152,6 +181,10 @@ class ResizeIter(DataIter):
         return True
 
     def next(self):
+        # NOT instrumented: iter_next() consumes the inner iterator's
+        # instrumented next(), which already records each batch once
+        # under the producing iterator's label — observing here too
+        # would double-count every batch in mxnet_io_batch_latency_ms
         if self.iter_next():
             return self.current_batch
         raise StopIteration
@@ -266,6 +299,10 @@ class PrefetchingIter(DataIter):
         return True
 
     def next(self):
+        # NOT instrumented: iter_next() consumes the inner iterator's
+        # instrumented next(), which already records each batch once
+        # under the producing iterator's label — observing here too
+        # would double-count every batch in mxnet_io_batch_latency_ms
         if self.iter_next():
             return self.current_batch
         raise StopIteration
@@ -384,9 +421,15 @@ class NDArrayIter(DataIter):
         return self.cursor < self.num_data
 
     def next(self):
+        from . import telemetry
+        rec = telemetry.enabled()
+        t0 = time.perf_counter() if rec else 0.0
         if self.iter_next():
-            return DataBatch(data=self.getdata(), label=self.getlabel(),
-                             pad=self.getpad(), index=None)
+            batch = DataBatch(data=self.getdata(), label=self.getlabel(),
+                              pad=self.getpad(), index=None)
+            if rec:
+                _observe_batch(self, t0)
+            return batch
         raise StopIteration
 
     def _getdata(self, data_source):
@@ -445,6 +488,7 @@ class MNISTIter(DataIter):
                 (self._images.shape[0],) + tuple(input_shape))
         self._inner = NDArrayIter(self._images, self._labels, batch_size,
                                   shuffle=False, last_batch_handle="discard")
+        self._inner._telemetry_label = type(self).__name__
 
     @staticmethod
     def _open(path):
@@ -502,6 +546,7 @@ class CSVIter(DataIter):
         self._inner = NDArrayIter(
             data, label, batch_size,
             last_batch_handle="pad" if round_batch else "discard")
+        self._inner._telemetry_label = type(self).__name__
 
     @property
     def provide_data(self):
@@ -580,6 +625,9 @@ class LibSVMIter(DataIter):
         return self._cursor + self.batch_size <= self._num
 
     def next(self):
+        from . import telemetry
+        rec = telemetry.enabled()
+        t0 = time.perf_counter() if rec else 0.0
         if not self.iter_next():
             raise StopIteration
         s, e = self._cursor, self._cursor + self.batch_size
@@ -589,7 +637,10 @@ class LibSVMIter(DataIter):
                            sub_indptr),
                           shape=(self.batch_size,) + self._data_shape)
         label = array(self._labels[s:e])
-        return DataBatch(data=[data], label=[label], pad=0)
+        batch = DataBatch(data=[data], label=[label], pad=0)
+        if rec:
+            _observe_batch(self, t0)
+        return batch
 
 
 def ImageRecordIter(**kwargs):

@@ -27,12 +27,67 @@ TPU-native redesign (SURVEY §2.3, §5 "Distributed communication backend"):
 from __future__ import annotations
 
 import pickle
+import time
 
 from .base import MXNetError, string_types
 from .ndarray import NDArray, zeros
 from . import optimizer as opt
 
 __all__ = ["KVStore", "create"]
+
+
+def _nd_bytes(v):
+    """Host-side payload size of one pushed/pulled value (row_sparse
+    counts its compressed nnz storage, the honest transfer size)."""
+    import numpy as _np
+    try:
+        if getattr(v, "stype", "default") == "row_sparse":
+            d = v._aux["data"]
+            i = v._aux["indices"]
+            return (int(d.size) * _np.dtype(d.dtype).itemsize
+                    + int(i.size) * _np.dtype(i.dtype).itemsize)
+        return int(v.size) * _np.dtype(v.dtype).itemsize
+    except Exception:
+        return 0
+
+
+_KV_INSTR = {}          # direction -> memoized (ops, bytes, payload, lat)
+
+
+def _kv_observe(direction, nkeys, nbytes, t0):
+    """Record one push/pull against the telemetry registry (callers
+    gate on telemetry.enabled() so the disabled path costs nothing;
+    children memoized per direction — no registry lock per op)."""
+    from . import telemetry
+
+    def _bind():
+        return (
+            telemetry.counter(
+                "mxnet_kvstore_ops_total",
+                "kvstore operations by direction", ("direction",))
+            .labels(direction=direction),
+            telemetry.counter(
+                "mxnet_kvstore_bytes_total",
+                "host payload bytes moved through the kvstore veneer "
+                "by direction", ("direction",))
+            .labels(direction=direction),
+            telemetry.histogram(
+                "mxnet_kvstore_payload_bytes",
+                "per-call payload size by direction", ("direction",),
+                buckets=telemetry.BYTES_BUCKETS)
+            .labels(direction=direction),
+            telemetry.histogram(
+                "mxnet_kvstore_latency_ms",
+                "kvstore call latency by direction", ("direction",))
+            .labels(direction=direction),
+        )
+
+    ops, nbytes_c, payload, lat = telemetry.bound(
+        _KV_INSTR, direction, _bind)
+    ops.inc(nkeys)
+    nbytes_c.inc(nbytes)
+    payload.observe(nbytes)
+    lat.observe((time.perf_counter() - t0) * 1e3)
 
 
 def _key_value(keys, vals):
@@ -149,6 +204,9 @@ class KVStore(object):
 
     def push(self, key, value, priority=0):
         from .ndarray.sparse import RowSparseNDArray
+        from . import telemetry
+        rec = telemetry.enabled()
+        t0 = time.perf_counter() if rec else 0.0
         keys, vals = _key_value(key, value)
         for k, vlist in zip(keys, vals):
             if k not in self._store:
@@ -198,6 +256,10 @@ class KVStore(object):
                 self._store[k] = merged.tostype(self._store[k].stype)
             else:
                 self._store[k]._data = merged._data
+        if rec:
+            _kv_observe("push", len(keys),
+                        sum(_nd_bytes(v) for vlist in vals for v in vlist),
+                        t0)
 
     def _reduce_global(self, key, merged):
         """Cross-process reduction hook — identity for single-process stores;
@@ -208,6 +270,9 @@ class KVStore(object):
 
     def pull(self, key, out=None, priority=0, row_ids=None):
         assert out is not None
+        from . import telemetry
+        rec = telemetry.enabled()
+        t0 = time.perf_counter() if rec else 0.0
         keys, outs = _key_value(key, out)
         for k, olist in zip(keys, outs):
             if k not in self._store:
@@ -215,6 +280,10 @@ class KVStore(object):
             src = self._store[k]
             for o in olist:
                 src.copyto(o)  # preserves o's (possibly sharded) placement
+        if rec:
+            _kv_observe("pull", len(keys),
+                        sum(_nd_bytes(o) for olist in outs for o in olist),
+                        t0)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only selected rows of a row_sparse value.  O(len(row_ids))

@@ -14,6 +14,8 @@ import numpy as np
 
 from .ndarray import NDArray
 
+_STAT_GAUGES = {}       # tensor name -> memoized gauge child
+
 
 class Monitor(object):
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
@@ -39,7 +41,25 @@ class Monitor(object):
             arr = arr.asnumpy()
         else:
             arr = np.asarray(arr)
-        self.queue.append((self.step, name, self.stat_func(arr)))
+        stat = self.stat_func(arr)
+        self.queue.append((self.step, name, stat))
+        # scalar stats also land on the telemetry registry (one gauge
+        # series per monitored tensor) so they are scrapeable alongside
+        # the serving/kvstore series instead of print-only
+        from . import telemetry
+        if telemetry.enabled():
+            try:
+                value = float(stat)
+            except (TypeError, ValueError):
+                pass        # non-scalar stat_func: log-only, as before
+            else:
+                telemetry.bound(
+                    _STAT_GAUGES, name,
+                    lambda: telemetry.gauge(
+                        "mxnet_monitor_tensor_stat",
+                        "latest Monitor stat_func value per monitored "
+                        "tensor", ("tensor",)).labels(tensor=name)
+                ).set(value)
 
     def install(self, exe):
         """Attach to an executor (ref Monitor.install)."""

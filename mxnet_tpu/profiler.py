@@ -159,6 +159,23 @@ class record_span:
         return False
 
 
+def add_span_event(name, cat, t0, t1, args=None):
+    """Append one already-measured complete ('X') event.  ``t0``/``t1``
+    are ``time.perf_counter()`` values — the clock this ring is
+    anchored to.  The bridge the telemetry span trees use to land
+    request spans (tagged with their trace_id arg) on the same
+    chrome://tracing timeline as the host regions."""
+    if _STATE["running"]:
+        evt = {"name": name, "cat": cat, "ph": "X",
+               "ts": (t0 - _T0) * 1e6, "dur": (t1 - t0) * 1e6,
+               "pid": os.getpid(),
+               "tid": threading.get_ident() & 0xffff}
+        if args:
+            evt["args"] = dict(args)
+        with _LOCK:
+            _append(evt)
+
+
 def instant(name, cat="marker"):
     """Instant event (counter markers, epoch boundaries)."""
     if _STATE["running"]:
@@ -184,12 +201,14 @@ def dump_profile(finished=True):
     with _LOCK:
         events = list(_EVENTS)
         dropped = _DROPPED
+        capacity = _EVENTS.maxlen
         if finished:
             _EVENTS.clear()
             _DROPPED = 0
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "otherData": {"framework": "mxnet_tpu",
-                         "dropped_events": dropped}}
+                         "dropped_events": dropped,
+                         "max_events": capacity}}
     with open(_STATE["filename"], "w") as f:
         json.dump(doc, f)
     return _STATE["filename"]
@@ -200,8 +219,14 @@ def dump(finished=True):
 
 
 def dumps():
+    """In-memory dump.  Carries the same self-describing metadata as
+    the file dump: a consumer can tell a truncated trace (ring
+    evictions) from a complete one without the file context."""
     with _LOCK:
-        return json.dumps({"traceEvents": list(_EVENTS)})
+        return json.dumps({"traceEvents": list(_EVENTS),
+                           "otherData": {"framework": "mxnet_tpu",
+                                         "dropped_events": _DROPPED,
+                                         "max_events": _EVENTS.maxlen}})
 
 
 def pause():

@@ -76,19 +76,22 @@ class Request(object):
     groups share a dispatched batch.  ``out_rows`` holds the per-example
     output shapes the graph infers at the UNPADDED input, which the
     engine slices dispatched rows back to (None when seq bucketing is
-    off).
+    off).  ``trace`` optionally carries a
+    :class:`~mxnet_tpu.telemetry.TraceContext` across the thread hop to
+    the worker (sampled requests yield a full span tree).
     """
     __slots__ = ("inputs", "group", "future", "t_enqueue", "deadline",
-                 "out_rows")
+                 "out_rows", "trace")
 
     def __init__(self, inputs, group, future, deadline=None,
-                 out_rows=None):
+                 out_rows=None, trace=None):
         self.inputs = inputs
         self.group = group
         self.future = future
         self.t_enqueue = time.monotonic()
         self.deadline = deadline            # absolute time.monotonic()
         self.out_rows = out_rows
+        self.trace = trace
 
     def expired(self, now=None):
         return self.deadline is not None and \
@@ -97,7 +100,7 @@ class Request(object):
 
 class AdmissionController(object):
     def __init__(self, max_queue=256, overload_policy="reject",
-                 sweep_interval=0.05, wake_hint=None):
+                 sweep_interval=0.05, wake_hint=None, telemetry=None):
         if overload_policy not in ("reject", "shed-oldest", "shed_oldest"):
             raise MXNetError("unknown overload policy %r "
                              "(use 'reject' or 'shed-oldest')"
@@ -120,12 +123,19 @@ class AdmissionController(object):
         self.rejected = 0
         self.shed = 0
         self.expired = 0
+        # optional telemetry bundle (engine._EngineTelemetry): the
+        # registry mirrors of the counters above plus the queue-depth
+        # gauge.  None when MXNET_TELEMETRY_ON=0 — the hot path then
+        # makes zero instrument calls.  Instrument locks are leaves, so
+        # updating them under _cond's lock cannot deadlock.
+        self._telemetry = telemetry
 
     # ------------------------------------------------------------- producer
     def admit(self, req):
         """Enqueue a request or apply the overload policy.  Thread-safe;
         called from client threads."""
         failures, reject = [], None
+        tm = self._telemetry
         with self._cond:
             if self._closed:
                 raise EngineClosedError("serving engine is closed")
@@ -134,22 +144,30 @@ class AdmissionController(object):
                 if self.overload_policy == "shed-oldest":
                     victim = self._queue.popleft()
                     self.shed += 1
-                    failures.append((victim.future, ServerOverloadError(
+                    if tm is not None:
+                        tm.shed.inc()
+                    failures.append((victim, ServerOverloadError(
                         "request shed after %.1f ms queued: queue full "
                         "(%d) under shed-oldest overload policy"
                         % ((time.monotonic() - victim.t_enqueue) * 1e3,
                            self.max_queue))))
                 else:
                     self.rejected += 1
+                    if tm is not None:
+                        tm.rejected.inc()
                     reject = QueueFullError(
                         "serving queue full (%d pending): backpressure"
                         % self.max_queue)
             if reject is None:
                 self._queue.append(req)
                 self.admitted += 1
+                if tm is not None:
+                    tm.admitted.inc()
                 if self._wake_hint is None or len(self._queue) == 1 \
                         or len(self._queue) >= self._wake_hint:
                     self._cond.notify()    # single consumer (the worker)
+            if tm is not None:
+                tm.queue_depth.set(len(self._queue))
         self._deliver(failures)
         if reject is not None:
             raise reject
@@ -198,6 +216,8 @@ class AdmissionController(object):
             else:
                 keep.append(r)
         self._queue = keep
+        if self._telemetry is not None:
+            self._telemetry.queue_depth.set(len(keep))
         return taken
 
     # -------------------------------------------------------------- expiry
@@ -215,19 +235,28 @@ class AdmissionController(object):
         for r in self._queue:
             if r.expired(now):
                 self.expired += 1
-                failures.append((r.future, DeadlineExceededError(
+                if self._telemetry is not None:
+                    self._telemetry.expired.inc()
+                failures.append((r, DeadlineExceededError(
                     "deadline exceeded after %.1f ms in queue"
                     % ((now - r.t_enqueue) * 1e3))))
             else:
                 live.append(r)
         self._queue = live
+        if failures and self._telemetry is not None:
+            self._telemetry.queue_depth.set(len(live))
         return failures
 
     @staticmethod
     def _deliver(failures):
-        """Fail futures OUTSIDE the condition lock (see _sweep_locked)."""
-        for fut, exc in failures:
-            _fail_future(fut, exc)
+        """Fail futures OUTSIDE the condition lock (see _sweep_locked).
+        ``failures`` holds (Request, exception) pairs so a sampled
+        trace on a failed request still gets finished (abort) instead
+        of silently vanishing from the trace store."""
+        for req, exc in failures:
+            _fail_future(req.future, exc)
+            if req.trace is not None:
+                req.trace.abort(type(exc).__name__)
 
     def sweep(self):
         """Expire overdue queued requests now (also runs automatically
@@ -246,8 +275,10 @@ class AdmissionController(object):
             if not drain:
                 while self._queue:
                     r = self._queue.popleft()
-                    failures.append((r.future, EngineClosedError(
+                    failures.append((r, EngineClosedError(
                         "engine closed before dispatch")))
+                if self._telemetry is not None:
+                    self._telemetry.queue_depth.set(0)
             self._cond.notify_all()
         self._deliver(failures)
 

@@ -160,6 +160,12 @@ class ProgramCache(object):
         self._plans = {}         # full data-shape key -> prefilled flat
         self._keys = set()       # bucket signatures dispatched so far
         self._lock = threading.Lock()
+        # plan-cache traffic counters: plain ints (only the single
+        # worker + pre-start warmup touch them), mirrored into the
+        # telemetry registry by the engine's collect callback and
+        # reported by ServingEngine.stats()
+        self.plan_hits = 0
+        self.plan_misses = 0
 
     # ------------------------------------------------------------------
     @property
@@ -202,7 +208,7 @@ class ProgramCache(object):
                     self._keys.add(shape_key)
         return plan
 
-    def run(self, feeds):
+    def run(self, feeds, _record=True):
         """Dispatch one padded batch: ``feeds`` maps data name -> host
         ndarray WITH batch dim, already padded to bucket shapes.
         Returns the outputs as host ndarrays (still batch-padded).
@@ -211,12 +217,20 @@ class ProgramCache(object):
         is frozen, so aux write-back and autograd bookkeeping are
         skipped, the non-data input slots come from the prebuilt
         device-resident template, and the whole non-data plumbing is a
-        cached per-signature plan (no lock, no rebuild on warm keys)."""
+        cached per-signature plan (no lock, no rebuild on warm keys).
+
+        ``_record=False`` skips the hit/miss counters — the pad probe's
+        second dispatch of the SAME logical batch must not make the
+        accounting read two dispatches."""
         shape_key = tuple(sorted((k, v.shape) for k, v in feeds.items()))
         plan = self._plans.get(shape_key)
         if plan is None:
+            if _record:
+                self.plan_misses += 1
             plan = self._plan_for(
                 shape_key, {k: tuple(v.shape) for k, v in feeds.items()})
+        elif _record:
+            self.plan_hits += 1
         template, kernel, key, data_pos = plan
         if key is None:
             key = self._op._key()       # stochastic graph: fresh draws
@@ -250,5 +264,5 @@ class ProgramCache(object):
             else:
                 probed_feeds[name] = np.where(
                     mask, arr, np.asarray(sentinel, arr.dtype))
-        probed = self.run(probed_feeds)
+        probed = self.run(probed_feeds, _record=False)
         return base, probed

@@ -19,7 +19,15 @@ bucket grid — after ``warmup()`` the compile counter stays flat.
 Observability: every enqueue/coalesce/dispatch emits a Chrome-trace span
 through :mod:`mxnet_tpu.profiler` ('serve' lane) plus queue-depth and
 batch-occupancy counters; ``stats()`` returns a point-in-time snapshot
-including p50/p99 request latency.
+including p50/p99 request latency.  With :mod:`mxnet_tpu.telemetry`
+enabled (``MXNET_TELEMETRY_ON``, default on) the engine additionally
+feeds the process-wide metrics registry (``mxnet_serve_*`` series:
+queue depth, shed/reject/expiry, occupancy, padding waste per bucket,
+program-cache hit/miss, retraces keyed by the retrace-linter's hazard
+fingerprints, shape-signature entropy) and samples every
+``MXNET_TELEMETRY_TRACE_SAMPLE``-th request into a full span tree
+(queue-wait -> coalesce -> pad -> dispatch -> unpad) retrievable by
+trace id via ``tools/telemetry_dump.py``.
 
 Env knobs (config.py): ``MXNET_SERVE_MAX_BATCH``,
 ``MXNET_SERVE_MAX_QUEUE``, ``MXNET_SERVE_BATCH_TIMEOUT_MS``,
@@ -29,15 +37,20 @@ Env knobs (config.py): ``MXNET_SERVE_MAX_BATCH``,
 from __future__ import annotations
 
 import collections
+import hashlib
+import itertools
+import math
 import threading
 import time
 import warnings
+import weakref
 from concurrent.futures import Future
 
 import numpy as np
 
 from ..base import MXNetError
 from .. import profiler
+from .. import telemetry as _telemetry
 from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
 from .buckets import BucketPolicy, ProgramCache
@@ -50,6 +63,176 @@ def _percentile(sorted_vals, q):
         return 0.0
     k = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
     return sorted_vals[k]
+
+
+# distinct shape signatures tracked as individual label values before
+# spilling into the catch-all "other" series (label cardinality bound)
+_MAX_SIG_LABELS = 64
+
+# per-process engine ordinal: the `engine` label on point-in-time
+# gauges, so co-resident engines get distinct series
+_ENGINE_SEQ = itertools.count()
+
+# unregistered sink for the submit-vs-close race: a counter nothing
+# scrapes, so a racing submit cannot resurrect removed series
+_NULL_COUNTER = _telemetry.Counter()
+
+
+class _EngineTelemetry(object):
+    """The engine's instrument bundle against the default telemetry
+    registry.  Built once per engine ONLY when telemetry is enabled —
+    with ``MXNET_TELEMETRY_ON=0`` the engine holds ``None`` and its hot
+    path performs zero instrument calls (tests assert this).
+
+    Families are shared process-wide (a second engine reuses them), so
+    counters aggregate across engines; point-in-time gauges (queue
+    depth, program-cache hits/misses, compile count, shape entropy)
+    carry an ``engine`` label so two live engines in one process
+    cannot clobber each other's series.
+    """
+
+    def __init__(self, engine):
+        reg = _telemetry.registry()
+        self.engine_label = str(next(_ENGINE_SEQ))
+        self.closed = False
+        self.requests = reg.counter(
+            "mxnet_serve_requests_total", "serving requests submitted")
+        self.queue_wait = reg.histogram(
+            "mxnet_serve_queue_wait_ms",
+            "enqueue -> worker-pop wait per request",
+            buckets=_telemetry.LATENCY_MS_BUCKETS)
+        self.latency = reg.histogram(
+            "mxnet_serve_request_latency_ms",
+            "enqueue -> result end-to-end request latency",
+            buckets=_telemetry.LATENCY_MS_BUCKETS)
+        self.batches = reg.counter(
+            "mxnet_serve_batches_total", "batches dispatched")
+        self.occupancy = reg.histogram(
+            "mxnet_serve_batch_occupancy",
+            "live requests / bucket size per dispatched batch",
+            buckets=_telemetry.RATIO_BUCKETS)
+        self.dispatch_ms = reg.histogram(
+            "mxnet_serve_dispatch_ms",
+            "compiled-program dispatch wall time per batch",
+            buckets=_telemetry.LATENCY_MS_BUCKETS)
+        self.pad_waste = reg.histogram(
+            "mxnet_serve_padding_waste_ratio",
+            "padded-but-dead input elements / total padded elements "
+            "per batch, by batch bucket",
+            labelnames=("bucket",), buckets=_telemetry.RATIO_BUCKETS)
+        self.padded_elems = reg.counter(
+            "mxnet_serve_padded_elements_total",
+            "total input elements dispatched (live + pad slots)",
+            labelnames=("bucket",))
+        self.live_elems = reg.counter(
+            "mxnet_serve_live_elements_total",
+            "live (request-backed) input elements dispatched",
+            labelnames=("bucket",))
+        self.compiles = reg.counter(
+            "mxnet_serve_compiles_total",
+            "XLA programs traced by this process's serving dispatches "
+            "(warmup + cold buckets + retraces)")
+        self.retraces = reg.counter(
+            "mxnet_serve_retraces_total",
+            "post-warmup XLA traces on serving dispatches — the "
+            "compile-once contract demands this stays 0; the hazards "
+            "label carries the retrace-linter fingerprints of the "
+            "graph's statically known hazards",
+            labelnames=("hazards",))
+        self.shape_seen = reg.counter(
+            "mxnet_serve_shape_signature_total",
+            "requests per observed (bucket-padded) input-shape "
+            "signature, per engine; drives the shape-entropy gauge",
+            labelnames=("engine", "sig"))
+        entropy_fam = reg.gauge(
+            "mxnet_serve_shape_entropy_bits",
+            "Shannon entropy (bits) of one engine's observed shape-"
+            "signature distribution — high entropy + retrace hazards "
+            "= the traffic most likely to trigger a retrace storm",
+            labelnames=("engine",))
+        self.entropy = entropy_fam.labels(engine=self.engine_label)
+        queue_depth_fam = reg.gauge(
+            "mxnet_serve_queue_depth",
+            "pending admission-queue depth per engine",
+            labelnames=("engine",))
+        self.queue_depth = queue_depth_fam.labels(
+            engine=self.engine_label)
+        self.admitted = reg.counter(
+            "mxnet_serve_admitted_total", "requests admitted")
+        self.rejected = reg.counter(
+            "mxnet_serve_rejected_total",
+            "requests rejected with QueueFullError backpressure")
+        self.shed = reg.counter(
+            "mxnet_serve_shed_total",
+            "requests shed under the shed-oldest overload policy")
+        self.expired = reg.counter(
+            "mxnet_serve_expired_total",
+            "requests expired past their deadline while queued")
+        cache_hits_fam = reg.gauge(
+            "mxnet_serve_program_cache_hits",
+            "dispatch-plan cache hits (warm bucket signatures) per "
+            "engine", labelnames=("engine",))
+        self.cache_hits = cache_hits_fam.labels(engine=self.engine_label)
+        cache_misses_fam = reg.gauge(
+            "mxnet_serve_program_cache_misses",
+            "dispatch-plan cache misses (first sight of a signature) "
+            "per engine", labelnames=("engine",))
+        self.cache_misses = cache_misses_fam.labels(
+            engine=self.engine_label)
+        compile_count_fam = reg.gauge(
+            "mxnet_serve_compile_count",
+            "CachedOp trace counter — programs compiled so far, per "
+            "engine", labelnames=("engine",))
+        self.compile_count = compile_count_fam.labels(
+            engine=self.engine_label)
+        self._engine_gauge_fams = (queue_depth_fam, cache_hits_fam,
+                                   cache_misses_fam, compile_count_fam,
+                                   entropy_fam)
+        # pre-touch the retrace series under this graph's hazard label
+        # so a healthy engine scrapes an explicit 0 (absence of the
+        # series would be indistinguishable from "not instrumented")
+        self.retraces.labels(hazards=engine._hazard_label)
+        self._engine = weakref.ref(engine)
+        reg.register_callback(self._refresh)
+
+    def close(self):
+        """Detach from the registry: an engine's bundle must not
+        outlive it (constructing engines in a loop would otherwise
+        leak one dead callback — and its per-engine series — per
+        engine into every future scrape)."""
+        self.closed = True      # before removal: see _sig_counter
+        _telemetry.registry().unregister_callback(self._refresh)
+        self._remove_engine_series()
+
+    def _remove_engine_series(self):
+        for fam in self._engine_gauge_fams:
+            fam.remove(engine=self.engine_label)
+        for values, _inst in self.shape_seen.series():
+            if values[0] == self.engine_label:
+                self.shape_seen.remove(*values)
+
+    def _refresh(self, reg):
+        """Collect-time callback: mirror engine-owned state into gauges
+        so every scrape is fresh without a sampler thread."""
+        eng = self._engine()
+        if eng is None:
+            # engine was GC'd without close(): self-evict, series too
+            reg.unregister_callback(self._refresh)
+            self._remove_engine_series()
+            return
+        self.cache_hits.set(eng._cache.plan_hits)
+        self.cache_misses.set(eng._cache.plan_misses)
+        self.compile_count.set(eng.compile_count)
+        # entropy over THIS engine's series only (sig children carry
+        # the engine label) — a co-resident engine's traffic must not
+        # contaminate the estimate
+        vals = [inst.value for values, inst in self.shape_seen.series()
+                if values[0] == self.engine_label]
+        total = sum(vals)
+        if total > 0:
+            ent = -sum((v / total) * math.log2(v / total)
+                       for v in vals if v > 0)
+            self.entropy.set(ent if ent else 0.0)   # never -0.0
 
 
 class ServingEngine(object):
@@ -92,12 +275,27 @@ class ServingEngine(object):
         # fall back to exact-shape dispatch) instead of silently
         # returning contaminated values (ROADMAP padded-axis item).
         self.analysis_report = None
+        self._hazard_label = "none"
+        self.hazard_fingerprints = {}
         self._pad_check = config.get("MXNET_SERVE_PAD_CHECK")
         if config.get("MXNET_ANALYSIS_ON"):
             self._preflight(symbol, config.get("MXNET_ANALYSIS_STRICT"))
+        # telemetry bundle: None when disabled — every instrumented
+        # branch below gates on that, keeping the disabled hot path at
+        # zero registry calls per request
+        self._tm = _EngineTelemetry(self) if _telemetry.enabled() else None
+        self._trace_sample = (_telemetry.trace_sample_every()
+                              if self._tm is not None else 0)
+        self._req_seq = itertools.count()
+        self._sig_labels = {}        # group key -> shape-sig counter child
+        self._sig_other = None       # shared catch-all child past the cap
+        self._sig_lock = threading.Lock()   # guards creation + the cap
+        self._dispatched_keys = set()
+        self._retraces = 0
         self._adm = AdmissionController(max_queue=max_queue,
                                         overload_policy=overload_policy,
-                                        wake_hint=self._policy.max_batch)
+                                        wake_hint=self._policy.max_batch,
+                                        telemetry=self._tm)
         self._cache = ProgramCache(symbol, arg_params, aux_params,
                                    list(self._data_shapes), ctx=ctx,
                                    dtype=dtype)
@@ -129,6 +327,20 @@ class ServingEngine(object):
         verdicts, report = check_serving_graph(
             symbol, self._data_shapes, self._policy)
         self.analysis_report = report
+        # fingerprint the retrace-linter's hazard findings: runtime
+        # retrace events are counted under these labels, tying an
+        # observed compile storm back to the static warning that
+        # predicted it (ROADMAP: rank hazards by observed traffic)
+        for d in report.warnings:
+            if d.pass_name != "retrace":
+                continue
+            fp = hashlib.sha1(
+                ("%s|%s|%s" % (d.node, d.op, d.message.split(":")[0]))
+                .encode()).hexdigest()[:8]
+            self.hazard_fingerprints.setdefault(fp, str(d))
+        if self.hazard_fingerprints:
+            self._hazard_label = ",".join(
+                sorted(self.hazard_fingerprints)[:4])
         if report.errors:
             if strict:
                 raise AnalysisError(report.format())
@@ -196,6 +408,8 @@ class ServingEngine(object):
                 self._worker = None
         elif drain:
             self._run()    # never started: drain on the caller's thread
+        if self._tm is not None:
+            self._tm.close()
 
     def __enter__(self):
         return self
@@ -270,6 +484,12 @@ class ServingEngine(object):
                 raise MXNetError("pass the input either positionally or "
                                  "by name, not both")
             feeds = {next(iter(self._data_shapes)): value}
+        # fail fast pre-instrumentation: a submit against a closed
+        # engine must not touch the registry — close() already removed
+        # this engine's per-engine series, and re-creating one here
+        # (new shape signature) would orphan it in every future scrape
+        if self._adm.closed:
+            raise EngineClosedError("serving engine is closed")
         feeds = {k: np.asarray(v, dtype=self._dtype)
                  for k, v in feeds.items()}
         group, out_rows = self._group_for(feeds)
@@ -278,15 +498,59 @@ class ServingEngine(object):
         deadline = None if not deadline_ms else \
             time.monotonic() + float(deadline_ms) / 1e3
         fut = Future()
+        trace = None
+        if self._tm is not None:
+            self._tm.requests.inc()
+            self._sig_counter(group).inc()
+            if self._trace_sample and \
+                    next(self._req_seq) % self._trace_sample == 0:
+                trace = _telemetry.TraceContext("serve.request", "serve")
         req = Request(feeds, group, fut, deadline=deadline,
-                      out_rows=out_rows)
-        if profiler.is_running():
-            with profiler.record_span("serve.enqueue", "serve"):
+                      out_rows=out_rows, trace=trace)
+        try:
+            if profiler.is_running():
+                with profiler.record_span("serve.enqueue", "serve"):
+                    self._adm.admit(req)
+                profiler.counter("serve.queue_depth", len(self._adm))
+            else:
                 self._adm.admit(req)
-            profiler.counter("serve.queue_depth", len(self._adm))
-        else:
-            self._adm.admit(req)
+        except Exception as e:
+            if trace is not None:     # rejected at the door: still record
+                trace.abort(type(e).__name__)
+            raise
         return fut
+
+    def _sig_counter(self, group):
+        """Shape-signature counter child for one coalescing key,
+        memoized; past _MAX_SIG_LABELS distinct signatures traffic
+        lands on the catch-all 'other' series (bounded cardinality:
+        the point is an entropy estimate, not an exact census)."""
+        child = self._sig_labels.get(group)
+        if child is not None:
+            return child                # warm path: lock-free dict probe
+        with self._sig_lock:            # cold path: create under a lock
+            child = self._sig_labels.get(group)
+            if child is not None:
+                return child
+            if self._tm.closed:
+                # racing a concurrent close(): do not re-create series
+                # the close just removed — count into an unregistered
+                # sink instead (the submit is about to be rejected)
+                return _NULL_COUNTER
+            if len(self._sig_labels) >= _MAX_SIG_LABELS:
+                # at the cap, do NOT memoize new keys either — the memo
+                # dict must stay as bounded as the label set (the lock
+                # makes the cap exact under concurrent submits)
+                if self._sig_other is None:
+                    self._sig_other = self._tm.shape_seen.labels(
+                        engine=self._tm.engine_label, sig="other")
+                return self._sig_other
+            sig = "|".join("%s:%s" % (name, "x".join(map(str, shape)))
+                           for name, shape in group)
+            child = self._tm.shape_seen.labels(
+                engine=self._tm.engine_label, sig=sig)
+            self._sig_labels[group] = child
+            return child
 
     def predict(self, value=None, timeout=None, deadline_ms=None, **feeds):
         """Synchronous convenience wrapper around :meth:`submit`."""
@@ -305,6 +569,12 @@ class ServingEngine(object):
                 return                     # closed and drained
             if not reqs:
                 continue
+            t_pop = time.perf_counter()
+            if self._tm is not None:
+                now_mono = time.monotonic()
+                for r in reqs:
+                    self._tm.queue_wait.observe(
+                        (now_mono - r.t_enqueue) * 1e3)
             if profiler.is_running():
                 # true coalescing latency (oldest enqueue -> dispatch),
                 # NOT a span around the blocking take(), which would be
@@ -313,49 +583,143 @@ class ServingEngine(object):
                                  (time.monotonic()
                                   - reqs[0].t_enqueue) * 1e3)
             try:
-                self._dispatch(reqs)
+                self._dispatch(reqs, t_pop)
             except Exception as e:         # fail the batch, keep serving
                 for r in reqs:
                     if not r.future.done():
                         _fail_future(r.future, e)
+                        if r.trace is not None:
+                            r.trace.abort(type(e).__name__)
+                    elif r.trace is not None:
+                        # delivered before the batch blew up mid-
+                        # scatter: close the trace as-is, NOT 'failed'
+                        r.trace.finish()
 
-    def _dispatch(self, reqs):
+    def _dispatch(self, reqs, t_pop=None):
+        tm = self._tm
+        t_pop = time.perf_counter() if t_pop is None else t_pop
         # claim every future up front: a claimed (RUNNING) future can no
         # longer be cancel()ed out from under the scatter, and requests
         # the client already cancelled drop out of the batch here
-        reqs = [r for r in reqs if r.future.set_running_or_notify_cancel()]
+        live = []
+        for r in reqs:
+            if r.future.set_running_or_notify_cancel():
+                live.append(r)
+            elif r.trace is not None:
+                r.trace.abort("cancelled")
+        reqs = live
         if not reqs:
             return
         n = len(reqs)
         b = self._policy.batch_bucket(n)
         group = dict(reqs[0].group)
+        t_pad0 = time.perf_counter()
         feeds = {}
+        live_elems = 0
         for name, ex_shape in group.items():
             arr = np.zeros((b,) + ex_shape, dtype=self._dtype)
             for i, r in enumerate(reqs):
                 x = r.inputs[name]
                 arr[(i,) + tuple(slice(0, d) for d in x.shape)] = x
+                live_elems += x.size
             feeds[name] = arr
+        c0 = self._cache.compile_count
+        t_disp0 = time.perf_counter()
         with profiler.record_span("serve.dispatch[b=%d,n=%d]" % (b, n),
                                   "serve"):
             if self._pad_check:
                 outs = self._pad_probe(feeds, reqs)
             else:
                 outs = self._cache.run(feeds)
+        t_disp1 = time.perf_counter()
+        compiled = self._count_compiles(c0, feeds)
         now = time.monotonic()
         # scatter first: unblock the waiting clients before doing any
-        # stats bookkeeping (closed-loop clients resubmit ~0.1 ms sooner)
+        # stats bookkeeping (closed-loop clients resubmit ~0.1 ms
+        # sooner) — trace assembly included, so a traced request at
+        # slot 0 cannot delay slots 1..n-1's set_result
+        traced = []
         for i, r in enumerate(reqs):
+            t_u0 = time.perf_counter() if r.trace is not None else 0.0
             res = [self._unpad(o[i], r, j) for j, o in enumerate(outs)]
             r.future.set_result(res if len(res) > 1 else res[0])
+            if r.trace is not None:
+                traced.append((r, t_u0, time.perf_counter()))
+        for r, t_u0, t_u1 in traced:
+            self._finish_trace(r, t_pop, t_pad0, t_disp0, t_disp1,
+                               t_u0, t_u1, b, n, compiled)
         with self._lock:
             self._batches += 1
             self._requests_served += n
             self._occupancy_sum += n / float(b)
             for r in reqs:
                 self._lat_ms.append((now - r.t_enqueue) * 1e3)
+        if tm is not None:
+            tm.batches.inc()
+            tm.occupancy.observe(n / float(b))
+            tm.dispatch_ms.observe((t_disp1 - t_disp0) * 1e3)
+            for r in reqs:
+                tm.latency.observe((now - r.t_enqueue) * 1e3)
+            padded = sum(arr.size for arr in feeds.values())
+            bucket = str(b)
+            tm.padded_elems.labels(bucket=bucket).inc(padded)
+            tm.live_elems.labels(bucket=bucket).inc(live_elems)
+            if padded:
+                tm.pad_waste.labels(bucket=bucket).observe(
+                    1.0 - live_elems / float(padded))
         if profiler.is_running():
             profiler.counter("serve.batch_occupancy", n / float(b))
+
+    def _count_compiles(self, c0, feeds):
+        """Attribute XLA traces observed during one dispatch: every
+        trace counts as a compile; a trace on an already-dispatched
+        bucket signature (or any trace once warmup ran) is a RETRACE —
+        the compile-once contract broken at runtime — and is counted
+        under the engine's static hazard fingerprints.  The engine-side
+        bookkeeping (``stats()['retraces']``) always runs — a compile
+        storm must be visible even with the registry disabled; only
+        the instrument writes gate on the bundle."""
+        tm = self._tm
+        compiled = self._cache.compile_count - c0
+        key = tuple(sorted((k, v.shape) for k, v in feeds.items()))
+        if compiled:
+            if tm is not None:
+                tm.compiles.inc(compiled)
+            # retrace = a compile on a signature ALREADY dispatched
+            # (warmup seeds the set).  A first-sight signature is a
+            # legitimate cold compile even post-warmup: exact-length
+            # seq mode (cross-position graphs degrade to one program
+            # per length) compiles new lengths by design.
+            if key in self._dispatched_keys:
+                self._retraces += compiled
+                if tm is not None:
+                    tm.retraces.labels(
+                        hazards=self._hazard_label).inc(compiled)
+        self._dispatched_keys.add(key)
+        return compiled
+
+    def _finish_trace(self, r, t_pop, t_pad0, t_disp0, t_disp1, t_u0,
+                      t_u1, b, n, compiled):
+        """Assemble the sampled request's span tree: batch-stage
+        intervals were measured once per batch and are attributed to
+        every traced member request.  Runs AFTER the scatter loop —
+        store inserts and the profiler-ring bridge must not sit
+        between two clients' set_result calls."""
+        tc = r.trace
+        tc.add("queue-wait", tc.root.t0, t_pop, "serve")
+        tc.add("coalesce", t_pop, t_pad0, "serve",
+               meta={"batch": n})
+        tc.add("pad", t_pad0, t_disp0, "serve", meta={"bucket": b})
+        dsp = tc.add("dispatch", t_disp0, t_disp1, "serve",
+                     meta={"bucket": b, "live": n,
+                           "compiled": bool(compiled)})
+        if compiled:
+            sp = _telemetry.Span("compile", "serve", t0=t_disp0)
+            sp.t1 = t_disp1
+            sp.meta = {"programs": compiled}
+            dsp.children.append(sp)
+        tc.add("unpad", t_u0, t_u1, "serve")
+        tc.finish(t_u1)
 
     def _pad_probe(self, feeds, reqs):
         """MXNET_SERVE_PAD_CHECK: dispatch twice via the ProgramCache
@@ -411,6 +775,7 @@ class ServingEngine(object):
                     s[self._policy.seq_axis] = sb
                     shapes[name] = tuple(s)
                 seq_shapes.append(shapes)
+        c0 = self.compile_count
         for shapes in seq_shapes:
             for bb in self._policy.batch_buckets():
                 feeds = {name: np.zeros((bb,) + ex, dtype=self._dtype)
@@ -418,8 +783,12 @@ class ServingEngine(object):
                 with profiler.record_span(
                         "serve.warmup[b=%d]" % bb, "serve"):
                     self._cache.run(feeds)
+                self._dispatched_keys.add(tuple(sorted(
+                    (k, v.shape) for k, v in feeds.items())))
                 with self._lock:
                     self._warmup_batches += 1
+        if self._tm is not None:
+            self._tm.compiles.inc(self.compile_count - c0)
         return self.compile_count
 
     @property
@@ -427,9 +796,13 @@ class ServingEngine(object):
         return self._cache.compile_count
 
     def stats(self):
-        """Point-in-time snapshot of engine health: admission counters,
-        dispatch/occupancy aggregates, program-cache state, and request
-        latency percentiles (ms) over the last ≤4096 completions."""
+        """Point-in-time snapshot of engine health: admission counters
+        (queue depth + cumulative rejected/shed/expired — the same
+        numbers the mxnet_serve_* telemetry gauges/counters carry),
+        dispatch/occupancy aggregates, program-cache traffic, retrace
+        count, and request latency percentiles (ms) over the last
+        ≤4096 completions.  An empty latency window reports zeros for
+        every latency field, never NaN or an exception."""
         snap = self._adm.stats()
         with self._lock:
             lat = sorted(self._lat_ms)
@@ -440,6 +813,9 @@ class ServingEngine(object):
                 "batch_occupancy": (self._occupancy_sum / self._batches
                                     if self._batches else 0.0),
                 "compile_count": self.compile_count,
+                "retraces": self._retraces,
+                "program_cache": {"hits": self._cache.plan_hits,
+                                  "misses": self._cache.plan_misses},
                 "bucket_keys": len(self._cache.bucket_keys),
                 "max_batch": self._policy.max_batch,
                 "latency_ms": {

@@ -1,0 +1,154 @@
+"""mxnet_tpu.telemetry — unified runtime telemetry.
+
+One instrumentation vocabulary for every layer of the stack (the
+ROADMAP's production-serving north star needs machine-scrapeable
+signals, not just Chrome-trace files):
+
+- **metrics** (metrics.py): a process-wide registry of counters,
+  gauges, and fixed-bucket histograms with labeled series; Prometheus
+  text + JSON exporters and a periodic snapshot thread (export.py);
+- **tracing** (tracing.py): request-scoped ``TraceContext`` span trees,
+  contextvar-propagated on-thread and carried across the serving
+  worker hop, stored retrievably by trace id and bridged into the
+  profiler's Chrome-trace ring;
+- **built-in instrumentation**: serving admission/dispatch (queue
+  depth, shed/reject/expiry, occupancy, padding waste, program-cache
+  hit/miss, retraces keyed by the retrace-linter's hazard
+  fingerprints, shape-signature entropy), kvstore push/pull bytes +
+  latency, io/dataloader batch latency, monitor tensor gauges, XLA
+  trace counts.
+
+The master switch is ``MXNET_TELEMETRY_ON`` (default on).  Call sites
+gate on :func:`enabled` and hold NO instruments when it is off — the
+serving hot path then makes zero registry calls per request (asserted
+by tests via ``registry().instrument_calls()``).  Use::
+
+    from mxnet_tpu import telemetry
+    reqs = telemetry.counter("myapp_requests_total", "requests seen")
+    reqs.inc()
+    print(telemetry.render_prometheus())
+
+CLI: ``tools/telemetry_dump.py`` renders snapshots and per-request
+span breakdowns from :func:`dump_state` files.
+"""
+from __future__ import annotations
+
+import atexit
+
+from .metrics import (Registry, Counter, Gauge, Histogram, Family,
+                      LATENCY_MS_BUCKETS, RATIO_BUCKETS, BYTES_BUCKETS)
+from .tracing import (TraceContext, Span, current_trace, activate, trace,
+                      maybe_span, get_trace, recent_trace_ids, all_traces,
+                      clear_traces)
+from .export import (render_prometheus, render_json, write_snapshot,
+                     start_snapshotter, stop_snapshotter)
+
+__all__ = [
+    "Registry", "Counter", "Gauge", "Histogram", "Family",
+    "LATENCY_MS_BUCKETS", "RATIO_BUCKETS", "BYTES_BUCKETS",
+    "TraceContext", "Span", "current_trace", "activate", "trace",
+    "maybe_span", "get_trace", "recent_trace_ids", "all_traces",
+    "clear_traces",
+    "render_prometheus", "render_json", "write_snapshot",
+    "start_snapshotter", "stop_snapshotter",
+    "enabled", "set_enabled", "registry", "counter", "gauge",
+    "histogram", "bound", "reset", "dump_state", "trace_sample_every",
+]
+
+_REGISTRY = Registry()
+_FORCED = None          # set_enabled override; None defers to the env
+
+
+def registry():
+    """The process-wide default registry every built-in instrument
+    registers against."""
+    return _REGISTRY
+
+
+def enabled():
+    """Master switch.  Reads ``MXNET_TELEMETRY_ON`` through the config
+    tier per call (cheap: one environ probe) so tests and operators
+    can flip it without reimporting — and so the parse/default can
+    never diverge from the documented config surface;
+    :func:`set_enabled` pins it programmatically."""
+    if _FORCED is not None:
+        return _FORCED
+    from .. import config
+    return config.get("MXNET_TELEMETRY_ON")
+
+
+def set_enabled(value):
+    """Pin telemetry on/off (``None`` restores env-var control)."""
+    global _FORCED
+    _FORCED = None if value is None else bool(value)
+
+
+def trace_sample_every():
+    """Request-tracing sample period: every Nth serving request gets a
+    full span tree (0 disables tracing; 1 traces everything)."""
+    from .. import config
+    return config.get("MXNET_TELEMETRY_TRACE_SAMPLE")
+
+
+# -- default-registry conveniences ------------------------------------------
+
+def counter(name, doc="", labelnames=()):
+    return _REGISTRY.counter(name, doc, labelnames)
+
+
+def gauge(name, doc="", labelnames=()):
+    return _REGISTRY.gauge(name, doc, labelnames)
+
+
+def histogram(name, doc="", labelnames=(), buckets=LATENCY_MS_BUCKETS):
+    return _REGISTRY.histogram(name, doc, labelnames, buckets)
+
+
+def bound(cache, key, factory):
+    """Memoize a bound instrument child in a call-site ``cache`` dict —
+    the warm path is one dict probe + one int compare, no registry
+    lock.  Entries are versioned by the registry generation so a
+    :func:`reset` invalidates them (otherwise hot paths would keep
+    writing to orphaned instruments that no scrape can see)."""
+    gen = _REGISTRY.generation
+    hit = cache.get(key)
+    if hit is not None and hit[0] == gen:
+        return hit[1]
+    inst = factory()
+    cache[key] = (gen, inst)
+    return inst
+
+
+def reset():
+    """Clear the default registry AND the finished-trace store (tests).
+    Engines built before a reset keep their orphaned instruments;
+    rebuild them to re-register."""
+    _REGISTRY.reset()
+    clear_traces()
+
+
+def dump_state(path):
+    """Write the combined metrics+traces JSON document to ``path`` —
+    the file ``tools/telemetry_dump.py`` renders offline."""
+    write_snapshot(path, fmt="json", registry=_REGISTRY)
+    return path
+
+
+# Periodic snapshots autostart when configured (serving processes run
+# unattended for days); a final snapshot lands at interpreter exit.
+def _maybe_autostart():
+    from .. import config
+    if enabled() and config.get("MXNET_TELEMETRY_SNAPSHOT_SECS") > 0:
+        try:
+            start_snapshotter()
+        except Exception as e:
+            # a typo'd MXNET_TELEMETRY_SNAPSHOT_FORMAT must not make
+            # `import mxnet_tpu` raise — but it must also not be
+            # silent (the thread exists for unattended processes)
+            import warnings
+            warnings.warn("telemetry snapshot autostart failed: %s" % e)
+        else:
+            atexit.register(stop_snapshotter)
+
+
+_maybe_autostart()

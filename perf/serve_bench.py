@@ -19,9 +19,12 @@ contract demands retraces == 0).
   python perf/serve_bench.py --offered 8 --requests 2048
   python perf/serve_bench.py --check-speedup 3   # exit 1 if batch-8
                                                  # speedup < 3x
+  python perf/serve_bench.py --telemetry         # exit 1 if telemetry
+                                                 # costs >= 2% rps
 
 A fast smoke variant runs in the tier-1 suite
-(tests/test_serving.py::test_serve_bench_smoke).
+(tests/test_serving.py::test_serve_bench_smoke; the telemetry-overhead
+path smokes in tests/test_telemetry.py).
 """
 import argparse
 import json
@@ -54,6 +57,32 @@ def build_model(feature=512, hidden=1024, classes=10, seed=0):
     return net, params
 
 
+def closed_loop_round(eng, X, requests, offered_batch, timeout=120):
+    """One timed closed-loop round: ``offered_batch`` client threads
+    drain ``requests`` requests through the engine.  Shared by the
+    serial-vs-engine sweep AND the telemetry overhead gate so both
+    measure the identical load pattern; asserts every request actually
+    completed — a died client thread must fail the bench, not feed a
+    short round into the timing."""
+    results = [None] * requests
+
+    def client(tid):
+        for i in range(tid, requests, offered_batch):
+            results[i] = eng.predict(X[i], timeout=timeout)
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(offered_batch)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    assert all(r is not None for r in results), \
+        "a bench client died mid-round; timing would be bogus"
+    return dt
+
+
 def run_bench(requests=512, offered_batch=8, feature=512, hidden=1024,
               classes=10, batch_timeout_ms=2.0, repeats=3):
     """One sweep point: serial Predictor loop vs engine at an offered
@@ -83,31 +112,14 @@ def run_bench(requests=512, offered_batch=8, feature=512, hidden=1024,
                                 batch_timeout_ms=batch_timeout_ms)
     warm_compiles = eng.warmup()
 
-    def engine_round():
-        results = [None] * requests
-
-        def client(tid):
-            for i in range(tid, requests, offered_batch):
-                results[i] = eng.predict(X[i], timeout=120)
-
-        threads = [threading.Thread(target=client, args=(t,))
-                   for t in range(offered_batch)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        dt = time.perf_counter() - t0
-        assert all(r is not None for r in results)
-        return dt
-
     serial_s = engine_s = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
         for i in range(requests):
             pred.forward(data=X[i][None]).get_output(0)
         serial_s = min(serial_s, time.perf_counter() - t0)
-        engine_s = min(engine_s, engine_round())
+        engine_s = min(engine_s,
+                       closed_loop_round(eng, X, requests, offered_batch))
     stats = eng.stats()
     retraces = eng.compile_count - warm_compiles
     eng.close()
@@ -126,6 +138,60 @@ def run_bench(requests=512, offered_batch=8, feature=512, hidden=1024,
     }
 
 
+def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
+                           hidden=1024, classes=10, batch_timeout_ms=2.0,
+                           repeats=3, tol=0.02):
+    """Telemetry overhead gate: engine throughput with the metrics
+    registry + trace sampling ON must stay within ``tol`` of the OFF
+    path (the issue contract: <2% regression at the default tol).
+
+    One engine per mode — instruments bind at construction — driven by
+    the same closed-loop client pattern as :func:`run_bench`, rounds
+    INTERLEAVED (off, on, off, on, ...) and best-of-``repeats`` per
+    mode so shared-machine drift hits both paths alike.
+    """
+    from mxnet_tpu import serving, telemetry
+
+    net, params = build_model(feature, hidden, classes)
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((requests, feature)).astype(np.float32)
+
+    def make_engine(enabled):
+        telemetry.set_enabled(enabled)
+        try:
+            import mxnet_tpu as mx
+            eng = serving.ServingEngine(
+                net, params, {}, {"data": (feature,)}, ctx=mx.cpu(),
+                batch_timeout_ms=batch_timeout_ms)
+            eng.warmup()
+        finally:
+            telemetry.set_enabled(None)
+        return eng
+
+    eng_off = make_engine(False)
+    eng_on = make_engine(True)
+    off_s = on_s = float("inf")
+    try:
+        for _ in range(repeats):
+            off_s = min(off_s, closed_loop_round(eng_off, X, requests,
+                                                 offered_batch))
+            on_s = min(on_s, closed_loop_round(eng_on, X, requests,
+                                               offered_batch))
+    finally:
+        eng_off.close()
+        eng_on.close()
+    regression = 1.0 - off_s / on_s        # >0 means telemetry is slower
+    return {
+        "requests": requests,
+        "offered_batch": offered_batch,
+        "rps_telemetry_off": round(requests / off_s, 1),
+        "rps_telemetry_on": round(requests / on_s, 1),
+        "regression": round(regression, 4),
+        "tol": tol,
+        "ok": regression < tol,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=512)
@@ -141,7 +207,30 @@ def main():
     ap.add_argument("--check-speedup", type=float, default=None,
                     help="exit 1 unless the largest offered load's "
                          "speedup is at least this factor")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="run the telemetry overhead gate instead of "
+                         "the serial-vs-engine sweep: exit 1 if engine "
+                         "throughput regresses >= --telemetry-tol with "
+                         "telemetry enabled")
+    ap.add_argument("--telemetry-tol", type=float, default=0.02,
+                    help="allowed fractional throughput regression "
+                         "with telemetry on (default 0.02 = 2%%)")
     args = ap.parse_args()
+
+    if args.telemetry:
+        row = run_telemetry_overhead(
+            requests=args.requests, offered_batch=(args.offered or [8])[-1],
+            feature=args.feature, hidden=args.hidden, classes=args.classes,
+            batch_timeout_ms=args.window_ms, repeats=args.repeats,
+            tol=args.telemetry_tol)
+        print(json.dumps(row))
+        if not row["ok"]:
+            print("FAIL: telemetry costs %.2f%% throughput (tol %.2f%%)"
+                  % (row["regression"] * 1e2, row["tol"] * 1e2))
+            sys.exit(1)
+        print("OK: telemetry overhead %.2f%% < %.2f%%"
+              % (row["regression"] * 1e2, row["tol"] * 1e2))
+        return
 
     offered = args.offered or [1, 2, 4, 8]
     rows = []

@@ -395,6 +395,24 @@ def test_cli_unknown_graph_exits_two():
 # review-hardening regressions
 # ---------------------------------------------------------------------------
 
+def test_padding_greater_scalar_zero_rule_sign_sensitive():
+    """(pad=0) > c is 1 for negative c: the zero bit must NOT survive a
+    negative-threshold comparison, or a downstream sum over the padded
+    axis absorbs spurious ones (regression: the rule was coded
+    unconditionally True)."""
+    data = mx.sym.Variable("data")
+    policy = BucketPolicy(max_batch=2, seq_axis=0, seq_buckets=(4,))
+    bad = mx.sym.sum(data > -1.0, axis=1, name="pool")
+    verdicts, report = analysis.check_serving_graph(bad, {"data": (4, 3)},
+                                                    policy)
+    assert verdicts["seq"] == "cross-position"
+    # non-negative threshold keeps 0 > c == 0: still absorbing
+    ok = mx.sym.sum(data > 0.5, axis=1, name="pool")
+    verdicts2, _ = analysis.check_serving_graph(ok, {"data": (4, 3)},
+                                                policy)
+    assert verdicts2["seq"] == "row-local"
+
+
 def test_padding_sequence_mask_value_controls_zero_bit():
     """SequenceMask(value=0) restores the zero invariant on its axis
     (sum-over-pads exact again); any other value destroys it."""

@@ -1,0 +1,143 @@
+"""Render telemetry state: metrics snapshots and per-request span trees.
+
+Consumes the self-contained JSON document the runtime writes
+(``telemetry.dump_state(path)``, or the periodic snapshot thread with
+``MXNET_TELEMETRY_SNAPSHOT_FORMAT=json``), or a live Prometheus-text
+snapshot (printed verbatim).  A serving process stays uninspected only
+until someone has one of those files::
+
+  python tools/telemetry_dump.py snapshot telemetry.json
+  python tools/telemetry_dump.py traces telemetry.json
+  python tools/telemetry_dump.py trace 1c96ce8a1ace4cf6 telemetry.json
+
+``snapshot`` prints one line per series with histogram count/mean/max
+bucket; ``trace`` prints the request's span tree with per-stage start
+and duration — the "where did THIS request's latency go" view
+(queue-wait -> coalesce -> pad -> dispatch -> unpad for serving
+traffic).
+"""
+import argparse
+import json
+import sys
+
+
+def load_doc(path):
+    """Parse a dump file: JSON documents load structurally; anything
+    else (Prometheus text) passes through as {'text': ...}."""
+    with open(path) as f:
+        raw = f.read()
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return {"text": raw}
+    if "metrics" not in doc and "traces" not in doc:
+        # bare Registry.collect() output: normalize
+        doc = {"metrics": doc}
+    return doc
+
+
+def _fmt_labels(labels):
+    if not labels:
+        return ""
+    return "{%s}" % ",".join("%s=%s" % kv for kv in sorted(labels.items()))
+
+
+def _num(v):
+    """Render one value; non-finite values export as null (export.py
+    _finite) and must render, not crash, during the NaN incident."""
+    return "%g" % v if v is not None else "null"
+
+
+def format_metrics(metrics):
+    """One line per series; histograms show count/mean and the largest
+    occupied bucket (the tail a dashboard would alert on)."""
+    lines = []
+    for name in sorted(metrics):
+        fam = metrics[name]
+        lines.append("%s (%s)%s" % (name, fam["kind"],
+                                    "  # " + fam["doc"] if fam.get("doc")
+                                    else ""))
+        for s in fam["series"]:
+            lab = _fmt_labels(s["labels"])
+            if fam["kind"] == "histogram":
+                count = s["count"]
+                mean = (s["sum"] / count
+                        if count and s["sum"] is not None else None)
+                tail = "-"
+                for le, c in reversed(list(zip(
+                        s["buckets"] + [float("inf")], s["counts"]))):
+                    if c:
+                        tail = "le=%g" % le
+                        break
+                lines.append("  %-40s count=%d mean=%s max_bucket=%s"
+                             % (lab or "(no labels)", count, _num(mean),
+                                tail))
+            else:
+                lines.append("  %-40s %s" % (lab or "(no labels)",
+                                             _num(s["value"])))
+    return "\n".join(lines)
+
+
+def format_trace(tree):
+    """Indented span tree with per-span offset + duration in ms."""
+    lines = ["trace %s" % tree["trace_id"]]
+
+    def walk(span, depth):
+        dur = span.get("dur_ms")
+        meta = span.get("meta")
+        lines.append("%s%-24s %s  [start %+9.3f ms]%s" % (
+            "  " * depth, span["name"],
+            ("%9.3f ms" % dur) if dur is not None else "  (open)  ",
+            span["start_ms"],
+            "  %s" % json.dumps(meta, sort_keys=True) if meta else ""))
+        for child in span.get("children", ()):
+            walk(child, depth + 1)
+
+    walk(tree["root"], 1)
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render mxnet_tpu telemetry dumps")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_snap = sub.add_parser("snapshot", help="render the metrics snapshot")
+    p_snap.add_argument("file")
+    p_list = sub.add_parser("traces", help="list stored trace ids")
+    p_list.add_argument("file")
+    p_tr = sub.add_parser("trace", help="render one request's span tree")
+    p_tr.add_argument("trace_id")
+    p_tr.add_argument("file")
+    args = ap.parse_args(argv)
+
+    doc = load_doc(args.file)
+    if "text" in doc:                       # Prometheus text: verbatim
+        print(doc["text"], end="")
+        return 0
+    if args.cmd == "snapshot":
+        print(format_metrics(doc.get("metrics", {})))
+        return 0
+    traces = doc.get("traces", {})
+    if args.cmd == "traces":
+        if not traces:
+            print("(no traces stored — is MXNET_TELEMETRY_TRACE_SAMPLE "
+                  "set too high, or tracing disabled?)")
+            return 0
+        for tid, tree in traces.items():
+            root = tree["root"]
+            print("%s  %-16s %s" % (
+                tid, root["name"],
+                ("%9.3f ms" % root["dur_ms"])
+                if root.get("dur_ms") is not None else "(open)"))
+        return 0
+    tree = traces.get(args.trace_id)
+    if tree is None:
+        print("trace %r not found (%d stored; run `traces` to list)"
+              % (args.trace_id, len(traces)), file=sys.stderr)
+        return 1
+    print(format_trace(tree))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
