@@ -19,7 +19,14 @@ Pass families (``DEFAULT_PASSES`` order):
   dynamic dims, shape-literal attrs downstream of them, jit-cache-
   busting attr values, host-callback ops in hot paths (retrace.py);
 - ``padding`` — padding-soundness: classifies the graph row-local vs
-  cross-position along serving's zero-padded axes (padding.py).
+  cross-position along serving's zero-padded axes, tracking the
+  constant each axis's pad slots are known to hold (padding.py).
+
+Verdicts drive rewrites, not just diagnostics: ``rewrite.py`` consumes
+the padding pass's structured violations and splices valid-length-
+driven SequenceMask / mean-renorm repairs, accepted only when
+re-analysis flips the verdict row-local (``plan_repair`` /
+``repair_serving_graph``; CLI ``graph_lint --fix``).
 
 Entry points::
 
@@ -35,23 +42,27 @@ named model-zoo graph (``--strict`` exits nonzero on any finding).
 Runtime wiring: ``ServingEngine``/``Predictor`` construction verifies by
 default — warn, or raise with ``MXNET_ANALYSIS_STRICT=1``.
 """
-from .diagnostics import Severity, Diagnostic, Report, AnalysisError
+from .diagnostics import (Severity, Diagnostic, Report, AnalysisError,
+                          hazard_fingerprint)
 from .core import (AnalysisContext, AnalysisPass, analyze, register_pass,
                    get_pass, list_passes, DEFAULT_PASSES)
-from .graph import GraphView, find_cycle
+from .graph import GraphView, find_cycle, splice_input, redirect_entries
 from .verifier import VerifierPass
 from .shapes import ShapeDtypePass
 from .retrace import RetraceHazardPass
-from .padding import PaddingSoundnessPass, classify_padding
+from .padding import PaddingSoundnessPass, classify_padding, PadViolation
+from .rewrite import RepairPlan, plan_repair, repair_serving_graph
 
 __all__ = [
     "Severity", "Diagnostic", "Report", "AnalysisError",
+    "hazard_fingerprint",
     "AnalysisContext", "AnalysisPass", "analyze", "register_pass",
     "get_pass", "list_passes", "DEFAULT_PASSES",
-    "GraphView", "find_cycle",
+    "GraphView", "find_cycle", "splice_input", "redirect_entries",
     "VerifierPass", "ShapeDtypePass", "RetraceHazardPass",
-    "PaddingSoundnessPass", "classify_padding", "check_serving_graph",
-    "verify",
+    "PaddingSoundnessPass", "classify_padding", "PadViolation",
+    "RepairPlan", "plan_repair", "repair_serving_graph",
+    "check_serving_graph", "verify",
 ]
 
 
@@ -61,7 +72,8 @@ def verify(symbol):
     return report
 
 
-def check_serving_graph(symbol, data_shapes, policy, training=False):
+def check_serving_graph(symbol, data_shapes, policy, training=False,
+                        with_ctx=False):
     """The engine-construction check: verify + shapes + padding over the
     axes serving actually zero-pads.
 
@@ -69,18 +81,19 @@ def check_serving_graph(symbol, data_shapes, policy, training=False):
     ``ServingEngine`` receives; graph coordinates gain the batch axis at
     0, so the padded axes are batch=0 and, when the policy seq-buckets,
     ``policy.seq_axis + 1``.  Returns ({label: verdict}, Report) with
-    labels "batch" and "seq".
+    labels "batch" and "seq" — plus the AnalysisContext when
+    ``with_ctx`` (the engine forwards it to the repair path so the
+    pre-repair analysis is not repeated).
     """
-    full = {}
-    for name, ex in data_shapes.items():
-        try:
-            ex = policy.example_shape(tuple(ex))
-        except Exception:
-            ex = tuple(ex)      # off-grid reference shape: analyze as-is
-        full[name] = (policy.max_batch,) + ex
-    pad_axes = {"batch": {name: 0 for name in data_shapes}}
-    if policy.seq_axis is not None and policy.seq_buckets:
-        pad_axes["seq"] = {name: policy.seq_axis + 1
-                           for name in data_shapes}
-    return classify_padding(symbol, full, pad_axes, training=training,
-                            policy=policy)
+    from .rewrite import serving_pad_spec
+    full, pad_axes = serving_pad_spec(data_shapes, policy)
+    # retrace runs too: its warnings (host-sync ops, cache-busting
+    # attrs, ...) are the hazard fingerprints the engine labels
+    # runtime retraces with — without the pass they could never fire
+    report, ctx = analyze(symbol, data_shapes=full, pad_axes=pad_axes,
+                          training=training, policy=policy,
+                          passes=("verify", "shapes", "retrace",
+                                  "padding"))
+    if with_ctx:
+        return dict(ctx.pad_verdicts), report, ctx
+    return dict(ctx.pad_verdicts), report

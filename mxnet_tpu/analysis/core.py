@@ -37,7 +37,7 @@ class AnalysisContext(object):
     """
 
     def __init__(self, symbol, data_shapes=None, dtypes=None, policy=None,
-                 pad_axes=None, training=False):
+                 pad_axes=None, training=False, valid_lengths=None):
         self.symbol = symbol
         self.data_shapes = {k: (tuple(v) if v is not None else None)
                             for k, v in (data_shapes or {}).items()}
@@ -45,6 +45,12 @@ class AnalysisContext(object):
         self.policy = policy
         self.pad_axes = pad_axes
         self.training = training
+        # axis label -> name of the graph input carrying each request's
+        # live length along that padded axis (the repair engine's mask
+        # driver).  Also auto-discovered from variables that declare
+        # ``__pad_valid_len__ = <label>`` (rewrite.py marks the inputs
+        # it creates, so a repaired graph re-analyzes standalone).
+        self.valid_lengths = dict(valid_lengths or {})
         self.view = None          # GraphView, set once certified acyclic
         self.structural_ok = None # verifier verdict; gates later passes
         # products of the shape/dtype abstract interpreter, keyed
@@ -53,6 +59,12 @@ class AnalysisContext(object):
         self.node_dtypes = {}
         # padding pass verdicts: axis label -> "row-local"|"cross-position"
         self.pad_verdicts = {}
+        # padding pass by-products consumed by rewrite.py:
+        # label -> {(id(node), out_idx): _Pad abstract state}, and
+        # label -> [PadViolation] (structured cross-position findings
+        # with repair hints)
+        self.pad_states = {}
+        self.pad_violations = {}
 
     def ensure_view(self):
         if self.view is None:
@@ -89,7 +101,8 @@ def list_passes():
 
 
 def analyze(symbol, data_shapes=None, dtypes=None, policy=None,
-            pad_axes=None, training=False, passes=None):
+            pad_axes=None, training=False, passes=None,
+            valid_lengths=None):
     """Run a pass pipeline over ``symbol``; returns (Report, ctx).
 
     ``passes`` is an ordered iterable of pass names (default: the full
@@ -108,7 +121,7 @@ def analyze(symbol, data_shapes=None, dtypes=None, policy=None,
         names.insert(0, "verify")
     ctx = AnalysisContext(symbol, data_shapes=data_shapes, dtypes=dtypes,
                           policy=policy, pad_axes=pad_axes,
-                          training=training)
+                          training=training, valid_lengths=valid_lengths)
     report = Report()
     for name in names:
         if name != "verify" and ctx.structural_ok is False:

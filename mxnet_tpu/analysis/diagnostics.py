@@ -11,9 +11,24 @@ mismatch" becomes "rank mismatch at `fc1` flowing from `data` via
 """
 from __future__ import annotations
 
+import hashlib
+
 from ..base import MXNetError
 
-__all__ = ["Severity", "Diagnostic", "Report", "AnalysisError"]
+__all__ = ["Severity", "Diagnostic", "Report", "AnalysisError",
+           "hazard_fingerprint"]
+
+
+def hazard_fingerprint(node, op, message):
+    """Stable 8-hex fingerprint of one finding's identity (node, op,
+    message head).  The SAME function keys three places: the serving
+    engine's ``mxnet_serve_retraces_total{hazards=...}`` label, the
+    ``graph_lint --json`` report, and ``tools/hazard_rank.py``'s join
+    between them — so an observed runtime retrace can be traced back to
+    the static warning that predicted it."""
+    head = (message or "").split(":")[0]
+    return hashlib.sha1(
+        ("%s|%s|%s" % (node, op, head)).encode()).hexdigest()[:8]
 
 
 class AnalysisError(MXNetError):
@@ -64,6 +79,16 @@ class Diagnostic(object):
 
     def __repr__(self):
         return "<Diagnostic %s>" % self
+
+    def to_dict(self):
+        """JSON-ready form (``graph_lint --json``); ``fingerprint`` is
+        the same hazard key the engine labels runtime retraces with."""
+        return {"severity": self.severity, "pass": self.pass_name,
+                "node": self.node, "op": self.op,
+                "message": self.message,
+                "provenance": list(self.provenance),
+                "fingerprint": hazard_fingerprint(self.node, self.op,
+                                                  self.message)}
 
 
 class Report(object):
@@ -127,10 +152,24 @@ class Report(object):
     def __str__(self):
         return self.format()
 
+    def to_list(self):
+        """Every diagnostic as a JSON-ready dict (``graph_lint --json``)."""
+        return [d.to_dict() for d in self.diagnostics]
+
+    def failing_passes(self, strict=False):
+        """Names of the passes whose findings fail the bar, sorted."""
+        bad = list(self.errors) + (list(self.warnings) if strict else [])
+        return sorted({d.pass_name for d in bad})
+
     def raise_if_errors(self, strict=False):
         """Raise :class:`AnalysisError` when the report fails the bar
-        (errors always; warnings too under ``strict``)."""
+        (errors always; warnings too under ``strict``).  The exception
+        message leads with the originating pass names, so a caller
+        catching it one frame up can tell a verifier failure from a
+        padding refusal without parsing the findings."""
         if not self.clean(strict=strict):
-            raise AnalysisError(self.format(
-                Severity.WARNING if strict else Severity.ERROR))
+            raise AnalysisError("analysis pass(es) %s failed:\n%s" % (
+                ", ".join(self.failing_passes(strict=strict)) or "?",
+                self.format(Severity.WARNING if strict
+                            else Severity.ERROR)))
         return self

@@ -10,7 +10,37 @@ from __future__ import annotations
 
 from ..symbol.symbol import _topo
 
-__all__ = ["GraphView", "find_cycle"]
+__all__ = ["GraphView", "find_cycle", "splice_input", "redirect_entries"]
+
+
+def splice_input(node, slot, entry):
+    """Point input ``slot`` of ``node`` at ``entry`` ((SymNode, out_idx)).
+
+    The edge-level splice the repair engine uses to interpose a mask
+    node between a producer and one specific consumer: other consumers
+    of the producer keep reading the unmasked value.
+    """
+    if not (0 <= slot < len(node.inputs)):
+        raise IndexError("node %r has %d inputs, no slot %d"
+                         % (node.name, len(node.inputs), slot))
+    node.inputs[slot] = tuple(entry)
+
+
+def redirect_entries(symbol, replacements):
+    """Re-point every consumer edge AND head of ``symbol`` matching a
+    key of ``replacements`` ({(id(node), out_idx): (new_node, out_idx)})
+    at its replacement entry.
+
+    This is the node-replacement primitive (the mean -> sum/count
+    rewrite): build the replacement subgraph reading the OLD node's
+    inputs first, then redirect; the old node drops out of the DAG once
+    nothing reaches it.  Mutates ``symbol`` in place.
+    """
+    for n in _topo(symbol._outputs):
+        n.inputs = [tuple(replacements.get((id(i), ix), (i, ix)))
+                    for (i, ix) in n.inputs]
+    symbol._outputs = [tuple(replacements.get((id(n), ix), (n, ix)))
+                       for (n, ix) in symbol._outputs]
 
 
 def find_cycle(heads):

@@ -12,9 +12,10 @@ This pass decides the question statically with an abstract
 interpretation over the certified DAG.  The abstract value per tensor
 tracks, for one padded source axis at a time:
 
-- ``axes``   — which axes of this tensor carry whole pad *positions*;
-- ``zero``   — whether pad slots are still guaranteed exactly zero
-  (f(0)=0 chains preserve it; a bias add or sigmoid destroys it);
+- ``values`` — which axes of this tensor carry whole pad *positions*,
+  and per axis the constant every pad slot is known to hold (``0.0``
+  through f(0)=0 chains — a bias add or sigmoid degrades it to unknown;
+  ``-inf``/``+inf``/``1.0`` after a repair mask pinned them there);
 - ``diffuse``— pad slots survived but were merged into another axis
   (reshape/flatten), so position-level reasoning is lost.
 
@@ -40,12 +41,25 @@ serving/buckets.py.
 """
 from __future__ import annotations
 
+import collections
 from functools import reduce as _reduce
 
 from .core import AnalysisPass, register_pass
 from .diagnostics import Diagnostic, Severity
 
-__all__ = ["PaddingSoundnessPass", "classify_padding"]
+__all__ = ["PaddingSoundnessPass", "classify_padding", "PadViolation",
+           "MaskAction", "MeanAction", "NEG_INF", "POS_INF"]
+
+#: repair hints a handler attaches to a cross-position finding:
+#: mask input ``slot`` with the neutral ``value`` along ``axes``, or
+#: rewrite a mean node into the sum/count form over ``axes``
+MaskAction = collections.namedtuple("MaskAction", ["value", "axes", "slot"])
+MeanAction = collections.namedtuple("MeanAction", ["axes", "slot"])
+
+NEG_INF = float("-inf")
+POS_INF = float("inf")
+
+_UNSET = object()
 
 
 def _prod(xs):
@@ -53,30 +67,87 @@ def _prod(xs):
 
 
 class _Pad(object):
-    """Abstract padding state of one tensor (see module docstring)."""
-    __slots__ = ("axes", "zero", "diffuse")
+    """Abstract padding state of one tensor (see module docstring).
 
-    def __init__(self, axes=(), zero=True, diffuse=False):
-        self.axes = frozenset(axes)
-        self.zero = zero
-        self.diffuse = diffuse
+    ``values`` maps each carried axis to the constant every pad slot
+    along it is known to hold (``None`` = unknown).  Tracking the value
+    — not just a zero bit — is what lets the repair engine's spliced
+    masks flip verdicts: softmax over pad slots pinned to ``-inf`` is
+    exact, max over ``-inf`` pads is exact, prod over ``1.0`` pads is
+    exact.  A slot padded along several axes holds the value of the
+    axis masked LAST (a mask writes every past-length slot, including
+    intersections), which is exactly what chained repair masks produce.
+    ``dvalue`` plays the same role for diffuse (axis-merged) pad slots.
+    """
+    __slots__ = ("values", "dvalue", "diffuse")
+
+    def __init__(self, axes=(), zero=True, diffuse=False, values=None,
+                 dvalue=_UNSET):
+        if values is not None:
+            self.values = dict(values)
+        else:
+            v = 0.0 if zero else None
+            self.values = {a: v for a in axes}
+        self.diffuse = bool(diffuse)
+        if dvalue is not _UNSET:
+            self.dvalue = dvalue
+        else:
+            self.dvalue = (0.0 if zero else None) if diffuse else None
+
+    @property
+    def axes(self):
+        return frozenset(self.values)
+
+    @property
+    def zero(self):
+        """Every pad slot this state tracks is known exactly zero."""
+        return all(v == 0.0 for v in self.values.values()) and \
+            (self.dvalue == 0.0 if self.diffuse else True)
 
     @property
     def carries(self):
-        return bool(self.axes) or self.diffuse
+        return bool(self.values) or self.diffuse
 
     def __repr__(self):
-        return "<pad axes=%s zero=%s diffuse=%s>" % (
-            sorted(self.axes), self.zero, self.diffuse)
+        return "<pad values=%s diffuse=%s>" % (
+            {a: self.values[a] for a in sorted(self.values)}, self.diffuse)
 
 
 _EMPTY = _Pad()
 
 
+class PadViolation(object):
+    """One structured cross-position finding (the rewrite engine's
+    input): the node that mixes pad into live positions, plus — when
+    the mixing op has a masking repair — machine-readable repair
+    actions.  ``actions`` is a tuple of :data:`MaskAction` /
+    :data:`MeanAction` entries, or ``()`` when the op has no known
+    masking rewrite (conv windows, reorders, norm layers...).
+    """
+    __slots__ = ("label", "node", "op", "actions", "provenance", "message")
+
+    def __init__(self, label, node, op, actions, provenance, message):
+        self.label = label
+        self.node = node
+        self.op = op
+        self.actions = tuple(actions or ())
+        self.provenance = tuple(provenance)
+        self.message = message
+
+    @property
+    def repairable(self):
+        return bool(self.actions)
+
+    def __repr__(self):
+        return "<PadViolation %s@%s(%s) actions=%s>" % (
+            self.label, self.node, self.op, list(self.actions))
+
+
 class _H(object):
     """Per-node handler context."""
     __slots__ = ("node", "attrs", "ins", "in_shapes", "out_shapes",
-                 "emit", "training", "view")
+                 "emit", "training", "view", "valid_len_name",
+                 "batch_states")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -128,10 +199,59 @@ _BINARY_PW = {"_add", "_sub", "_mul", "_div", "_mod", "_power", "_maximum",
               "logical_or", "logical_xor", "_scatter_elemwise_div",
               "_identity_with_attr_like_rhs", "where"}
 
-_REDUCE_SUM_ABSORBING = {"sum", "nansum", "norm"}
+# value a pad slot must hold for the reduction to absorb it exactly
+# (mean has none — its divisor counts pad slots regardless, which is
+# why its repair is a sum/count rewrite, not a mask; see rewrite.py)
+_REDUCE_IDENTITY = {"sum": 0.0, "nansum": 0.0, "norm": 0.0,
+                    "prod": 1.0, "nanprod": 1.0,
+                    "max": NEG_INF, "min": POS_INF,
+                    # arg-reductions: a pad slot at the absorbing
+                    # identity can never win, and ties break toward the
+                    # leading (live) positions
+                    "argmax": NEG_INF, "argmin": POS_INF}
 _REDUCE_OPS = {"sum", "nansum", "mean", "prod", "nanprod", "max", "min",
                "norm", "argmax", "argmin"}
 _REORDER_OPS = {"reverse", "sort", "argsort", "topk", "_shuffle"}
+
+
+def _contract_absorbed(lhs, l_con, rhs, r_con):
+    """Do pad slots vanish from a dot/batch_dot contraction?
+
+    Per pad position k of the contracted axis, the product vanishes
+    iff one side holds exactly 0.0 there AND the other side's factor
+    is finite — ``0 * inf`` is NaN, and a ``-inf`` masked operand
+    (exactly what a softmax repair mask upstream produces) against a
+    zero-padded one would poison every live sum.  A side that does
+    not carry the contracted axis holds live data there (treated
+    finite, as the pre-value-domain rule did).  Diffuse states never
+    reach here today (the _transfer gate flags non-pointwise ops on
+    diffuse carriers first), but like the softmax/reduce exactness
+    rules this one refuses them anyway: position-unknown pad slots
+    admit no per-axis claim."""
+    def _zero(st, con):
+        return (not st.diffuse and con in st.axes
+                and st.values.get(con) == 0.0)
+
+    def _finite(st, con):
+        if st.diffuse:
+            return False
+        if con not in st.axes:
+            return True                         # live data at pad k
+        v = st.values.get(con)
+        return v is not None and NEG_INF < v < POS_INF and v == v
+
+    return (_zero(lhs, l_con) and _finite(rhs, r_con)) or \
+        (_zero(rhs, r_con) and _finite(lhs, l_con))
+
+
+def _contract_repair(lhs, l_con, rhs, r_con):
+    """Mask actions restoring absorption for a contaminating
+    contraction: zero out whichever side's contracted pad slots are
+    not already exactly zero (shared by dot and batch_dot)."""
+    return tuple(
+        MaskAction(0.0, (con,), slot)
+        for slot, (st, con) in enumerate([(lhs, l_con), (rhs, r_con)])
+        if con in st.axes and st.values.get(con) != 0.0)
 
 
 def _map_axis_through_reshape(in_shape, out_shape, ax):
@@ -160,13 +280,18 @@ def _reduce_axes(attrs, rank):
     return axes
 
 
-def _remap_after_reduce(axes, reduced, keepdims):
-    out = set()
+def _reduce_remap(axes, reduced, keepdims):
+    """{surviving input axis: its output position} after a reduction."""
+    out = {}
     for a in axes:
         if a in reduced:
             continue
-        out.add(a if keepdims else a - sum(1 for r in reduced if r < a))
+        out[a] = a if keepdims else a - sum(1 for r in reduced if r < a)
     return out
+
+
+def _remap_after_reduce(axes, reduced, keepdims):
+    return set(_reduce_remap(axes, reduced, keepdims).values())
 
 
 # ---------------------------------------------------------------------------
@@ -195,6 +320,14 @@ class PaddingSoundnessPass(AnalysisPass):
     def _classify(self, ctx, view, label, var_axes, report):
         states = {}
         mixing = [False]
+        violations = ctx.pad_violations.setdefault(label, [])
+        valid_name = self._valid_len_name(ctx, view, label)
+        # the batch label's abstract states (classified first: spec
+        # order puts "batch" ahead) let the SequenceMask value-pinning
+        # rule verify the masked tensor is actually request-indexed at
+        # axis 0 — the layout the lengths vector assumes
+        batch_states = (ctx.pad_states.get("batch")
+                        if label != "batch" else None)
 
         for n in view.variables():
             if n.name in var_axes:
@@ -210,9 +343,12 @@ class PaddingSoundnessPass(AnalysisPass):
             out_shapes = [ctx.shapes.get((id(node), i)) for i in range(nout)]
 
             def emit(msg, severity=Severity.WARNING, mixes=True,
-                     _node=node):
+                     repair=None, _node=node):
                 if mixes and severity == Severity.WARNING:
                     mixing[0] = True
+                    violations.append(PadViolation(
+                        label, _node.name, _node.op.name, repair,
+                        view.provenance(_node), msg))
                 report.add(Diagnostic(
                     severity, self.name,
                     "[%s-axis] %s" % (label, msg), node=_node.name,
@@ -227,13 +363,32 @@ class PaddingSoundnessPass(AnalysisPass):
                     attrs = dict(node.attrs)
                 h = _H(node=node, attrs=attrs, ins=ins, in_shapes=in_shapes,
                        out_shapes=out_shapes, emit=emit,
-                       training=ctx.training, view=view)
+                       training=ctx.training, view=view,
+                       valid_len_name=valid_name,
+                       batch_states=batch_states)
                 outs = self._transfer(h)
                 if len(outs) < nout:
                     outs = list(outs) + [_EMPTY] * (nout - len(outs))
             for i, st in enumerate(outs):
                 states[(id(node), i)] = st
+        ctx.pad_states[label] = states
         return "cross-position" if mixing[0] else "row-local"
+
+    @staticmethod
+    def _valid_len_name(ctx, view, label):
+        """The graph input whose values are each request's live length
+        along this padded axis: declared by the caller, or discovered
+        from the ``__pad_valid_len__`` marker rewrite.py stamps on the
+        inputs it creates (so a repaired symbol re-analyzes standalone,
+        e.g. when graph_lint re-lints a ``--fix`` output)."""
+        name = ctx.valid_lengths.get(label)
+        if name is None:
+            for n in view.variables():
+                if str(n.attrs.get("__pad_valid_len__", "")) == label:
+                    name = n.name
+                    ctx.valid_lengths[label] = name
+                    break
+        return name
 
     @staticmethod
     def _nout(node):
@@ -397,6 +552,7 @@ class PaddingSoundnessPass(AnalysisPass):
 
     def _op_softmax(self, h):
         data = h.ins[0]
+        name = h.node.op.name
         raw_ax = int(h.attrs.get("axis", -1))
         if raw_ax < 0 and h.rank(0) is None:
             h.emit("cannot resolve softmax axis %d without shapes; "
@@ -404,9 +560,26 @@ class PaddingSoundnessPass(AnalysisPass):
             return [_Pad(data.axes, False)]
         ax = h.norm_axis(raw_ax)
         if ax in data.axes:
+            if data.values.get(ax) == NEG_INF and not data.diffuse:
+                h.emit("softmax over the padded axis is exact: pad "
+                       "slots hold -inf and contribute exp(-inf)=0 to "
+                       "the partition function",
+                       severity=Severity.INFO, mixes=False)
+                out_vals = {a: None for a in data.axes}
+                if data.axes == {ax}:
+                    # live rows renormalize over live slots only; the
+                    # pad slots themselves come out exactly 0 (-inf in
+                    # log space)
+                    out_vals[ax] = (NEG_INF if name == "log_softmax"
+                                    else 0.0)
+                return [_Pad(values=out_vals)]
+            repair = None
+            if name in ("softmax", "log_softmax"):
+                repair = (MaskAction(NEG_INF, (ax,), 0),)
             h.emit("softmax normalizes over the padded axis: each zero "
                    "pad slot contributes exp(0)=1 to the partition "
-                   "function, scaling every live probability down")
+                   "function, scaling every live probability down",
+                   repair=repair)
             return [_Pad(data.axes, False)]
         return [_Pad(data.axes, False)]
 
@@ -442,17 +615,33 @@ class PaddingSoundnessPass(AnalysisPass):
         hit = data.axes & set(reduced)
         out_axes = _remap_after_reduce(data.axes, set(reduced), keepdims)
         if hit:
-            if name in _REDUCE_SUM_ABSORBING and data.zero:
-                h.emit("%s over the padded axis is exact: pad slots are "
-                       "still zero and sums absorb them" % name,
+            ident = _REDUCE_IDENTITY.get(name)
+            if ident is not None and not data.diffuse and \
+                    all(data.values.get(a) == ident for a in hit):
+                h.emit("%s over the padded axis is exact: pad slots "
+                       "hold the reduction's absorbing identity (%s)"
+                       % (name, ident),
                        severity=Severity.INFO, mixes=False)
                 return [_Pad(out_axes, False)]
+            if name == "mean":
+                repair = (MeanAction(tuple(sorted(hit)), 0),)
+            elif ident is not None:
+                repair = (MaskAction(ident, tuple(sorted(hit)), 0),)
+            else:
+                repair = None
             h.emit("%s folds the padded axis into live outputs (%s)"
                    % (name,
                       "pad slots are no longer zero" if not data.zero
-                      else "zero is not the identity of this reduction"))
+                      else "zero is not the identity of this reduction"),
+                   repair=repair)
             return [_Pad(out_axes, False)]
-        return [_Pad(out_axes, data.zero and name in ("sum", "nansum"))]
+        out_vals = {}
+        remap = _reduce_remap(data.axes, set(reduced), keepdims)
+        for a, j in remap.items():
+            out_vals[j] = (0.0 if name in ("sum", "nansum")
+                           and data.values.get(a) == 0.0 else None)
+        return [_Pad(values=out_vals, diffuse=data.diffuse,
+                     dvalue=data.dvalue)]
 
     def _op_dot(self, h):
         lhs, rhs = h.ins[0], h.ins[1]
@@ -466,14 +655,15 @@ class PaddingSoundnessPass(AnalysisPass):
         r_con = len(rs) - 1 if tb else 0
         contracted_pad = (l_con in lhs.axes) or (r_con in rhs.axes)
         if contracted_pad:
-            if (lhs.zero or not lhs.axes) and (rhs.zero or not rhs.axes):
+            if _contract_absorbed(lhs, l_con, rhs, r_con):
                 h.emit("dot contracts a still-zero padded axis: exact "
                        "(zero terms absorb), but parameter operands "
                        "would pin their shape to the bucket extent",
                        severity=Severity.INFO, mixes=False)
             else:
+                repair = _contract_repair(lhs, l_con, rhs, r_con)
                 h.emit("dot contracts the padded axis with nonzero pad "
-                       "slots: live outputs absorb them")
+                       "slots: live outputs absorb them", repair=repair)
         out_axes = set()
         l_keep = [i for i in range(len(ls)) if i != l_con]
         for pos, i in enumerate(l_keep):
@@ -499,13 +689,15 @@ class PaddingSoundnessPass(AnalysisPass):
         l_con = len(ls) - (2 if h.attrs.get("transpose_a") else 1)
         r_con = len(rs) - (1 if h.attrs.get("transpose_b") else 2)
         if (l_con in lhs.axes) or (r_con in rhs.axes):
-            if (lhs.zero or not lhs.axes) and (rhs.zero or not rhs.axes):
+            if _contract_absorbed(lhs, l_con, rhs, r_con):
                 h.emit("batch_dot contracts a still-zero padded axis: "
                        "exact (zero terms absorb)",
                        severity=Severity.INFO, mixes=False)
             else:
+                repair = _contract_repair(lhs, l_con, rhs, r_con)
                 h.emit("batch_dot contracts the padded axis with "
-                       "nonzero pad slots: live outputs absorb them")
+                       "nonzero pad slots: live outputs absorb them",
+                       repair=repair)
         out_axes = set()
         for a in lhs.axes | rhs.axes:
             if a < len(ls) - 2:
@@ -687,17 +879,59 @@ class PaddingSoundnessPass(AnalysisPass):
     def _op_sequence_mask(self, h):
         data = h.ins[0]
         if not h.attrs.get("use_sequence_length"):
-            return [_Pad(data.axes, data.zero, data.diffuse)]  # identity
+            return [_Pad(values=data.values, diffuse=data.diffuse,
+                         dvalue=data.dvalue)]               # identity
         # masks positions past sequence_length along the time axis with
-        # `value`: value=0 RESTORES the zero invariant on that axis,
-        # any other value DESTROYS it (pad slots become `value`)
+        # `value`.  When the lengths input is the designated per-request
+        # valid-length variable (the repair engine's mask driver, or a
+        # variable stamped __pad_valid_len__=<label>), every pad slot
+        # along the masked axis afterwards holds exactly `value` — the
+        # neutral-element fact downstream softmax/sum/max rules key on.
+        # Any other lengths source only gets the historical benefit of
+        # the doubt for value=0 (restoring the zero invariant).
         ax = int(h.attrs.get("axis", 0))
         val = float(h.attrs.get("value", 0.0) or 0.0)
-        if ax in data.axes:
-            zero = val == 0.0
-        else:
-            zero = data.zero
-        return [_Pad(data.axes, zero, data.diffuse)]
+        values = dict(data.values)
+        sl_node = h.node.inputs[1][0] if len(h.node.inputs) > 1 else None
+        sl_state = h.ins[1] if len(h.ins) > 1 else _EMPTY
+        # the lengths vector is indexed by the batch axis (axis 1 in
+        # the reference (T, B, ...) layout when masking axis 0, axis 0
+        # otherwise): pad positions carried BY the lengths input land
+        # on that axis of the output, row-locally (row i's mask reads
+        # lengths[i] only)
+        batch_ax = 1 if ax == 0 else 0
+        if sl_state.carries:
+            # rows whose length entry is itself a pad slot read a
+            # garbage length: the row stays in place (row-local) but
+            # its value is only known when data and mask value agree
+            values[batch_ax] = val if values.get(batch_ax) == val else None
+        if ax in values:
+            # the masked tensor must really be request-indexed at axis
+            # 0 — a shape coincidence (leading dim == batch extent on
+            # a transposed layout) is not enough, so the batch label's
+            # abstract state at the data input is consulted too
+            data_key = (id(h.node.inputs[0][0]), h.node.inputs[0][1])
+            bst = (h.batch_states or {}).get(data_key)
+            authoritative = (
+                h.valid_len_name is not None and sl_node is not None
+                and sl_node.op is None
+                and sl_node.name == h.valid_len_name
+                and ax != 0
+                and h.in_shapes[0] is not None
+                and h.in_shapes[1] is not None
+                and tuple(h.in_shapes[1]) == (h.in_shapes[0][0],)
+                and bst is not None and not bst.diffuse
+                and bst.axes == frozenset({0}))
+            if authoritative:
+                values[ax] = val
+                h.emit("SequenceMask driven by the designated valid-"
+                       "length input %r pins pad slots along axis %d "
+                       "to %s" % (h.valid_len_name, ax, val),
+                       severity=Severity.INFO, mixes=False)
+            else:
+                values[ax] = 0.0 if val == 0.0 else None
+        return [_Pad(values=values, diffuse=data.diffuse,
+                     dvalue=data.dvalue)]
 
     def _op_rnn(self, h):
         data = h.ins[0]
@@ -815,16 +1049,19 @@ _HANDLERS = {
 # ---------------------------------------------------------------------------
 
 def classify_padding(symbol, data_shapes, pad_axes, training=False,
-                     policy=None):
+                     policy=None, valid_lengths=None):
     """Run verify+shapes+padding; returns (verdicts, report).
 
     ``pad_axes``: {label: {input name: graph axis}}.  Verdict per label
     is "row-local" or "cross-position"; a structurally broken graph
     yields no verdicts (the report carries the errors).
+    ``valid_lengths``: optional {label: input name} designating the
+    per-request live-length input masking rewrites key on (repaired
+    graphs also self-declare it via ``__pad_valid_len__`` markers).
     """
     from .core import analyze
     report, ctx = analyze(symbol, data_shapes=data_shapes,
                           pad_axes=pad_axes, training=training,
-                          policy=policy,
+                          policy=policy, valid_lengths=valid_lengths,
                           passes=("verify", "shapes", "padding"))
     return dict(ctx.pad_verdicts), report

@@ -135,6 +135,15 @@ VARIABLES = {v.name: v for v in [
          "MXNetError: malformed graphs refuse to build, and a serving "
          "graph classified cross-position along a padded axis refuses "
          "the unsound bucketing instead of degrading it."),
+    _Var("MXNET_SERVE_REPAIR", bool, True,
+         "Attempt an automatic masking repair (analysis/rewrite.py) "
+         "before degrading a serving graph the padding pass classifies "
+         "cross-position along the bucketed seq axis: SequenceMask "
+         "nodes driven by a per-request valid-length input neutralize "
+         "pad slots (-inf for softmax, 0 for sums, renormalized count "
+         "for mean), and the repair is adopted only when re-analysis "
+         "verdicts the rewritten graph row-local.  0 = always degrade "
+         "as before (exact-length programs / max_batch=1)."),
     _Var("MXNET_SERVE_PAD_CHECK", bool, False,
          "Runtime padding-soundness probe (debug; doubles dispatch "
          "cost): every serving batch is dispatched twice — zero pads "

@@ -1225,14 +1225,20 @@ def _seq_len_or_full(use_len, seq_len, x):
 def sequence_mask(attrs, data, seq_len=None):
     if not attrs["use_sequence_length"]:
         return data
-    ax = attrs["axis"]  # time axis: 0 or 1
+    # time axis: 0 keeps the reference (T, B, ...) layout; any axis >= 1
+    # assumes batch at axis 0 (the generalization analysis/rewrite.py
+    # splices masks through — e.g. axis 2 of (B, T_q, T_k) attention
+    # scores).  axis=1 reduces to the reference (B, T, ...) behaviour.
+    ax = attrs["axis"]
     T = data.shape[ax]
     steps = jnp.arange(T)
+    sl = seq_len.astype(jnp.int32)
     if ax == 0:
-        mask = steps[:, None] < seq_len.astype(jnp.int32)[None, :]
+        mask = steps[:, None] < sl[None, :]
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
     else:
-        mask = steps[None, :] < seq_len.astype(jnp.int32)[:, None]
-    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+        mask = (steps.reshape((1,) * ax + (T,) + (1,) * (data.ndim - ax - 1))
+                < sl.reshape((sl.shape[0],) + (1,) * (data.ndim - 1)))
     return jnp.where(mask, data, jnp.asarray(attrs["value"], data.dtype))
 
 

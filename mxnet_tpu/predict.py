@@ -31,11 +31,11 @@ def _verify_graph(symbol, what):
     bind/dispatch.  Warn by default; MXNET_ANALYSIS_STRICT=1 raises."""
     if not config.get("MXNET_ANALYSIS_ON"):
         return
-    from .analysis import verify, AnalysisError
+    from .analysis import verify
     report = verify(symbol)
     if not report.ok:
         if config.get("MXNET_ANALYSIS_STRICT"):
-            raise AnalysisError(report.format())
+            report.raise_if_errors()    # message names the failing pass
         warnings.warn("%s: graph verification failed:\n%s"
                       % (what, report.format()))
 

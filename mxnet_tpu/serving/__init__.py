@@ -20,10 +20,11 @@ Quick start::
 from .admission import (AdmissionController, Request, QueueFullError,
                         DeadlineExceededError, ServerOverloadError,
                         EngineClosedError)
-from .buckets import BucketPolicy, ProgramCache
+from .buckets import BucketPolicy, ProgramCache, pad_valid_lengths
 from .engine import ServingEngine
 
 __all__ = ["ServingEngine", "BucketPolicy", "ProgramCache",
+           "pad_valid_lengths",
            "AdmissionController", "Request", "QueueFullError",
            "DeadlineExceededError", "ServerOverloadError",
            "EngineClosedError"]

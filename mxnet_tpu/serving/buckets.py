@@ -30,7 +30,7 @@ from ..base import MXNetError
 from ..cached_op import CachedOp
 from ..predict import _infer_label_shapes, _label_like
 
-__all__ = ["BucketPolicy", "ProgramCache"]
+__all__ = ["BucketPolicy", "ProgramCache", "pad_valid_lengths"]
 
 
 def _next_pow2(n):
@@ -38,6 +38,23 @@ def _next_pow2(n):
     while p < n:
         p <<= 1
     return p
+
+
+def pad_valid_lengths(lengths, bucket):
+    """Batch-pad a per-request live-length vector onto the bucket grid.
+
+    The repaired-graph dispatch contract (analysis/rewrite.py): slot i
+    carries request i's live extent along the repaired axis; the pad
+    rows carry 0, so every spliced SequenceMask masks them entirely —
+    a pad row can never leak into live rows no matter what garbage the
+    zero-padded data slots hold.  Lengths are ALWAYS float32 — no
+    dtype knob on purpose: the spliced variable declares float32, and
+    a half-precision dtype would round large lengths onto the wrong
+    mask boundary (float16 cannot represent 2049).
+    """
+    out = np.zeros((bucket,), dtype=np.float32)
+    out[:len(lengths)] = lengths
+    return out
 
 
 class BucketPolicy(object):

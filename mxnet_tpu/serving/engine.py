@@ -32,12 +32,11 @@ trace id via ``tools/telemetry_dump.py``.
 Env knobs (config.py): ``MXNET_SERVE_MAX_BATCH``,
 ``MXNET_SERVE_MAX_QUEUE``, ``MXNET_SERVE_BATCH_TIMEOUT_MS``,
 ``MXNET_SERVE_DEFAULT_DEADLINE_MS``, ``MXNET_SERVE_OVERLOAD_POLICY``,
-``MXNET_SERVE_SEQ_BUCKETS``.
+``MXNET_SERVE_SEQ_BUCKETS``, ``MXNET_SERVE_REPAIR``.
 """
 from __future__ import annotations
 
 import collections
-import hashlib
 import itertools
 import math
 import threading
@@ -53,7 +52,7 @@ from .. import profiler
 from .. import telemetry as _telemetry
 from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
-from .buckets import BucketPolicy, ProgramCache
+from .buckets import BucketPolicy, ProgramCache, pad_valid_lengths
 
 __all__ = ["ServingEngine"]
 
@@ -68,6 +67,12 @@ def _percentile(sorted_vals, q):
 # distinct shape signatures tracked as individual label values before
 # spilling into the catch-all "other" series (label cardinality bound)
 _MAX_SIG_LABELS = 64
+
+# hazard fingerprints carried verbatim in the retraces `hazards` label
+# before the overflow marker takes over (label length bound); overflow
+# is EXPLICIT ("...,+3") — tools/hazard_rank.py must be able to tell a
+# truncated label from "these are all the hazards"
+_MAX_HAZARD_LABEL_FPS = 16
 
 # per-process engine ordinal: the `engine` label on point-in-time
 # gauges, so co-resident engines get distinct series
@@ -137,8 +142,10 @@ class _EngineTelemetry(object):
             "post-warmup XLA traces on serving dispatches — the "
             "compile-once contract demands this stays 0; the hazards "
             "label carries the retrace-linter fingerprints of the "
-            "graph's statically known hazards",
-            labelnames=("hazards",))
+            "graph's statically known hazards, per engine, so "
+            "tools/hazard_rank.py can credit each fingerprint with "
+            "its own engine's traffic exposure",
+            labelnames=("engine", "hazards"))
         self.shape_seen = reg.counter(
             "mxnet_serve_shape_signature_total",
             "requests per observed (bucket-padded) input-shape "
@@ -185,13 +192,29 @@ class _EngineTelemetry(object):
             "engine", labelnames=("engine",))
         self.compile_count = compile_count_fam.labels(
             engine=self.engine_label)
+        self.repairs_applied = reg.counter(
+            "mxnet_serve_repairs_applied_total",
+            "construction-time masking rewrites adopted (verdict "
+            "flipped row-local) per padded axis and frontier op — "
+            "each count is one SequenceMask splice / mean renorm the "
+            "engine now serves through instead of degrading",
+            labelnames=("engine", "axis", "op"))
+        self.repairs_rejected = reg.counter(
+            "mxnet_serve_repairs_rejected_total",
+            "construction-time repair attempts whose rewritten graph "
+            "did not re-verify row-local: the engine fell back to the "
+            "degrade path (exact-length programs / max_batch=1)",
+            labelnames=("engine",))
         self._engine_gauge_fams = (queue_depth_fam, cache_hits_fam,
                                    cache_misses_fam, compile_count_fam,
                                    entropy_fam)
         # pre-touch the retrace series under this graph's hazard label
         # so a healthy engine scrapes an explicit 0 (absence of the
-        # series would be indistinguishable from "not instrumented")
-        self.retraces.labels(hazards=engine._hazard_label)
+        # series would be indistinguishable from "not instrumented" —
+        # and the zero-count series is how the offline ranker knows a
+        # lint fingerprint is DEPLOYED)
+        self.retraces.labels(engine=self.engine_label,
+                             hazards=engine._hazard_label)
         self._engine = weakref.ref(engine)
         reg.register_callback(self._refresh)
 
@@ -207,9 +230,11 @@ class _EngineTelemetry(object):
     def _remove_engine_series(self):
         for fam in self._engine_gauge_fams:
             fam.remove(engine=self.engine_label)
-        for values, _inst in self.shape_seen.series():
-            if values[0] == self.engine_label:
-                self.shape_seen.remove(*values)
+        for fam in (self.shape_seen, self.retraces,
+                    self.repairs_applied, self.repairs_rejected):
+            for values, _inst in fam.series():
+                if values[0] == self.engine_label:
+                    fam.remove(*values)
 
     def _refresh(self, reg):
         """Collect-time callback: mirror engine-owned state into gauges
@@ -270,11 +295,20 @@ class ServingEngine(object):
         self._data_shapes = {k: tuple(v) for k, v in dict(data_shapes).items()}
         self._dtype = np.dtype(dtype)
         # static pre-flight: IR verifier + padding-soundness over the
-        # axes this engine will zero-pad.  A cross-position graph gets
-        # its unsound bucketing REFUSED (strict) or de-fanged (warn +
-        # fall back to exact-shape dispatch) instead of silently
-        # returning contaminated values (ROADMAP padded-axis item).
+        # axes this engine will zero-pad.  A cross-position graph first
+        # gets a masking REPAIR attempt (analysis/rewrite.py splices
+        # SequenceMask nodes driven by a per-request valid-length
+        # input; adopted only if re-analysis verdicts the rewritten
+        # graph row-local) and only then has its unsound bucketing
+        # REFUSED (strict) or de-fanged (warn + fall back to
+        # exact-shape dispatch) instead of silently returning
+        # contaminated values (ROADMAP padded-axis + auto-masking items).
         self.analysis_report = None
+        self.repair_plan = None          # accepted RepairPlan, if any
+        self._repair_rejected = None     # rejection reason, if attempted
+        self._serve_sym = symbol         # what the ProgramCache compiles
+        self._valid_name = None          # repaired graphs' extra input
+        self._length_sources = {}        # input name -> per-example axis
         self._hazard_label = "none"
         self.hazard_fingerprints = {}
         self._pad_check = config.get("MXNET_SERVE_PAD_CHECK")
@@ -284,6 +318,8 @@ class ServingEngine(object):
         # branch below gates on that, keeping the disabled hot path at
         # zero registry calls per request
         self._tm = _EngineTelemetry(self) if _telemetry.enabled() else None
+        if self._tm is not None:
+            self._record_repair_telemetry()
         self._trace_sample = (_telemetry.trace_sample_every()
                               if self._tm is not None else 0)
         self._req_seq = itertools.count()
@@ -296,9 +332,11 @@ class ServingEngine(object):
                                         overload_policy=overload_policy,
                                         wake_hint=self._policy.max_batch,
                                         telemetry=self._tm)
-        self._cache = ProgramCache(symbol, arg_params, aux_params,
-                                   list(self._data_shapes), ctx=ctx,
-                                   dtype=dtype)
+        data_names = list(self._data_shapes)
+        if self._valid_name is not None:
+            data_names.append(self._valid_name)
+        self._cache = ProgramCache(self._serve_sym, arg_params, aux_params,
+                                   data_names, ctx=ctx, dtype=dtype)
         self._lock = threading.Lock()
         self._group_cache = {}   # exact input shapes -> validated group
         self._lat_ms = collections.deque(maxlen=4096)
@@ -313,9 +351,15 @@ class ServingEngine(object):
     def _preflight(self, symbol, strict):
         """Construction-time static analysis (mxnet_tpu.analysis).
 
-        Verifier errors and cross-position verdicts raise under
-        ``MXNET_ANALYSIS_STRICT``; otherwise they warn, and the engine
-        degrades the affected bucketing to stay sound:
+        Verifier errors raise under ``MXNET_ANALYSIS_STRICT``; a
+        cross-position verdict along the bucketed **seq** axis first
+        gets an automatic masking repair attempt (MXNET_SERVE_REPAIR,
+        on by default): analysis/rewrite.py splices SequenceMask nodes
+        driven by a new per-request valid-length input, and the
+        rewritten graph is adopted ONLY when re-running
+        verify+shapes+padding flips the verdict to row-local.  When the
+        repair is rejected (or disabled) the engine degrades the
+        affected bucketing to stay sound, exactly as before:
 
         - cross-position along **seq**: seq buckets are dropped — each
           exact length compiles its own program (correct, more traces);
@@ -323,48 +367,66 @@ class ServingEngine(object):
           all (``max_batch=1``) — with positions mixing across the
           batch axis, even unpadded batching would blend requests.
         """
-        from ..analysis import check_serving_graph, AnalysisError
-        verdicts, report = check_serving_graph(
-            symbol, self._data_shapes, self._policy)
+        from .. import config
+        from ..analysis import (check_serving_graph, repair_serving_graph,
+                                AnalysisError)
+        verdicts, report, ctx = check_serving_graph(
+            symbol, self._data_shapes, self._policy, with_ctx=True)
         self.analysis_report = report
         # fingerprint the retrace-linter's hazard findings: runtime
         # retrace events are counted under these labels, tying an
         # observed compile storm back to the static warning that
         # predicted it (ROADMAP: rank hazards by observed traffic)
-        for d in report.warnings:
-            if d.pass_name != "retrace":
-                continue
-            fp = hashlib.sha1(
-                ("%s|%s|%s" % (d.node, d.op, d.message.split(":")[0]))
-                .encode()).hexdigest()[:8]
-            self.hazard_fingerprints.setdefault(fp, str(d))
-        if self.hazard_fingerprints:
-            self._hazard_label = ",".join(
-                sorted(self.hazard_fingerprints)[:4])
+        self._harvest_hazards(report)
         if report.errors:
             if strict:
-                raise AnalysisError(report.format())
+                report.raise_if_errors()    # names the failing passes
             warnings.warn("ServingEngine: graph verification failed:\n%s"
                           % report.format())
         cross = [lb for lb, v in verdicts.items() if v == "cross-position"]
         if not cross:
             return
+        if "seq" in cross and config.get("MXNET_SERVE_REPAIR") \
+                and not report.errors:
+            plan = repair_serving_graph(symbol, self._data_shapes,
+                                        self._policy,
+                                        precomputed=(report, ctx))
+            if plan.accepted:
+                # serve the rewritten graph from the full bucket grid;
+                # dispatch feeds the per-request live lengths that
+                # drive the spliced masks (see _dispatch)
+                self.repair_plan = plan
+                self._serve_sym = plan.symbol
+                self._valid_name = plan.valid_length_name
+                self._length_sources = dict(plan.length_sources)
+                cross.remove("seq")
+                if not cross:
+                    return
+            else:
+                self._repair_rejected = plan.reason
         detail = "\n".join(
             "  " + str(d) for d in report.warnings) or "  (see report)"
         if strict:
             raise AnalysisError(
-                "ServingEngine: graph is cross-position along padded "
-                "axis(es) %s — zero-pad slots would bleed into live "
-                "outputs:\n%s" % (cross, detail))
+                "[padding] ServingEngine: graph is cross-position along "
+                "padded axis(es) %s — zero-pad slots would bleed into "
+                "live outputs%s:\n%s"
+                % (cross,
+                   " (repair rejected: %s)" % self._repair_rejected
+                   if self._repair_rejected else "", detail))
         if "seq" in cross:
             warnings.warn(
                 "ServingEngine: graph is cross-position along the "
-                "bucketed seq axis; disabling seq buckets (lengths "
+                "bucketed seq axis%s; disabling seq buckets (lengths "
                 "still vary per request, but each exact length now "
-                "compiles its own program):\n%s" % detail)
+                "compiles its own program):\n%s"
+                % (" and the masking repair was rejected (%s)"
+                   % self._repair_rejected if self._repair_rejected
+                   else "", detail))
             self._policy = BucketPolicy(
                 max_batch=self._policy.max_batch,
                 seq_axis=self._policy.seq_axis, seq_buckets=())
+            self._collect_seq_hazards()
         if "batch" in cross:
             warnings.warn(
                 "ServingEngine: graph mixes positions across the BATCH "
@@ -373,6 +435,62 @@ class ServingEngine(object):
             self._policy = BucketPolicy(
                 max_batch=1, seq_axis=self._policy.seq_axis,
                 seq_buckets=self._policy.seq_buckets)
+
+    def _harvest_hazards(self, report):
+        """Fold the report's retrace-linter warnings into this engine's
+        hazard fingerprints (the ``hazards`` label on runtime retrace
+        counts, and the offline ranker's join key)."""
+        from ..analysis import hazard_fingerprint
+        for d in report.warnings:
+            if d.pass_name != "retrace":
+                continue
+            fp = hazard_fingerprint(d.node, d.op, d.message)
+            self.hazard_fingerprints.setdefault(fp, str(d))
+        if self.hazard_fingerprints:
+            fps = sorted(self.hazard_fingerprints)
+            label = fps[:_MAX_HAZARD_LABEL_FPS]
+            if len(fps) > _MAX_HAZARD_LABEL_FPS:
+                # no silent caps: the overflow count rides the label so
+                # the offline ranker knows attribution is incomplete
+                label.append("+%d" % (len(fps) - _MAX_HAZARD_LABEL_FPS))
+            self._hazard_label = ",".join(label)
+
+    def _collect_seq_hazards(self):
+        """Exact-length degrade mode IS the retrace linter's
+        unbucketed-dynamic-dim hazard (one compiled program per
+        observed length, unbounded under real traffic) — invisible to
+        the construction-time lint, which saw concrete bucket shapes.
+        Re-run the linter at the degraded policy with the seq axis
+        declared dynamic so the engine's runtime retrace counter
+        carries the SAME fingerprints a ``graph_lint --json`` report
+        yields — tools/hazard_rank.py joins the two."""
+        from ..analysis import analyze
+        shapes = {}
+        for name, ex in self._data_shapes.items():
+            s = [0 if ax == self._policy.seq_axis else d
+                 for ax, d in enumerate(ex)]
+            shapes[name] = (self._policy.max_batch,) + tuple(s)
+        try:
+            report, _ = analyze(self._sym, data_shapes=shapes,
+                                policy=self._policy,
+                                passes=("verify", "shapes", "retrace"))
+        except Exception:
+            return                      # advisory only: never block
+        self._harvest_hazards(report)
+
+    def _record_repair_telemetry(self):
+        """Mirror the construction-time repair outcome into the
+        registry (mxnet_serve_repairs_*_total): runs once, right after
+        the telemetry bundle exists — _preflight decided the outcome
+        before the bundle was built."""
+        tm = self._tm
+        if self.repair_plan is not None:
+            for a in self.repair_plan.actions:
+                tm.repairs_applied.labels(
+                    engine=tm.engine_label,
+                    axis=self.repair_plan.label, op=a.op).inc()
+        if self._repair_rejected is not None:
+            tm.repairs_rejected.labels(engine=tm.engine_label).inc()
 
     @classmethod
     def from_checkpoint(cls, prefix, epoch, data_shapes, **kwargs):
@@ -453,6 +571,20 @@ class ServingEngine(object):
                         % (name, ax, got, want))
             padded = self._policy.example_shape(x.shape)
             group.append((name, padded))
+        if self._length_sources:
+            # repaired graph: every input the repaired axis pads must
+            # agree on ONE live length per request — reject the
+            # offending request HERE, at submit, so it cannot fail the
+            # whole coalesced batch at dispatch (_live_length is the
+            # backstop)
+            lens = {feeds[n].shape[ax]
+                    for n, ax in self._length_sources.items()}
+            if len(lens) > 1:
+                raise MXNetError(
+                    "repaired-graph request needs ONE live length, but "
+                    "its inputs disagree along the repaired axis: %s"
+                    % {n: feeds[n].shape[ax]
+                       for n, ax in sorted(self._length_sources.items())})
         # With seq bucketing, outputs must be sliced back to exactly what
         # the graph would produce at the UNPADDED input — inferred from
         # the symbol, never guessed from axis sizes (an output axis that
@@ -623,6 +755,15 @@ class ServingEngine(object):
                 arr[(i,) + tuple(slice(0, d) for d in x.shape)] = x
                 live_elems += x.size
             feeds[name] = arr
+        padded_elems = sum(arr.size for arr in feeds.values())
+        if self._valid_name is not None:
+            # repaired graph: feed each request's live length so the
+            # spliced masks neutralize exactly the pad slots (pad rows
+            # carry 0 -> fully masked).  Always float32 — the model
+            # dtype must not round lengths (float16 cannot represent
+            # 2049), and the spliced variable declares float32
+            feeds[self._valid_name] = pad_valid_lengths(
+                [self._live_length(r) for r in reqs], b)
         c0 = self._cache.compile_count
         t_disp0 = time.perf_counter()
         with profiler.record_span("serve.dispatch[b=%d,n=%d]" % (b, n),
@@ -660,13 +801,12 @@ class ServingEngine(object):
             tm.dispatch_ms.observe((t_disp1 - t_disp0) * 1e3)
             for r in reqs:
                 tm.latency.observe((now - r.t_enqueue) * 1e3)
-            padded = sum(arr.size for arr in feeds.values())
             bucket = str(b)
-            tm.padded_elems.labels(bucket=bucket).inc(padded)
+            tm.padded_elems.labels(bucket=bucket).inc(padded_elems)
             tm.live_elems.labels(bucket=bucket).inc(live_elems)
-            if padded:
+            if padded_elems:
                 tm.pad_waste.labels(bucket=bucket).observe(
-                    1.0 - live_elems / float(padded))
+                    1.0 - live_elems / float(padded_elems))
         if profiler.is_running():
             profiler.counter("serve.batch_occupancy", n / float(b))
 
@@ -694,6 +834,7 @@ class ServingEngine(object):
                 self._retraces += compiled
                 if tm is not None:
                     tm.retraces.labels(
+                        engine=tm.engine_label,
                         hazards=self._hazard_label).inc(compiled)
         self._dispatched_keys.add(key)
         return compiled
@@ -721,6 +862,23 @@ class ServingEngine(object):
         tc.add("unpad", t_u0, t_u1, "serve")
         tc.finish(t_u1)
 
+    def _live_length(self, req):
+        """One request's live extent along the repaired axis, read off
+        its unpadded inputs.  Every input the repaired label pads must
+        agree — they share the one padded source axis the masks
+        neutralize; disagreement would silently mask the wrong slots,
+        so it fails the batch instead."""
+        lengths = {req.inputs[n].shape[ax]
+                   for n, ax in self._length_sources.items()}
+        if len(lengths) != 1:
+            raise MXNetError(
+                "repaired-graph dispatch needs ONE live length per "
+                "request, but the padded inputs disagree along the "
+                "repaired axis: %s"
+                % {n: req.inputs[n].shape[ax]
+                   for n, ax in sorted(self._length_sources.items())})
+        return lengths.pop()
+
     def _pad_probe(self, feeds, reqs):
         """MXNET_SERVE_PAD_CHECK: dispatch twice via the ProgramCache
         probe hook and require bitwise-equal live regions (see
@@ -729,9 +887,15 @@ class ServingEngine(object):
         live_masks = {}
         for name, arr in feeds.items():
             mask = np.zeros(arr.shape, dtype=bool)
-            for i, r in enumerate(reqs):
-                x = r.inputs[name]
-                mask[(i,) + tuple(slice(0, d) for d in x.shape)] = True
+            if name == self._valid_name:
+                # the lengths vector's live slots are the first n rows;
+                # perturbing its PAD entries scrambles only pad-row
+                # masks, which a sound repair keeps out of live rows
+                mask[:len(reqs)] = True
+            else:
+                for i, r in enumerate(reqs):
+                    x = r.inputs[name]
+                    mask[(i,) + tuple(slice(0, d) for d in x.shape)] = True
             live_masks[name] = mask
         base, probed = self._cache.run_pad_probe(feeds, live_masks)
         for j, (o0, o1) in enumerate(zip(base, probed)):
@@ -780,6 +944,10 @@ class ServingEngine(object):
             for bb in self._policy.batch_buckets():
                 feeds = {name: np.zeros((bb,) + ex, dtype=self._dtype)
                          for name, ex in shapes.items()}
+                if self._valid_name is not None:
+                    # all-pad lengths: the compiled program is the
+                    # same; the outputs are discarded
+                    feeds[self._valid_name] = pad_valid_lengths([], bb)
                 with profiler.record_span(
                         "serve.warmup[b=%d]" % bb, "serve"):
                     self._cache.run(feeds)
@@ -800,9 +968,12 @@ class ServingEngine(object):
         (queue depth + cumulative rejected/shed/expired — the same
         numbers the mxnet_serve_* telemetry gauges/counters carry),
         dispatch/occupancy aggregates, program-cache traffic, retrace
-        count, and request latency percentiles (ms) over the last
-        ≤4096 completions.  An empty latency window reports zeros for
-        every latency field, never NaN or an exception."""
+        count, the construction-time repair outcome (``repairs``:
+        actions applied / rejection reason / the valid-length input a
+        repaired graph is fed), and request latency percentiles (ms)
+        over the last ≤4096 completions.  An empty latency window
+        reports zeros for every latency field, never NaN or an
+        exception."""
         snap = self._adm.stats()
         with self._lock:
             lat = sorted(self._lat_ms)
@@ -818,6 +989,13 @@ class ServingEngine(object):
                                   "misses": self._cache.plan_misses},
                 "bucket_keys": len(self._cache.bucket_keys),
                 "max_batch": self._policy.max_batch,
+                "repairs": {
+                    "applied": (len(self.repair_plan.actions)
+                                if self.repair_plan is not None else 0),
+                    "rejected": 1 if self._repair_rejected else 0,
+                    "valid_length_input": self._valid_name,
+                    "reason": self._repair_rejected,
+                },
                 "latency_ms": {
                     "count": len(lat),
                     "mean": float(np.mean(lat)) if lat else 0.0,

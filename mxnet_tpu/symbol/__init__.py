@@ -2,7 +2,7 @@
 import sys as _sys
 
 from .symbol import (Symbol, var, Variable, Group, load, load_json, zeros,
-                     ones)
+                     ones, copy_graph)
 from . import register as _register
 
 _register.attach_methods()

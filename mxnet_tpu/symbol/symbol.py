@@ -26,7 +26,7 @@ from ..ops import get_op
 from ..ops.registry import OpDef
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json", "zeros",
-           "ones"]
+           "ones", "copy_graph"]
 
 
 class SymNode:
@@ -389,6 +389,31 @@ class Symbol:
                     new_inputs.append((inp, ix))
             node.inputs = new_inputs
         return s
+
+
+# ---------------------------------------------------------------------------
+# graph rebuild (the splice API analysis/rewrite.py edits through)
+# ---------------------------------------------------------------------------
+
+def copy_graph(symbol):
+    """Structural deep copy of the DAG behind ``symbol``.
+
+    Unlike the JSON round-trip (``load_json(sym.tojson())``) this keeps
+    python-typed attr values verbatim (no string round-trip) and returns
+    the ``{id(old node): clone}`` map, so a caller holding references
+    into the original graph — e.g. the repair engine, whose violation
+    records point at original nodes — can find the clone to edit.
+    Clones are ordinary mutable :class:`SymNode` objects; edits to them
+    never touch the source graph.
+    """
+    topo = _topo(symbol._outputs)
+    mapping = {}
+    for n in topo:
+        clone = SymNode(n.op, n.name, dict(n.attrs),
+                        [(mapping[id(i)], ix) for (i, ix) in n.inputs])
+        mapping[id(n)] = clone
+    heads = [(mapping[id(n)], ix) for (n, ix) in symbol._outputs]
+    return Symbol(heads), mapping
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,491 @@
+"""Verdict-driven graph repair (mxnet_tpu/analysis/rewrite.py).
+
+Coverage per the subsystem contract: a cross-position seq graph that
+PR 2 could only degrade (exact-length programs) is repaired — masks
+spliced, verdict re-verified row-local — and then SERVES from the pow2
+seq-bucket grid with zero warm retraces and bitwise the answers a
+batch-1 Predictor gives at each exact length; repair-rejected graphs
+still degrade exactly as before; the MXNET_SERVE_PAD_CHECK sentinel
+probe stays silent on repaired programs; repair telemetry counts and
+is reclaimed at close().
+"""
+import warnings as _w
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis, serving, telemetry
+from mxnet_tpu.serving import BucketPolicy
+
+
+def _predictor_ref(net, params, x):
+    """Batch-1 Predictor answer at the request's exact length."""
+    pred = mx.predict.Predictor(net, params, {}, {"data": (1,) + x.shape},
+                                ctx=mx.cpu())
+    out = pred.forward(data=x[None])
+    return [out.get_output(i)[0] for i in range(len(net))]
+
+
+def _seq_engine(net, params, ex_shape, seq_buckets=(4,), max_batch=2,
+                **kw):
+    policy = BucketPolicy(max_batch=max_batch, seq_axis=0,
+                          seq_buckets=seq_buckets)
+    return serving.ServingEngine(net, params, {}, {"data": ex_shape},
+                                 ctx=mx.cpu(), policy=policy,
+                                 batch_timeout_ms=2.0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# plan level
+# ---------------------------------------------------------------------------
+
+def test_plan_softmax_seq_flips_verdict_and_roundtrips_json():
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=1, name="sm_seq")
+    policy = BucketPolicy(max_batch=2, seq_axis=0, seq_buckets=(4,))
+    plan = analysis.repair_serving_graph(net, {"data": (4, 3)}, policy)
+    assert plan.accepted, plan.reason
+    assert plan.verdict_before == "cross-position"
+    assert plan.verdict_after == "row-local"
+    assert plan.valid_length_name in plan.symbol.list_arguments()
+    assert plan.length_sources == {"data": 0}
+    assert [(a[0], a[2]) for a in plan.actions] == [("sm_seq", "mask")]
+    assert "ACCEPTED" in plan.describe()
+    # the repaired symbol is self-describing: after a JSON round trip
+    # (including the -inf mask value and the __pad_valid_len__ marker)
+    # the padding pass re-discovers the valid-length input on its own
+    loaded = mx.sym.load_json(plan.symbol.tojson())
+    verdicts, report = analysis.classify_padding(
+        loaded, {"data": (2, 4, 3),
+                 plan.valid_length_name: (2,)},
+        {"batch": {"data": 0, plan.valid_length_name: 0},
+         "seq": {"data": 1}})
+    assert verdicts["seq"] == "row-local", report.format()
+    assert report.ok
+
+
+def test_plan_rejected_for_unrepairable_frontier():
+    """reverse along the padded seq axis reorders positions — no mask
+    can fix that; the plan must be rejected with the frontier named."""
+    net = mx.sym.reverse(mx.sym.Variable("data"), axis=1, name="rev")
+    policy = BucketPolicy(max_batch=2, seq_axis=0, seq_buckets=(4,))
+    plan = analysis.repair_serving_graph(net, {"data": (4, 3)}, policy)
+    assert not plan.accepted
+    assert plan.symbol is None
+    assert "rev" in plan.reason
+    assert "REJECTED" in plan.describe()
+
+
+def test_plan_rejected_without_seq_buckets():
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=1)
+    plan = analysis.repair_serving_graph(
+        net, {"data": (4, 3)}, BucketPolicy(max_batch=2))
+    assert not plan.accepted
+
+
+def test_user_mask_on_transposed_layout_not_trusted():
+    """A hand-authored SequenceMask whose data tensor carries batch at
+    axis 1 (but whose leading dim COINCIDES with the batch extent)
+    must not get the value-pinning benefit: lengths index axis 0, so
+    the mask would hit the wrong positions."""
+    d = mx.sym.Variable("data")                       # (B, C, T), C == B
+    vl = mx.sym.var("_pad_valid_len_seq", __pad_valid_len__="seq",
+                    dtype="float32")
+    t = mx.sym.transpose(d, axes=(1, 0, 2), name="t")
+    m = mx.sym.SequenceMask(t, vl, use_sequence_length=True,
+                            value=float("-inf"), axis=2, name="msk")
+    net = mx.sym.softmax(m, axis=2, name="sm")
+    spec = {"batch": {"data": 0, "_pad_valid_len_seq": 0},
+            "seq": {"data": 2}}
+    shapes = {"data": (2, 2, 4), "_pad_valid_len_seq": (2,)}
+    verdicts, _ = analysis.classify_padding(net, shapes, spec)
+    assert verdicts["seq"] == "cross-position"
+    # control: the untransposed layout IS trusted
+    m2 = mx.sym.SequenceMask(d, vl, use_sequence_length=True,
+                             value=float("-inf"), axis=2, name="msk2")
+    net2 = mx.sym.softmax(m2, axis=2, name="sm2")
+    v2, _ = analysis.classify_padding(net2, shapes, spec)
+    assert v2["seq"] == "row-local"
+
+
+def test_plan_rejected_when_splice_tensor_not_request_indexed():
+    """A splice-point tensor that dropped the batch pad entirely (sum
+    over the batch axis absorbs the zero pads, so no batch violation
+    fires) is no longer request-indexed: per-request lengths would
+    mask the wrong positions, so the layout guard must reject."""
+    d = mx.sym.Variable("data")
+    pooled = mx.sym.sum(d, axis=0, keepdims=True, name="bsum")
+    net = mx.sym.softmax(pooled, axis=1, name="sm")
+    plan = analysis.plan_repair(
+        net, {"data": (2, 4, 3)},
+        {"batch": {"data": 0}, "seq": {"data": 1}}, label="seq")
+    assert not plan.accepted
+    assert "request axis" in plan.reason
+
+
+def test_mean_repair_renormalizes_count():
+    """mean over the padded axis becomes sum(mask(x,0))/count: the
+    divisor must be the LIVE count, not the bucket extent."""
+    net = mx.sym.mean(mx.sym.Variable("data"), axis=1, name="pool")
+    policy = BucketPolicy(max_batch=2, seq_axis=0, seq_buckets=(4,))
+    plan = analysis.repair_serving_graph(net, {"data": (4, 3)}, policy)
+    assert plan.accepted, plan.reason
+    assert [(a[0], a[2]) for a in plan.actions] == [("pool", "mean")]
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((3, 3)).astype(np.float32)
+    feed = np.zeros((2, 4, 3), np.float32)
+    feed[0, :3] = x
+    out = plan.symbol.eval(
+        ctx=mx.cpu(), data=mx.nd.array(feed),
+        **{plan.valid_length_name: mx.nd.array([3.0, 0.0])})[0].asnumpy()
+    ref = _predictor_ref(net, {}, x)[0]
+    np.testing.assert_array_equal(out[0], ref)
+
+
+def test_inf_masked_contraction_is_not_absorbed():
+    """0 * inf = NaN: a -inf-masked operand contracted against a
+    zero-padded one must NOT classify as absorbed (the per-axis
+    absorption rule requires the non-zero side finite) — and the
+    repair engine fixes it by re-masking the -inf side to 0."""
+    data = mx.sym.Variable("data")
+    vl = mx.sym.var("_pad_valid_len_seq", __pad_valid_len__="seq",
+                    dtype="float32")
+    kt = mx.sym.transpose(data, axes=(0, 2, 1))
+    scores = mx.sym.batch_dot(data, kt, name="scores")
+    masked = mx.sym.SequenceMask(scores, vl, use_sequence_length=True,
+                                 value=float("-inf"), axis=2, name="msk")
+    net = mx.sym.batch_dot(masked, data, name="attn")
+    shapes = {"data": (2, 4, 3), "_pad_valid_len_seq": (2,)}
+    spec = {"batch": {"data": 0, "_pad_valid_len_seq": 0},
+            "seq": {"data": 1}}
+    verdicts, _ = analysis.classify_padding(net, shapes, spec)
+    assert verdicts["seq"] == "cross-position"
+    plan = analysis.plan_repair(net, shapes, spec, label="seq")
+    assert plan.accepted, plan.reason
+    # the repaired graph is NaN-free on live rows
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((3, 3)).astype(np.float32)
+    feed = np.zeros((2, 4, 3), np.float32)
+    feed[0, :3] = x
+    outs = plan.symbol.eval(
+        ctx=mx.cpu(), data=mx.nd.array(feed),
+        _pad_valid_len_seq=mx.nd.array([3.0, 0.0]))
+    live = outs[0].asnumpy()[0, :3]
+    assert np.isfinite(live).all()
+
+
+def test_valid_lengths_feed_stays_float32():
+    """The lengths vector must not ride the model dtype: float16 would
+    round large lengths onto the wrong mask boundary."""
+    from mxnet_tpu.serving import pad_valid_lengths
+    v = pad_valid_lengths([2049, 3], 4)
+    assert v.dtype == np.float32
+    assert v.tolist() == [2049.0, 3.0, 0.0, 0.0]
+    # a half-precision repaired engine still feeds float32 lengths:
+    # its live rows match the repaired symbol evaluated with f16 data
+    # + f32 lengths bitwise
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=1, name="sm_seq")
+    eng = _seq_engine(net, {}, (4, 3), start=False, dtype=np.float16)
+    assert eng.repair_plan is not None and eng.repair_plan.accepted
+    x = np.random.default_rng(6).standard_normal((3, 3)).astype(np.float16)
+    fut = eng.submit(x)
+    eng.start()
+    out = fut.result(timeout=60)
+    eng.close()
+    feed = np.zeros((1, 4, 3), np.float16)
+    feed[0, :3] = x
+    ref = eng.repair_plan.symbol.eval(
+        ctx=mx.cpu(), data=mx.nd.array(feed, dtype=np.float16),
+        **{eng.repair_plan.valid_length_name:
+           mx.nd.array([3.0], dtype=np.float32)})[0].asnumpy()
+    assert np.isfinite(ref[0, :3]).all()
+    np.testing.assert_array_equal(out, ref[0, :3])
+
+
+# ---------------------------------------------------------------------------
+# engine level — the acceptance bar
+# ---------------------------------------------------------------------------
+
+def test_repaired_seq_graph_serves_from_pow2_buckets_bitwise():
+    """THE acceptance criterion: softmax over the padded seq axis —
+    which PR 2 degraded to exact-length programs — now serves from the
+    pow2 seq-bucket grid with ZERO warm retraces and bitwise-identical
+    live rows vs the batch-1 Predictor."""
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=1, name="sm_seq")
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        eng = _seq_engine(net, {}, (8, 3), seq_buckets=(4, 8),
+                          start=False)
+    assert not caught                        # repair is not a warning
+    assert eng._policy.seq_buckets == (4, 8)  # buckets KEPT
+    assert eng.repair_plan is not None and eng.repair_plan.accepted
+    warm = eng.warmup()
+    assert warm == len(eng._policy.batch_buckets()) * 2
+    rng = np.random.default_rng(11)
+    xs = [rng.standard_normal((L, 3)).astype(np.float32)
+          for L in (2, 3, 4, 5, 8, 1, 7)]
+    futs = [eng.submit(x) for x in xs]
+    eng.start()
+    outs = [f.result(timeout=60) for f in futs]
+    st = eng.stats()
+    eng.close()
+    assert st["compile_count"] == warm       # zero warm retraces
+    assert st["retraces"] == 0
+    assert st["repairs"]["applied"] == 1
+    assert st["repairs"]["rejected"] == 0
+    assert st["repairs"]["valid_length_input"] == \
+        eng.repair_plan.valid_length_name
+    for x, out in zip(xs, outs):
+        assert out.shape == x.shape          # unpadded to the request
+        np.testing.assert_array_equal(out, _predictor_ref(net, {}, x)[0])
+
+
+def test_repaired_mean_pool_engine_bitwise():
+    net = mx.sym.mean(mx.sym.Variable("data"), axis=1, name="pool")
+    eng = _seq_engine(net, {}, (4, 3), start=False)
+    assert eng.repair_plan is not None and eng.repair_plan.accepted
+    eng.warmup()
+    rng = np.random.default_rng(12)
+    xs = [rng.standard_normal((L, 3)).astype(np.float32)
+          for L in (1, 2, 3, 4)]
+    futs = [eng.submit(x) for x in xs]
+    eng.start()
+    outs = [f.result(timeout=60) for f in futs]
+    eng.close()
+    for x, out in zip(xs, outs):
+        np.testing.assert_array_equal(out, _predictor_ref(net, {}, x)[0])
+
+
+def test_repaired_attention_block_bitwise():
+    """Attention-style score path: batch_dot(q, k^T) -> softmax over
+    the key axis -> batch_dot with v.  Two frontiers (the softmax and
+    the probs-side contraction) both repair, and live rows match the
+    batch-1 Predictor bitwise."""
+    data = mx.sym.Variable("data")
+    kt = mx.sym.transpose(data, axes=(0, 2, 1), name="kT")
+    scores = mx.sym.batch_dot(data, kt, name="scores")
+    probs = mx.sym.softmax(scores, axis=2, name="probs")
+    net = mx.sym.batch_dot(probs, data, name="attn")
+    eng = _seq_engine(net, {}, (4, 3), start=False)
+    assert eng.repair_plan is not None and eng.repair_plan.accepted, \
+        getattr(eng, "_repair_rejected", None)
+    assert eng._policy.seq_buckets == (4,)
+    eng.warmup()
+    rng = np.random.default_rng(13)
+    xs = [rng.standard_normal((L, 3)).astype(np.float32)
+          for L in (2, 4, 3)]
+    futs = [eng.submit(x) for x in xs]
+    eng.start()
+    outs = [f.result(timeout=60) for f in futs]
+    eng.close()
+    for x, out in zip(xs, outs):
+        assert out.shape == x.shape
+        np.testing.assert_array_equal(out, _predictor_ref(net, {}, x)[0])
+
+
+def test_disagreeing_lengths_rejected_at_submit_not_dispatch():
+    """Multi-input repaired graph: a request whose inputs disagree on
+    the live length is rejected at submit() — it must not reach the
+    batcher and fail innocent co-batched requests at dispatch."""
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    net = mx.sym.softmax(a + b, axis=1, name="sm")
+    policy = BucketPolicy(max_batch=2, seq_axis=0, seq_buckets=(4,))
+    eng = serving.ServingEngine(net, {}, {}, {"a": (4, 3), "b": (4, 3)},
+                                ctx=mx.cpu(), policy=policy,
+                                batch_timeout_ms=2.0, start=False)
+    assert eng.repair_plan is not None and eng.repair_plan.accepted
+    x = np.ones((3, 3), np.float32)
+    with pytest.raises(mx.MXNetError, match="disagree"):
+        eng.submit(a=x, b=np.ones((2, 3), np.float32))
+    fut = eng.submit(a=x, b=x)          # agreeing lengths still serve
+    eng.start()
+    out = fut.result(timeout=60)
+    eng.close()
+    pred = mx.predict.Predictor(net, {}, {}, {"a": (1, 3, 3),
+                                              "b": (1, 3, 3)},
+                                ctx=mx.cpu())
+    ref = pred.forward(a=x[None], b=x[None]).get_output(0)[0]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_rejected_repair_degrades_exactly_like_pr2():
+    """Regression vs PR 2: a repair-rejected graph (reverse over the
+    seq axis) must warn, drop the seq buckets, count the rejection,
+    and still serve every request bitwise vs the Predictor through
+    exact-length programs."""
+    net = mx.sym.reverse(mx.sym.Variable("data"), axis=1, name="rev")
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        eng = _seq_engine(net, {}, (4, 3), start=False)
+    assert any("repair was rejected" in str(c.message) for c in caught)
+    assert eng._policy.seq_buckets == ()     # degraded, exactly as PR 2
+    assert eng.repair_plan is None
+    st_rep = eng.stats()["repairs"]
+    assert st_rep == {"applied": 0, "rejected": 1,
+                      "valid_length_input": None,
+                      "reason": eng._repair_rejected}
+    x = np.random.default_rng(8).standard_normal((3, 3)).astype(np.float32)
+    fut = eng.submit(x)
+    eng.start()
+    out = fut.result(timeout=60)
+    eng.close()
+    np.testing.assert_array_equal(out, _predictor_ref(net, {}, x)[0])
+
+
+def test_repair_disabled_env_degrades(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_REPAIR", "0")
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=1, name="sm_seq")
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        eng = _seq_engine(net, {}, (4, 3), start=False)
+    assert any("cross-position" in str(c.message) for c in caught)
+    assert eng._policy.seq_buckets == ()
+    assert eng.repair_plan is None
+    eng.close(drain=False)
+
+
+def test_batch_axis_stays_degraded():
+    """Cross-position along the BATCH axis is out of repair scope:
+    coalescing still shuts off (max_batch=1), exactly as before."""
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=0, name="sm_b")
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        eng = serving.ServingEngine(net, {}, {}, {"data": (6,)},
+                                    ctx=mx.cpu(), batch_timeout_ms=2.0,
+                                    start=False)
+    assert any("BATCH" in str(c.message) for c in caught)
+    assert eng._policy.max_batch == 1
+    assert eng.repair_plan is None
+    eng.close(drain=False)
+
+
+def test_pad_check_probe_passes_on_repaired_program(monkeypatch):
+    """MXNET_SERVE_PAD_CHECK=1 perturbs pad slots (data AND the new
+    valid-length vector's pad rows) with a sentinel and requires
+    bitwise-stable live rows: a sound repair must pass it."""
+    monkeypatch.setenv("MXNET_SERVE_PAD_CHECK", "1")
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=1, name="sm_seq")
+    eng = _seq_engine(net, {}, (4, 3), start=False)
+    assert eng.repair_plan is not None and eng.repair_plan.accepted
+    eng.warmup()
+    rng = np.random.default_rng(21)
+    xs = [rng.standard_normal((L, 3)).astype(np.float32)
+          for L in (2, 3, 4)]
+    futs = [eng.submit(x) for x in xs]
+    eng.start()
+    outs = [f.result(timeout=60) for f in futs]   # probe raises on leak
+    eng.close()
+    for x, out in zip(xs, outs):
+        np.testing.assert_array_equal(out, _predictor_ref(net, {}, x)[0])
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+def _series_values(doc, name):
+    fam = doc.get(name)
+    if fam is None:
+        return []
+    return [(s["labels"], s["value"]) for s in fam["series"]]
+
+
+@pytest.mark.skipif(not telemetry.enabled(), reason="telemetry off")
+def test_repair_counters_recorded_and_reclaimed():
+    net = mx.sym.softmax(mx.sym.Variable("data"), axis=1, name="sm_seq")
+    eng = _seq_engine(net, {}, (4, 3), start=False)
+    assert eng.repair_plan is not None
+    lbl = eng._tm.engine_label
+    doc = telemetry.registry().collect()
+    applied = [(l, v) for l, v in _series_values(
+        doc, "mxnet_serve_repairs_applied_total")
+        if l.get("engine") == lbl]
+    assert applied == [({"engine": lbl, "axis": "seq", "op": "softmax"},
+                        1)]
+
+    bad = mx.sym.reverse(mx.sym.Variable("data"), axis=1, name="rev")
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        eng2 = _seq_engine(bad, {}, (4, 3), start=False)
+    lbl2 = eng2._tm.engine_label
+    doc = telemetry.registry().collect()
+    rejected = [(l, v) for l, v in _series_values(
+        doc, "mxnet_serve_repairs_rejected_total")
+        if l.get("engine") == lbl2]
+    assert rejected == [({"engine": lbl2}, 1)]
+
+    eng.close(drain=False)
+    eng2.close(drain=False)
+    doc = telemetry.registry().collect()
+    for name in ("mxnet_serve_repairs_applied_total",
+                 "mxnet_serve_repairs_rejected_total"):
+        assert not [l for l, _ in _series_values(doc, name)
+                    if l.get("engine") in (lbl, lbl2)]
+
+
+# ---------------------------------------------------------------------------
+# offline hazard ranker (tools/hazard_rank.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not telemetry.enabled(), reason="telemetry off")
+def test_hazard_rank_joins_lint_report_against_telemetry(tmp_path,
+                                                         capsys):
+    """ROADMAP ranker end to end: a repair-rejected engine degrades to
+    exact-length mode — the retrace linter's unbucketed-dynamic-dim
+    hazard — and its runtime retrace series carries the SAME
+    fingerprint a graph_lint --json report yields, so
+    tools/hazard_rank.py can join the two and rank by observed
+    impact."""
+    import json
+    import os
+    import sys
+    tools = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+    sys.path.insert(0, tools)
+    try:
+        import graph_lint
+        import hazard_rank
+        net = mx.sym.reverse(mx.sym.Variable("data"), axis=1, name="rev")
+        p = str(tmp_path / "rev-symbol.json")
+        net.save(p)
+        # lint the graph the way the degraded engine serves it: seq
+        # dim dynamic, no seq buckets quantizing it
+        assert graph_lint.main([p, "--shapes", "data=2,0,3",
+                                "--json"]) in (0, 1)
+        lint_path = str(tmp_path / "lint.json")
+        with open(lint_path, "w") as f:
+            f.write(capsys.readouterr().out)
+        fps = [d["fingerprint"]
+               for d in json.load(open(lint_path))["graphs"][p]["findings"]
+               if d["pass"] == "retrace" and d["severity"] == "warning"]
+        assert fps
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            eng = _seq_engine(net, {}, (4, 3))
+        # the degraded engine collected the same hazard fingerprints
+        assert set(fps) & set(eng.hazard_fingerprints)
+        rng = np.random.default_rng(5)
+        for L in (2, 3):
+            eng.predict(rng.standard_normal((L, 3)).astype(np.float32),
+                        timeout=30)
+        # force one genuine runtime retrace so the hazard-labeled
+        # series carries a nonzero count to rank on
+        eng._cache._op._jit.clear()
+        eng._cache._plans.clear()
+        eng.predict(rng.standard_normal((2, 3)).astype(np.float32),
+                    timeout=30)
+        assert eng.stats()["retraces"] >= 1
+        tele_path = str(tmp_path / "telemetry.json")
+        telemetry.dump_state(tele_path)
+        eng.close()
+        assert hazard_rank.main([lint_path, tele_path, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        byfp = {r["fingerprint"]: r for r in doc["hazards"]}
+        joined = set(fps) & set(byfp)
+        assert joined
+        top = doc["hazards"][0]
+        assert top["retraces_observed"] >= 1
+        assert top["fingerprint"] in fps      # observed hazard ranks 1st
+        assert not top["stale_report"]
+        assert any(e["requests"] >= 3 for e in doc["engines"].values())
+    finally:
+        sys.path.remove(tools)

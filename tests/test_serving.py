@@ -442,11 +442,15 @@ def test_cross_position_batch_head_served_uncontaminated():
         np.testing.assert_array_equal(outs[i], ref)
 
 
-def test_cross_position_seq_graph_refuses_bucket():
-    """softmax over the bucketed seq axis: the engine drops the seq
-    buckets (exact-length programs) instead of returning probabilities
-    scaled down by the zero pads' exp(0) mass."""
+def test_cross_position_seq_graph_refuses_bucket(monkeypatch):
+    """softmax over the bucketed seq axis with the masking repair
+    disabled (MXNET_SERVE_REPAIR=0): the engine drops the seq buckets
+    (exact-length programs) instead of returning probabilities scaled
+    down by the zero pads' exp(0) mass.  (With the repair enabled —
+    the default since PR 4 — this graph serves from the bucket grid
+    instead; tests/test_rewrite.py covers that path.)"""
     import warnings as _w
+    monkeypatch.setenv("MXNET_SERVE_REPAIR", "0")
     data = mx.sym.Variable("data")
     net = mx.sym.softmax(data, axis=1, name="sm_seq")
     policy = BucketPolicy(max_batch=2, seq_axis=0, seq_buckets=(4,))

@@ -268,8 +268,8 @@ def test_serving_telemetry_acceptance(monkeypatch, tmp_path, capsys):
     assert vals["mxnet_serve_admitted_total"] == st["admitted"] == len(X)
     assert vals["mxnet_serve_requests_total"] == len(X)
     assert vals["mxnet_serve_batches_total"] == st["batches"]
-    assert vals['mxnet_serve_retraces_total{hazards="none"}'] \
-        == st["retraces"] == 0
+    assert vals['mxnet_serve_retraces_total{engine="%s",hazards="none"}'
+                % el] == st["retraces"] == 0
     assert vals['mxnet_serve_program_cache_hits{engine="%s"}' % el] \
         == st["program_cache"]["hits"]
     assert vals['mxnet_serve_program_cache_misses{engine="%s"}' % el] \
@@ -327,11 +327,18 @@ def test_runtime_retrace_counted_under_hazard_label(monkeypatch):
     eng._cache._plans.clear()
     eng.predict(np.zeros((6,), np.float32), timeout=30)
     st = eng.stats()
+    # scrape BEFORE close: the per-engine retrace series (engine +
+    # hazards labels) is reclaimed with the other engine series
+    vals = _prom_values(telemetry.render_prometheus())
+    el = eng._tm.engine_label
     eng.close()
     assert st["retraces"] == 1
-    vals = _prom_values(telemetry.render_prometheus())
-    assert vals['mxnet_serve_retraces_total{hazards="none"}'] == 1
+    assert vals['mxnet_serve_retraces_total{engine="%s",hazards="none"}'
+                % el] == 1
     assert vals["mxnet_serve_compiles_total"] == st["compile_count"]
+    vals2 = _prom_values(telemetry.render_prometheus())
+    assert not any(k.startswith("mxnet_serve_retraces_total{engine=\"%s\""
+                                % el) for k in vals2)
 
 
 def test_retrace_bookkeeping_survives_telemetry_off(monkeypatch):
@@ -688,8 +695,11 @@ def test_snapshotter_rejects_unknown_format_up_front():
 def test_exact_length_cold_compiles_are_not_retraces(monkeypatch):
     """Post-warmup compiles on first-sight signatures are legitimate in
     exact-length seq mode (cross-position graphs degrade to one program
-    per length): stats()['retraces'] must stay 0 for them."""
+    per length): stats()['retraces'] must stay 0 for them.  Repair is
+    pinned off — with it on (the PR 4 default) this graph would serve
+    repaired from the bucket grid instead of degrading."""
     import warnings as _w
+    monkeypatch.setenv("MXNET_SERVE_REPAIR", "0")
     data = mx.sym.Variable("data")
     net = mx.sym.softmax(data, axis=1, name="sm_seq")   # cross-pos seq
     policy = serving.BucketPolicy(max_batch=2, seq_axis=0,

@@ -20,11 +20,29 @@ Usage:
     python tools/graph_lint.py model-symbol.json \
         --shapes data=8,0,64 --seq-axis 1 --seq-buckets 32,64
 
+    # repair it: splice valid-length masks before every cross-position
+    # frontier, re-verify, and emit <stem>.repaired.json + a report
+    python tools/graph_lint.py model-symbol.json \
+        --shapes data=8,4,64 --seq-axis 1 --seq-buckets 4 --fix
+
 Dynamic dims are written as 0 (or '?') in --shapes; the retrace linter
 keys on them.  --strict exits nonzero on warnings too (CI bar: the
 model-zoo exemplars must lint clean — tests/test_graph_lint.py).
 
-Exit codes: 0 clean at the chosen bar, 1 findings, 2 could not load.
+Exit codes (documented contract, tests/test_graph_lint.py):
+  0  clean at the chosen bar
+  1  warnings only, failing the bar (--strict; or a rejected --fix)
+  2  hard failure: verifier/shape ERRORS, or a graph could not load
+With --fix, a graph whose cross-position verdicts are all repaired
+(and whose rewritten graph re-lints clean) counts as passing; the
+repaired symbol JSON lands next to the input (or --fix-dir).  When
+only SOME labels repaired, the artifact is named
+<stem>.repaired.partial.json instead — it is still cross-position
+along the rejected axes — and the run keeps its failing exit code.
+
+--json prints one machine-readable document (findings with node/op/
+provenance/fingerprint, per-axis verdicts, repair outcomes) instead of
+text — tools/hazard_rank.py joins it against telemetry snapshots.
 """
 from __future__ import annotations
 
@@ -125,11 +143,23 @@ def main(argv=None):
                          "etc.); default is inference")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on warnings too, not just errors")
+    ap.add_argument("--fix", action="store_true",
+                    help="attempt masking repairs of cross-position "
+                         "verdicts (analysis/rewrite.py); emit "
+                         "<stem>.repaired.json + a repair report")
+    ap.add_argument("--fix-dir", default=None,
+                    help="directory for --fix outputs (default: next "
+                         "to the input JSON, or the cwd for model "
+                         "names)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print one machine-readable JSON document "
+                         "instead of text (hazard_rank.py input)")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="print only graphs with findings")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import json
     from mxnet_tpu import analysis
 
     try:
@@ -142,30 +172,169 @@ def main(argv=None):
     passes = tuple(p.strip() for p in args.passes.split(",")
                    if p.strip()) if args.passes else None
     worst = 0
+    doc = {}
     for spec in args.graphs:
         try:
             graph, shapes = _load_graph(spec)
         except Exception as e:
             print("graph_lint: cannot load %r: %s" % (spec, e),
                   file=sys.stderr)
+            if args.as_json:
+                # --json promises ONE document: record the failure and
+                # keep the graphs already analyzed instead of dropping
+                # the whole report on the floor (exit still 2)
+                doc[spec] = {"load_error": str(e)}
+                worst = 2
+                continue
             return 2
         shapes.update(cli_shapes)
+        shapes, valid_vars = _shape_valid_lengths(graph, shapes)
         pad_axes = None
         if policy is not None and policy.seq_axis is not None:
+            data_inputs = [n for n in shapes if n not in valid_vars]
             pad_axes = {"batch": {n: 0 for n in shapes},
-                        "seq": {n: policy.seq_axis for n in shapes}}
+                        "seq": {n: policy.seq_axis for n in data_inputs}}
         report, ctx = analysis.analyze(
             graph, data_shapes=shapes, policy=policy, pad_axes=pad_axes,
             training=args.training, passes=passes)
         failed = not report.clean(strict=args.strict)
-        if failed or not args.quiet:
+        hard = bool(report.errors)
+        entry = {"findings": report.to_list(),
+                 "verdicts": dict(ctx.pad_verdicts), "repairs": []}
+        fix_lines = []
+        if args.fix and pad_axes is None and not hard:
+            # --fix must never be a silent no-op: say WHY no repair
+            # was attempted (repairs need the seq padded-axis spec)
+            reason = ("--fix: no padded-axis spec — pass --seq-axis/"
+                      "--seq-buckets to describe the bucketing to "
+                      "repair for (batch-only padding has no masking "
+                      "repair: cross-position batch graphs serve at "
+                      "max_batch=1)")
+            entry["repairs"].append({"label": None, "accepted": False,
+                                     "reason": reason})
+            fix_lines.append(reason)
+        elif args.fix and pad_axes is not None and not hard:
+            failed, hard = _fix_graph(
+                analysis, spec, graph, shapes, pad_axes, policy, args,
+                passes, report, ctx, entry, fix_lines, failed, hard)
+        doc[spec] = entry
+        if not args.as_json and (failed or not args.quiet):
             print("== %s ==" % spec)
             print(report.format())
             for label, verdict in sorted(ctx.pad_verdicts.items()):
                 print("  padded %s axis: %s" % (label, verdict))
-        if failed:
-            worst = 1
+            for ln in fix_lines:
+                print(ln)
+        if hard:
+            worst = 2
+        elif failed:
+            worst = max(worst, 1)
+    if args.as_json:
+        print(json.dumps({"graphs": doc}, indent=2, default=str))
     return worst
+
+
+def _json_float(v):
+    """Mask neutral elements include +/-inf, which json.dumps would
+    emit as the RFC-8259-invalid ``-Infinity``; strict consumers (jq,
+    JSON.parse) must still be able to read the document, so
+    non-finite values serialize as strings ("-inf"/"inf"/"nan")."""
+    if v is None or (v == v and float("-inf") < v < float("inf")):
+        return v
+    return str(v)
+
+
+def _shape_valid_lengths(graph, shapes):
+    """Auto-shape ``__pad_valid_len__``-marked inputs (the masks'
+    driver in repaired graphs): a (batch,) vector sized off the first
+    shaped input, so re-linting a ``--fix`` output needs no extra
+    --shapes entry.  Returns (shapes, set of marked names)."""
+    valid_vars = set()
+    batch = next((s[0] for s in shapes.values() if s), None)
+    from mxnet_tpu.symbol.symbol import _topo
+    for n in _topo(graph._outputs):
+        if n.op is None and n.attrs.get("__pad_valid_len__"):
+            valid_vars.add(n.name)
+            if n.name not in shapes and batch is not None:
+                shapes[n.name] = (batch,)
+    return shapes, valid_vars
+
+
+def _fix_graph(analysis, spec, graph, shapes, pad_axes, policy, args,
+               passes, report, ctx, entry, fix_lines, failed, hard):
+    """--fix: repair every cross-position label (seq first), emit the
+    rewritten symbol JSON, and re-score the graph on a full re-lint of
+    the repaired symbol when everything repaired."""
+    cross = [lb for lb, v in sorted(ctx.pad_verdicts.items())
+             if v == "cross-position"]
+    cross.sort(key=lambda lb: lb != "seq")      # seq first
+    if not cross:
+        return failed, hard
+    cur, all_ok, last_plan = graph, True, None
+    pre = (report, ctx)         # the analysis main() just ran
+    for label in cross:
+        plan = analysis.plan_repair(cur, shapes, pad_axes, label=label,
+                                    policy=policy,
+                                    training=args.training,
+                                    precomputed=pre)
+        pre = None              # chained labels re-analyze the rewrite
+        entry["repairs"].append({
+            "label": label, "accepted": plan.accepted,
+            "reason": plan.reason,
+            "valid_length_input": plan.valid_length_name,
+            "actions": [{"node": a.node, "op": a.op, "kind": a.kind,
+                         "value": _json_float(a.value),
+                         "axes": list(a.axes),
+                         "slot": a.slot} for a in plan.actions]})
+        fix_lines.append(plan.describe())
+        if not plan.accepted:
+            # the user asked for a repair and it could not be done:
+            # that fails the run even without --strict (the documented
+            # "rejected --fix exits 1" contract)
+            all_ok = False
+            failed = True
+            continue
+        cur, last_plan = plan.symbol, plan
+        shapes = dict(shapes)
+        bname, bax = next(iter(pad_axes["batch"].items()))
+        shapes[plan.valid_length_name] = (shapes[bname][bax],)
+        pad_axes = {lb: dict(m) for lb, m in pad_axes.items()}
+        pad_axes["batch"][plan.valid_length_name] = 0
+    if last_plan is not None:
+        out_dir = args.fix_dir or (os.path.dirname(spec)
+                                   if os.path.sep in spec
+                                   or spec.endswith(".json") else ".")
+        stem = os.path.splitext(os.path.basename(spec))[0] or spec
+        # a partially-repaired graph (some labels' repairs rejected —
+        # it is STILL cross-position along those) must not be
+        # confusable with a fully-repaired artifact: distinct suffix,
+        # distinct report key, and the exit code keeps failing
+        suffix = ".repaired.json" if all_ok else ".repaired.partial.json"
+        out_path = os.path.join(out_dir or ".", stem + suffix)
+        cur.save(out_path)
+        entry["repaired_symbol" if all_ok else
+              "partial_symbol"] = out_path
+        fix_lines.append("  %s symbol written to %s"
+                         % ("repaired" if all_ok else
+                            "PARTIALLY repaired (still unsound along "
+                            "the rejected axes)", out_path))
+        if all_ok:
+            # the graph the user will serve is the repaired one: score
+            # a FULL re-lint of it under the same pass selection —
+            # plan_repair's internal re-verification only ran
+            # verify+shapes+padding, and e.g. a retrace-linter warning
+            # must keep failing the --strict bar after a repair too
+            report2, ctx2 = analysis.analyze(
+                cur, data_shapes=shapes, policy=policy,
+                pad_axes=pad_axes, training=args.training, passes=passes)
+            failed = not report2.clean(strict=args.strict)
+            hard = bool(report2.errors)
+            entry["repaired_findings"] = report2.to_list()
+            # --json consumers join on verdicts: the graph that passes
+            # is the repaired one, so record ITS per-axis verdicts
+            # alongside the original's
+            entry["repaired_verdicts"] = dict(ctx2.pad_verdicts)
+    return failed, hard
 
 
 if __name__ == "__main__":

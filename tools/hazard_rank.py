@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Rank static retrace hazards by observed production impact.
+
+The ROADMAP hazard-ranking item, closed offline: the retrace linter
+(mxnet_tpu/analysis/retrace.py) names every *potential* compile storm,
+but a busy serving fleet needs to know which warning to fix FIRST.  The
+raw signal exists in telemetry (PR 3): the engine counts runtime
+retraces under the hazard fingerprints of the graph's static findings
+(``mxnet_serve_retraces_total{hazards=...}``), counts requests per
+observed shape signature (``mxnet_serve_shape_signature_total``), and
+publishes a per-engine Shannon-entropy gauge
+(``mxnet_serve_shape_entropy_bits``).  This tool joins those series
+against a ``graph_lint --json`` report — both sides key on the SAME
+``analysis.hazard_fingerprint`` — and orders the lint findings by:
+
+1. **observed retraces** attributed to the finding's fingerprint (the
+   storm already happened: fix this now);
+2. **exposure** = shape-entropy bits x requests of exactly the engines
+   whose retrace-series label carries the fingerprint (engines
+   pre-touch it at construction, so a zero-count series still marks
+   the hazard DEPLOYED): a live latent hazard under heavy
+   high-entropy traffic outranks both a lightly-exercised one and a
+   lint-only finding.
+
+Usage::
+
+    python tools/graph_lint.py model-symbol.json --shapes data=8,0,64 \
+        --json > lint.json
+    python tools/telemetry_dump.py snapshot telemetry.json   # or raw file
+    python tools/hazard_rank.py lint.json telemetry.json [--top N] [--json]
+
+The telemetry file is whatever the runtime wrote: a
+``telemetry.dump_state`` JSON document or a periodic snapshot
+(``MXNET_TELEMETRY_SNAPSHOT_FORMAT=json``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+if __package__ is None or __package__ == "":       # script invocation
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_lint(path):
+    """Hazard findings from a ``graph_lint --json`` document (or a bare
+    findings list).  Returns {fingerprint: finding dict + 'graph'}."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        graphs = {"<findings>": {"findings": doc}}
+    else:
+        graphs = doc.get("graphs", {})
+    out = {}
+    for spec, entry in graphs.items():
+        for d in entry.get("findings", ()):
+            if d.get("pass") != "retrace" or \
+                    d.get("severity") != "warning":
+                continue
+            fp = d.get("fingerprint")
+            if not fp:
+                from mxnet_tpu.analysis import hazard_fingerprint
+                fp = hazard_fingerprint(d.get("node"), d.get("op"),
+                                        d.get("message"))
+            rec = dict(d)
+            rec["graph"] = spec
+            out.setdefault(fp, rec)
+    return out
+
+
+def _series(metrics, name):
+    fam = metrics.get(name) or {}
+    return fam.get("series", [])
+
+
+def load_observations(path):
+    """Aggregate the snapshot's serving series.  Returns
+    (retraces {fingerprint: count}, fp_engines {fingerprint: engine
+    set}, exposure {engine: {entropy_bits, requests, exposure}}).
+    ``fp_engines`` maps every fingerprint to the engines whose
+    retraces-series label carries it — engines pre-touch their hazard
+    label at construction, so a zero-count series still proves the
+    hazard is live in that serving engine."""
+    import telemetry_dump
+    doc = telemetry_dump.load_doc(path)
+    metrics = doc.get("metrics", {})
+    retraces, fp_engines, shared = {}, {}, set()
+    for s in _series(metrics, "mxnet_serve_retraces_total"):
+        v = s.get("value") or 0
+        labels = s.get("labels") or {}
+        eng = labels.get("engine", "?")
+        fps_in_label = [t.strip() for t in
+                        labels.get("hazards", "").split(",")
+                        if t.strip() and t.strip() != "none"
+                        and not t.strip().startswith("+")]
+        for fp in labels.get("hazards", "").split(","):
+            fp = fp.strip()
+            if not fp or fp == "none":
+                continue
+            if fp.startswith("+"):
+                # engine-side label overflow marker ("+3"): the engine
+                # carries more hazards than the label holds — warn
+                # rather than attribute to a phantom fingerprint
+                print("hazard_rank: engine %s's hazard label is "
+                      "truncated (%s more fingerprints) — attribution "
+                      "for that engine is incomplete" % (eng, fp[1:]),
+                      file=sys.stderr)
+                continue
+            fp_engines.setdefault(fp, set()).add(eng)
+            if v:
+                retraces[fp] = retraces.get(fp, 0) + v
+                if len(fps_in_label) > 1:
+                    shared.add(fp)
+    requests = {}
+    for s in _series(metrics, "mxnet_serve_shape_signature_total"):
+        eng = (s.get("labels") or {}).get("engine", "?")
+        requests[eng] = requests.get(eng, 0) + (s.get("value") or 0)
+    exposure = {}
+    for s in _series(metrics, "mxnet_serve_shape_entropy_bits"):
+        eng = (s.get("labels") or {}).get("engine", "?")
+        ent = s.get("value") or 0.0
+        reqs = requests.get(eng, 0)
+        exposure[eng] = {"entropy_bits": ent, "requests": reqs,
+                         "exposure": ent * reqs}
+    for eng, reqs in requests.items():
+        exposure.setdefault(eng, {"entropy_bits": 0.0, "requests": reqs,
+                                  "exposure": 0.0})
+    return retraces, fp_engines, shared, exposure
+
+
+def rank(hazards, retraces, fp_engines, shared, exposure):
+    """Join + order: observed retraces first, then exposure.  A hazard
+    that is actually DEPLOYED (its fingerprint appears in a serving
+    engine's retrace-series label) is credited with the exposure of
+    exactly the engines carrying it (their entropy bits x requests —
+    the traffic most likely to trigger it); a lint-only finding
+    carries zero, so live hazards outrank paper ones, and a hazard
+    behind heavy polymorphic traffic outranks one behind a trickle.
+    Observed fingerprints with no lint finding rank too (stale report
+    — the storm is real even if the report is not), flagged
+    ``stale_report``."""
+    def _exposure_of(fp):
+        return sum(exposure.get(e, {}).get("exposure", 0.0)
+                   for e in fp_engines.get(fp, ()))
+
+    rows = []
+    for fp, d in hazards.items():
+        rows.append({
+            "fingerprint": fp,
+            "retraces_observed": retraces.get(fp, 0),
+            "shared_attribution": fp in shared,
+            "deployed": fp in fp_engines,
+            "exposure": _exposure_of(fp),
+            "graph": d.get("graph"),
+            "node": d.get("node"), "op": d.get("op"),
+            "message": (d.get("message") or "").split("\n")[0],
+            "stale_report": False,
+        })
+    for fp, n in retraces.items():
+        if fp not in hazards:
+            rows.append({
+                "fingerprint": fp, "retraces_observed": n,
+                "shared_attribution": fp in shared,
+                "deployed": True,
+                "exposure": _exposure_of(fp), "graph": None,
+                "node": None, "op": None,
+                "message": "(fingerprint not in the lint report — "
+                           "re-lint the deployed graph)",
+                "stale_report": True,
+            })
+    rows.sort(key=lambda r: (-r["retraces_observed"], -r["exposure"],
+                             not r["deployed"], r["fingerprint"]))
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="rank graph_lint retrace hazards by observed "
+                    "telemetry impact")
+    ap.add_argument("lint_json", help="graph_lint --json output")
+    ap.add_argument("telemetry", help="telemetry dump/snapshot file")
+    ap.add_argument("--top", type=int, default=0,
+                    help="print only the top N hazards")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    try:
+        hazards = load_lint(args.lint_json)
+    except Exception as e:
+        print("hazard_rank: cannot read lint report %r: %s"
+              % (args.lint_json, e), file=sys.stderr)
+        return 2
+    try:
+        retraces, fp_engines, shared, exposure = \
+            load_observations(args.telemetry)
+    except Exception as e:
+        print("hazard_rank: cannot read telemetry %r: %s"
+              % (args.telemetry, e), file=sys.stderr)
+        return 2
+
+    rows = rank(hazards, retraces, fp_engines, shared, exposure)
+    if args.top:
+        rows = rows[:args.top]
+    if args.as_json:
+        print(json.dumps({"hazards": rows, "engines": exposure},
+                         indent=2))
+        return 0
+    if not rows:
+        print("no retrace hazards in the lint report and no retraces "
+              "observed — nothing to rank")
+        return 0
+    for eng, e in sorted(exposure.items()):
+        print("engine %s: %d request(s), shape entropy %.3f bits"
+              % (eng, e["requests"], e["entropy_bits"]))
+    print("%-4s %-10s %-9s %-9s %-20s %s"
+          % ("rank", "hazard", "retraces", "deployed", "node (op)",
+             "finding"))
+    for i, r in enumerate(rows, 1):
+        loc = "%s (%s)" % (r["node"], r["op"]) if r["node"] else "-"
+        cnt = "%d%s" % (r["retraces_observed"],
+                        "*" if r["shared_attribution"] else "")
+        print("%-4d %-10s %-9s %-9s %-20s %s%s"
+              % (i, r["fingerprint"], cnt,
+                 "yes" if r["deployed"] else "no", loc,
+                 r["message"][:70],
+                 "  [STALE REPORT]" if r["stale_report"] else ""))
+    if any(r["shared_attribution"] for r in rows):
+        print("(* retrace counts come from a label naming several "
+              "hazards: the engine cannot attribute per-hazard, so "
+              "the count is shared, not per-fingerprint)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
