@@ -169,10 +169,38 @@ VARIABLES = {v.name: v for v in [
          "'json' (metrics + finished traces, the document "
          "tools/telemetry_dump.py renders)."),
     _Var("MXNET_TELEMETRY_TRACE_SAMPLE", int, 64,
-         "Request-tracing sample period for the serving engine: every "
-         "Nth request carries a TraceContext and yields a full span "
-         "tree (queue-wait/coalesce/pad/dispatch/unpad) retrievable by "
-         "trace id.  1 traces every request; 0 disables tracing."),
+         "Baseline-floor period of the serving trace-retention chain "
+         "(telemetry/sampling.py): every request is traced cheaply and "
+         "retention is decided at finish — every Nth request is kept "
+         "unconditionally, on top of the tail-biased and error-keep "
+         "samplers.  1 keeps every request; 0 disables tracing "
+         "entirely (no per-request TraceContext, no tail/error keeps)."),
+    _Var("MXNET_TELEMETRY_TRACE_TAIL_K", int, 8,
+         "Tail-biased trace retention: a finished request trace is "
+         "retroactively kept when its end-to-end latency lands in the "
+         "current top-K slowest or exceeds a moving p99 estimate, so "
+         "every tail request has a span tree (the traffic p99 "
+         "debugging actually needs).  0 disables the tail sampler, "
+         "leaving only the periodic floor and error keep."),
+    _Var("MXNET_TELEMETRY_TRACE_ERRORS", bool, True,
+         "Keep the span tree of every request that failed (rejected, "
+         "shed, expired, cancelled, dispatch error) regardless of the "
+         "periodic/tail samplers — overloaded traffic is exactly what "
+         "an operator debugs."),
+    _Var("MXNET_TELEMETRY_PORT", int, -1,
+         "Port for the live telemetry HTTP endpoint "
+         "(telemetry/server.py: GET /metrics, /metrics.json, /traces, "
+         "/traces/<id>, /healthz).  -1 = off; 0 = bind an ephemeral "
+         "port (telemetry.server_address() reads it back).  Started "
+         "at import when set, or lazily by ServingEngine construction "
+         "— in which case the last engine's close() shuts it down "
+         "(port and acceptor thread are released, never leaked)."),
+    _Var("MXNET_TELEMETRY_SHARED_DIR", str, "",
+         "Cross-host aggregation drop point: when set, KVStoreDist "
+         "ranks periodically write their registry snapshot as "
+         "telemetry_rank<N>.json under this (shared) directory, and "
+         "`tools/telemetry_dump.py aggregate <dir>/telemetry_rank*.json` "
+         "merges them into one rank-labeled document.  Empty = off."),
     _Var("MXNET_TELEMETRY_TRACE_CAPACITY", int, 256,
          "Bound on the in-process finished-trace store; beyond it the "
          "oldest span trees are evicted (long serving runs must not "

@@ -207,6 +207,8 @@ class KVStoreDist(KVStore):
         self._psum_cache = {}
         self._mesh = None
         self._heartbeat = None
+        self._rank_snapshotter = None
+        self._start_rank_telemetry()
         if self._multi:
             import numpy as np
             from jax.sharding import Mesh
@@ -218,6 +220,32 @@ class KVStoreDist(KVStore):
                 self._heartbeat = _Heartbeat(
                     self._rank, self._size, interval,
                     miss_limit=config.get("MXNET_KVSTORE_HEARTBEAT_MISS"))
+
+    def _start_rank_telemetry(self):
+        """Cross-host observability (MXNET_TELEMETRY_SHARED_DIR): each
+        rank periodically publishes its registry snapshot as
+        ``telemetry_rank<N>.json`` under a shared directory, so
+        ``tools/telemetry_dump.py aggregate`` can merge the whole tier
+        into one rank-labeled document — the per-replica numbers this
+        tier had were useless for spotting a straggler until they were
+        joinable in one place.  Advisory: a failure to start the
+        pusher must never fail the kvstore."""
+        from . import config, telemetry
+        shared = config.get("MXNET_TELEMETRY_SHARED_DIR")
+        if not shared or not telemetry.enabled():
+            return
+        try:
+            self._rank_snapshotter = telemetry.start_rank_snapshotter(
+                shared, self._rank)
+            atexit.register(self._stop_rank_telemetry)
+        except Exception as e:
+            logging.warning(
+                "kvstore rank-telemetry pusher failed to start: %s", e)
+
+    def _stop_rank_telemetry(self):
+        snap, self._rank_snapshotter = self._rank_snapshotter, None
+        if snap is not None:
+            snap.stop()          # writes one final snapshot
 
     def get_num_dead_node(self, node_id=0):
         """Real failure detection when the heartbeat watchdog is on

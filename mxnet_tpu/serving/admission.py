@@ -77,8 +77,10 @@ class Request(object):
     output shapes the graph infers at the UNPADDED input, which the
     engine slices dispatched rows back to (None when seq bucketing is
     off).  ``trace`` optionally carries a
-    :class:`~mxnet_tpu.telemetry.TraceContext` across the thread hop to
-    the worker (sampled requests yield a full span tree).
+    :class:`~mxnet_tpu.telemetry.LazyTrace` (or an explicit
+    ``TraceContext``) across the thread hop to the worker; retention —
+    which requests yield a stored span tree — is decided at finish by
+    the tail-biased sampler chain.
     """
     __slots__ = ("inputs", "group", "future", "t_enqueue", "deadline",
                  "out_rows", "trace")

@@ -24,10 +24,13 @@ enabled (``MXNET_TELEMETRY_ON``, default on) the engine additionally
 feeds the process-wide metrics registry (``mxnet_serve_*`` series:
 queue depth, shed/reject/expiry, occupancy, padding waste per bucket,
 program-cache hit/miss, retraces keyed by the retrace-linter's hazard
-fingerprints, shape-signature entropy) and samples every
-``MXNET_TELEMETRY_TRACE_SAMPLE``-th request into a full span tree
-(queue-wait -> coalesce -> pad -> dispatch -> unpad) retrievable by
-trace id via ``tools/telemetry_dump.py``.
+fingerprints, shape-signature entropy), traces every request and
+retains span trees tail-biased (top-K slowest + moving-p99 + error
+keep, with ``MXNET_TELEMETRY_TRACE_SAMPLE`` as the periodic floor;
+``telemetry/sampling.py``) — queue-wait -> coalesce -> pad -> dispatch
+-> unpad, retrievable by trace id via ``tools/telemetry_dump.py`` or
+the live HTTP endpoint (``MXNET_TELEMETRY_PORT``: /metrics, /traces,
+/healthz; released by ``close()``).
 
 Env knobs (config.py): ``MXNET_SERVE_MAX_BATCH``,
 ``MXNET_SERVE_MAX_QUEUE``, ``MXNET_SERVE_BATCH_TIMEOUT_MS``,
@@ -320,9 +323,18 @@ class ServingEngine(object):
         self._tm = _EngineTelemetry(self) if _telemetry.enabled() else None
         if self._tm is not None:
             self._record_repair_telemetry()
-        self._trace_sample = (_telemetry.trace_sample_every()
-                              if self._tm is not None else 0)
-        self._req_seq = itertools.count()
+        # trace-retention chain (telemetry/sampling.py): every request
+        # is traced cheaply and kept/dropped at finish() — tail-biased
+        # (top-K slowest + moving p99) with error keep and the
+        # every-Nth periodic floor.  None = tracing off entirely
+        # (MXNET_TELEMETRY_TRACE_SAMPLE=0 or telemetry disabled).
+        self._trace_chain = (_telemetry.chain_from_config()
+                             if self._tm is not None else None)
+        # live HTTP endpoint: the first engine to find
+        # MXNET_TELEMETRY_PORT set with no server running starts one;
+        # close() releases it (refcounted across co-resident engines)
+        self._owns_http_server = (_telemetry.server.engine_acquire()
+                                  if self._tm is not None else False)
         self._sig_labels = {}        # group key -> shape-sig counter child
         self._sig_other = None       # shared catch-all child past the cap
         self._sig_lock = threading.Lock()   # guards creation + the cap
@@ -528,6 +540,11 @@ class ServingEngine(object):
             self._run()    # never started: drain on the caller's thread
         if self._tm is not None:
             self._tm.close()
+        if self._owns_http_server:
+            # last engine out stops the HTTP endpoint: port + acceptor
+            # thread are released, so reload loops cannot leak either
+            self._owns_http_server = False
+            _telemetry.server.engine_release()
 
     def __enter__(self):
         return self
@@ -634,9 +651,13 @@ class ServingEngine(object):
         if self._tm is not None:
             self._tm.requests.inc()
             self._sig_counter(group).inc()
-            if self._trace_sample and \
-                    next(self._req_seq) % self._trace_sample == 0:
-                trace = _telemetry.TraceContext("serve.request", "serve")
+            if self._trace_chain is not None:
+                # trace EVERY request, cheaply: a LazyTrace is one
+                # timestamp; the chain decides retention at finish(),
+                # when the e2e latency is known — that is what makes
+                # tail-biased keeps retroactive — and only the kept
+                # minority materializes a real span tree
+                trace = _telemetry.LazyTrace(self._trace_chain)
         req = Request(feeds, group, fut, deadline=deadline,
                       out_rows=out_rows, trace=trace)
         try:
@@ -841,26 +862,30 @@ class ServingEngine(object):
 
     def _finish_trace(self, r, t_pop, t_pad0, t_disp0, t_disp1, t_u0,
                       t_u1, b, n, compiled):
-        """Assemble the sampled request's span tree: batch-stage
-        intervals were measured once per batch and are attributed to
-        every traced member request.  Runs AFTER the scatter loop —
-        store inserts and the profiler-ring bridge must not sit
-        between two clients' set_result calls."""
-        tc = r.trace
-        tc.add("queue-wait", tc.root.t0, t_pop, "serve")
-        tc.add("coalesce", t_pop, t_pad0, "serve",
-               meta={"batch": n})
-        tc.add("pad", t_pad0, t_disp0, "serve", meta={"bucket": b})
-        dsp = tc.add("dispatch", t_disp0, t_disp1, "serve",
-                     meta={"bucket": b, "live": n,
-                           "compiled": bool(compiled)})
-        if compiled:
-            sp = _telemetry.Span("compile", "serve", t0=t_disp0)
-            sp.t1 = t_disp1
-            sp.meta = {"programs": compiled}
-            dsp.children.append(sp)
-        tc.add("unpad", t_u0, t_u1, "serve")
-        tc.finish(t_u1)
+        """Finish one request's trace: batch-stage intervals were
+        measured once per batch and are attributed to every member
+        request.  Span assembly is DEFERRED behind the retention
+        verdict — with every request traced, the dropped majority must
+        pay only for the keep/drop decision, never for building a span
+        tree nobody will read.  Runs AFTER the scatter loop — store
+        inserts and the profiler-ring bridge must not sit between two
+        clients' set_result calls."""
+        def build(tc):
+            tc.add("queue-wait", tc.root.t0, t_pop, "serve")
+            tc.add("coalesce", t_pop, t_pad0, "serve",
+                   meta={"batch": n})
+            tc.add("pad", t_pad0, t_disp0, "serve", meta={"bucket": b})
+            dsp = tc.add("dispatch", t_disp0, t_disp1, "serve",
+                         meta={"bucket": b, "live": n,
+                               "compiled": bool(compiled)})
+            if compiled:
+                sp = _telemetry.Span("compile", "serve", t0=t_disp0)
+                sp.t1 = t_disp1
+                sp.meta = {"programs": compiled}
+                dsp.children.append(sp)
+            tc.add("unpad", t_u0, t_u1, "serve")
+
+        r.trace.finish(t_u1, build=build)
 
     def _live_length(self, req):
         """One request's live extent along the repaired axis, read off
@@ -1001,6 +1026,9 @@ class ServingEngine(object):
                     "mean": float(np.mean(lat)) if lat else 0.0,
                     "p50": _percentile(lat, 0.50),
                     "p99": _percentile(lat, 0.99),
+                    # validates the tail-biased sampler: the traces it
+                    # retains must cover the latencies up here
+                    "p999": _percentile(lat, 0.999),
                 },
             })
         return snap

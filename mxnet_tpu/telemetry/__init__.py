@@ -10,7 +10,15 @@ signals, not just Chrome-trace files):
 - **tracing** (tracing.py): request-scoped ``TraceContext`` span trees,
   contextvar-propagated on-thread and carried across the serving
   worker hop, stored retrievably by trace id and bridged into the
-  profiler's Chrome-trace ring;
+  profiler's Chrome-trace ring; serving traces EVERY request via a
+  one-timestamp ``LazyTrace`` and retains span trees tail-biased
+  (sampling.py: top-K slowest + moving p99 + error keep +
+  every-Nth floor);
+- **live endpoint** (server.py): a stdlib HTTP daemon
+  (``MXNET_TELEMETRY_PORT`` / ``start_server``) serving ``/metrics``,
+  ``/metrics.json``, ``/traces``, ``/traces/<id>``, ``/healthz``;
+  cross-host, KVStoreDist ranks push rank-tagged snapshots under
+  ``MXNET_TELEMETRY_SHARED_DIR`` for ``telemetry_dump aggregate``;
 - **built-in instrumentation**: serving admission/dispatch (queue
   depth, shed/reject/expiry, occupancy, padding waste, program-cache
   hit/miss, retraces keyed by the retrace-linter's hazard
@@ -37,20 +45,30 @@ import atexit
 
 from .metrics import (Registry, Counter, Gauge, Histogram, Family,
                       LATENCY_MS_BUCKETS, RATIO_BUCKETS, BYTES_BUCKETS)
-from .tracing import (TraceContext, Span, current_trace, activate, trace,
-                      maybe_span, get_trace, recent_trace_ids, all_traces,
-                      clear_traces)
+from .tracing import (TraceContext, LazyTrace, Span, current_trace,
+                      activate, trace, maybe_span, get_trace,
+                      recent_trace_ids, all_traces, clear_traces)
 from .export import (render_prometheus, render_json, write_snapshot,
-                     start_snapshotter, stop_snapshotter)
+                     start_snapshotter, stop_snapshotter,
+                     start_rank_snapshotter, lint_metric_names,
+                     METRIC_NAME_RE)
+from .sampling import (PeriodicSampler, TailSampler, ErrorSampler,
+                       SamplerChain, chain_from_config)
+from .server import (TelemetryServer, start_server, stop_server,
+                     server_address)
 
 __all__ = [
     "Registry", "Counter", "Gauge", "Histogram", "Family",
     "LATENCY_MS_BUCKETS", "RATIO_BUCKETS", "BYTES_BUCKETS",
-    "TraceContext", "Span", "current_trace", "activate", "trace",
-    "maybe_span", "get_trace", "recent_trace_ids", "all_traces",
-    "clear_traces",
+    "TraceContext", "LazyTrace", "Span", "current_trace", "activate",
+    "trace", "maybe_span", "get_trace", "recent_trace_ids",
+    "all_traces", "clear_traces",
     "render_prometheus", "render_json", "write_snapshot",
-    "start_snapshotter", "stop_snapshotter",
+    "start_snapshotter", "stop_snapshotter", "start_rank_snapshotter",
+    "lint_metric_names", "METRIC_NAME_RE",
+    "PeriodicSampler", "TailSampler", "ErrorSampler", "SamplerChain",
+    "chain_from_config",
+    "TelemetryServer", "start_server", "stop_server", "server_address",
     "enabled", "set_enabled", "registry", "counter", "gauge",
     "histogram", "bound", "reset", "dump_state", "trace_sample_every",
 ]
@@ -84,8 +102,10 @@ def set_enabled(value):
 
 
 def trace_sample_every():
-    """Request-tracing sample period: every Nth serving request gets a
-    full span tree (0 disables tracing; 1 traces everything)."""
+    """The retention chain's periodic baseline floor: every Nth
+    serving request is kept unconditionally, on top of the tail-biased
+    and error-keep samplers (sampling.py).  0 disables tracing
+    entirely; 1 keeps everything."""
     from .. import config
     return config.get("MXNET_TELEMETRY_TRACE_SAMPLE")
 
@@ -134,11 +154,14 @@ def dump_state(path):
     return path
 
 
-# Periodic snapshots autostart when configured (serving processes run
-# unattended for days); a final snapshot lands at interpreter exit.
+# Periodic snapshots and the HTTP endpoint autostart when configured
+# (serving processes run unattended for days); a final snapshot lands
+# at interpreter exit, and the server socket closes cleanly.
 def _maybe_autostart():
     from .. import config
-    if enabled() and config.get("MXNET_TELEMETRY_SNAPSHOT_SECS") > 0:
+    if not enabled():
+        return
+    if config.get("MXNET_TELEMETRY_SNAPSHOT_SECS") > 0:
         try:
             start_snapshotter()
         except Exception as e:
@@ -149,6 +172,16 @@ def _maybe_autostart():
             warnings.warn("telemetry snapshot autostart failed: %s" % e)
         else:
             atexit.register(stop_snapshotter)
+    if config.get("MXNET_TELEMETRY_PORT") >= 0:
+        try:
+            start_server()
+        except Exception as e:
+            # a taken port must not make `import mxnet_tpu` raise —
+            # ServingEngine construction retries the acquire later
+            import warnings
+            warnings.warn("telemetry HTTP server autostart failed: %s" % e)
+        else:
+            atexit.register(stop_server)
 
 
 _maybe_autostart()

@@ -21,6 +21,7 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import re
 import sys
 import threading
 
@@ -29,7 +30,14 @@ from ..base import MXNetError
 _TMP_SEQ = itertools.count()
 
 __all__ = ["render_prometheus", "render_json", "write_snapshot",
-           "start_snapshotter", "stop_snapshotter"]
+           "start_snapshotter", "stop_snapshotter",
+           "start_rank_snapshotter", "lint_metric_names",
+           "METRIC_NAME_RE"]
+
+# every metric this stack exposes must live in the mxnet_ namespace —
+# the exporter/docs drift gate (tests lint the live /metrics output
+# against this)
+METRIC_NAME_RE = re.compile(r"^mxnet_[a-z0-9_]+$")
 
 
 def _esc(v):
@@ -104,9 +112,12 @@ def _finite(obj):
     return obj
 
 
-def render_json(registry=None, include_traces=True):
+def render_json(registry=None, include_traces=True, meta=None):
     """Self-contained JSON document: metrics snapshot + finished
-    traces.  This is the format ``tools/telemetry_dump.py`` consumes."""
+    traces.  This is the format ``tools/telemetry_dump.py`` consumes.
+    ``meta`` merges extra top-level keys into the document — the rank
+    snapshotter stamps ``{"rank": N}`` so cross-host aggregation can
+    label each series with its source."""
     if registry is None:
         from . import registry as _default
         registry = _default()
@@ -114,11 +125,13 @@ def render_json(registry=None, include_traces=True):
     if include_traces:
         from . import tracing
         doc["traces"] = tracing.all_traces()
+    if meta:
+        doc.update(meta)
     return json.dumps(_finite(doc), indent=1, sort_keys=True,
                       allow_nan=False)
 
 
-def write_snapshot(path=None, fmt=None, registry=None):
+def write_snapshot(path=None, fmt=None, registry=None, meta=None):
     """Write one snapshot now.  ``path=None``/empty writes to stdout.
     Returns the rendered text.  File writes go through a same-directory
     temp file + ``os.replace`` so readers never observe a torn
@@ -129,7 +142,7 @@ def write_snapshot(path=None, fmt=None, registry=None):
     if fmt == "prom":
         text = render_prometheus(registry)
     elif fmt == "json":
-        text = render_json(registry)
+        text = render_json(registry, meta=meta)
     else:
         raise MXNetError("unknown telemetry snapshot format %r "
                          "(use 'prom' or 'json')" % (fmt,))
@@ -157,20 +170,26 @@ def write_snapshot(path=None, fmt=None, registry=None):
 
 
 class _Snapshotter(object):
-    def __init__(self, interval_s, path, fmt):
+    def __init__(self, interval_s, path, fmt, registry=None, meta=None):
         self.interval_s = float(interval_s)
         self.path = path
         self.fmt = fmt
+        self.registry = registry
+        self.meta = meta
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run,
                                         name="mxnet-telemetry-snapshot",
                                         daemon=True)
         self._thread.start()
 
+    def _write(self):
+        write_snapshot(self.path, self.fmt, registry=self.registry,
+                       meta=self.meta)
+
     def _run(self):
         while not self._stop.wait(self.interval_s):
             try:
-                write_snapshot(self.path, self.fmt)
+                self._write()
             except Exception:
                 pass        # a failed write must never kill the thread
 
@@ -179,7 +198,7 @@ class _Snapshotter(object):
         self._thread.join(timeout=5)
         if final:
             try:
-                write_snapshot(self.path, self.fmt)
+                self._write()
             except Exception:
                 pass
 
@@ -223,3 +242,59 @@ def stop_snapshotter(final=True):
         if _SNAPSHOTTER is not None:
             _SNAPSHOTTER.stop(final=final)
             _SNAPSHOTTER = None
+
+
+# -- cross-host aggregation: rank-tagged snapshots --------------------------
+
+_RANK_SNAPSHOTTERS = {}      # path -> _Snapshotter (replace on re-start)
+
+
+def start_rank_snapshotter(shared_dir, rank, interval_s=None,
+                           registry=None):
+    """Periodically write THIS process's registry snapshot as a
+    rank-tagged JSON file under ``shared_dir`` — the dist-kvstore tier
+    publishing into one place so ``tools/telemetry_dump.py aggregate``
+    can join N ranks into a single document.
+
+    The file is ``telemetry_rank<rank>.json`` (atomic replace, same as
+    every snapshot write) and the document carries a top-level
+    ``rank`` key, so aggregation never has to guess from filenames.
+    One snapshot is written immediately (short jobs must leave a
+    record); ``interval_s`` defaults to MXNET_TELEMETRY_SNAPSHOT_SECS,
+    falling back to 30 s when that is 0 (the shared-dir push being
+    requested at all implies somebody wants the data).  Returns a
+    handle with ``.stop()`` (writes one final snapshot).
+    """
+    from .. import config
+    os.makedirs(shared_dir, exist_ok=True)
+    path = os.path.join(shared_dir, "telemetry_rank%d.json" % int(rank))
+    meta = {"rank": int(rank)}
+    write_snapshot(path, "json", registry, meta=meta)
+    if interval_s is None:
+        interval_s = config.get("MXNET_TELEMETRY_SNAPSHOT_SECS") or 30.0
+    with _SNAP_LOCK:
+        old = _RANK_SNAPSHOTTERS.pop(path, None)
+        if old is not None:
+            old.stop(final=False)
+        snap = _Snapshotter(interval_s, path, "json", registry=registry,
+                            meta=meta)
+        _RANK_SNAPSHOTTERS[path] = snap
+    return snap
+
+
+# -- exporter/docs drift gate -----------------------------------------------
+
+def lint_metric_names(text=None, registry=None):
+    """Return every metric family name in a Prometheus exposition that
+    does NOT match ``^mxnet_[a-z0-9_]+$`` — the namespace contract the
+    docs promise.  ``text`` defaults to rendering ``registry`` (default
+    registry), i.e. exactly what ``GET /metrics`` would serve; CI runs
+    this over a live scrape so exporter and docs cannot drift."""
+    if text is None:
+        text = render_prometheus(registry)
+    bad = []
+    for line in text.splitlines():
+        m = re.match(r"# TYPE (\S+) ", line)
+        if m and not METRIC_NAME_RE.match(m.group(1)):
+            bad.append(m.group(1))
+    return bad

@@ -1,0 +1,193 @@
+"""Trace retention: decide at finish() which span trees to keep.
+
+The PR 3 sampler was count-based — every Nth request got a span tree.
+That is exactly backwards for the traffic an operator debugs: an
+unbiased sample is dominated by the fast requests that need no
+explanation, while the p99 stragglers (the requests a shape-bucketed
+serving engine lives or dies by) are kept with probability 1/N like
+everything else.
+
+This module inverts the decision: the engine now traces EVERY request
+cheaply (a TraceContext is a uuid + a span list; spans are recorded
+batch-wise) and retention is decided at ``finish()``, when the e2e
+latency is known, by a composable :class:`SamplerChain`:
+
+- :class:`ErrorSampler` — a trace that aborted (rejected, shed,
+  expired, cancelled, dispatch error) is always kept;
+- :class:`TailSampler` — *retroactively* keep a trace whose latency
+  lands in the current top-K slowest (``MXNET_TELEMETRY_TRACE_TAIL_K``)
+  or exceeds a moving p99 estimate over a sliding window, so every
+  tail request has a span tree;
+- :class:`PeriodicSampler` — the old every-Nth sampler survives as the
+  baseline floor (``MXNET_TELEMETRY_TRACE_SAMPLE``), so uniform fast
+  traffic still leaves a trickle of exemplars.
+
+``MXNET_TELEMETRY_TRACE_SAMPLE=0`` remains the tracing kill switch: it
+disables the whole chain (no per-request TraceContext at all), which
+keeps deterministic-run tests and zero-overhead expectations intact.
+
+Retention outcomes are themselves observable:
+``mxnet_telemetry_traces_retained_total{reason}`` /
+``mxnet_telemetry_traces_dropped_total`` — the /traces endpoint and
+``telemetry_dump top`` lean on the ``retained_by`` tag each kept tree
+carries.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+
+__all__ = ["PeriodicSampler", "TailSampler", "ErrorSampler",
+           "SamplerChain", "chain_from_config"]
+
+# sliding latency window backing the moving p99 estimate; recomputed
+# every _P99_REFRESH observations (sorting 512 floats ~10 us, amortized
+# to nothing)
+_P99_WINDOW = 512
+_P99_REFRESH = 64
+# the p99 rule only arms once the window has enough mass for the 99th
+# percentile to mean something (below this every request "exceeds p99")
+_P99_MIN_SAMPLES = 100
+
+
+class PeriodicSampler(object):
+    """Every-Nth baseline floor (the PR 3 sampler, demoted to one link
+    of the chain).  ``itertools.count`` is atomic under the GIL, so the
+    hot path is lock-free."""
+
+    reason = "periodic"
+
+    def __init__(self, every_n):
+        self.every_n = int(every_n)
+        self._seq = itertools.count()
+
+    def decide(self, dur_ms, failed_reason):
+        if self.every_n <= 0:
+            return None
+        if next(self._seq) % self.every_n == 0:
+            return self.reason
+        return None
+
+
+class TailSampler(object):
+    """Always-keep-slowest reservoir + moving-p99 trigger.
+
+    A trace is kept when its e2e latency (a) lands in the current
+    top-``k`` slowest seen so far (min-heap reservoir — early traffic
+    fills the heap, then only genuine tail latencies displace entries),
+    or (b) exceeds the current p99 estimate over a sliding window of
+    recent latencies (so a long-running engine whose top-K saturated on
+    startup transients still traces fresh stragglers).
+    """
+
+    def __init__(self, k):
+        self.k = int(k)
+        self._lock = threading.Lock()
+        self._heap = []                    # k smallest of the largest
+        self._window = []                  # ring buffer of recent ms
+        self._widx = 0
+        self._nobs = 0
+        self._p99 = None
+
+    def decide(self, dur_ms, failed_reason):
+        if self.k <= 0 or dur_ms is None:
+            return None
+        with self._lock:
+            # window + periodic p99 refresh (always observe, even when
+            # the top-K verdict below is negative — the estimate must
+            # reflect ALL traffic, not just retained traffic)
+            if len(self._window) < _P99_WINDOW:
+                self._window.append(dur_ms)
+            else:
+                self._window[self._widx] = dur_ms
+                self._widx = (self._widx + 1) % _P99_WINDOW
+            self._nobs += 1
+            if self._nobs % _P99_REFRESH == 0 and \
+                    len(self._window) >= _P99_MIN_SAMPLES:
+                s = sorted(self._window)
+                self._p99 = s[min(len(s) - 1,
+                                  int(round(0.99 * (len(s) - 1))))]
+            if len(self._heap) < self.k:
+                heapq.heappush(self._heap, dur_ms)
+                return "tail_topk"
+            if dur_ms > self._heap[0]:
+                heapq.heapreplace(self._heap, dur_ms)
+                return "tail_topk"
+            if self._p99 is not None and dur_ms >= self._p99:
+                return "tail_p99"
+        return None
+
+
+class ErrorSampler(object):
+    """Abort-triggered keep: rejected / shed / expired / cancelled /
+    dispatch-failed requests are exactly the traffic an operator
+    debugs; their span trees must never be sampled away."""
+
+    reason = "error"
+
+    def decide(self, dur_ms, failed_reason):
+        return self.reason if failed_reason is not None else None
+
+
+class SamplerChain(object):
+    """Run every sampler on every finished trace; keep on ANY vote.
+
+    Every sampler sees every observation (a periodic hit must not hide
+    the latency from the tail reservoir, or its p99 estimate would be
+    biased by retention), and the FIRST affirmative reason tags the
+    kept tree (``retained_by``).  Outcomes are counted in the registry
+    when instruments were bound (telemetry enabled at build time).
+    """
+
+    def __init__(self, samplers, retained_counter=None,
+                 dropped_counter=None):
+        self.samplers = tuple(samplers)
+        self._retained = retained_counter
+        self._dropped = dropped_counter
+
+    def decide(self, dur_ms, failed_reason):
+        """(keep, reason) for one finished trace."""
+        reason = None
+        for s in self.samplers:
+            r = s.decide(dur_ms, failed_reason)
+            if r is not None and reason is None:
+                reason = r
+        if reason is not None:
+            if self._retained is not None:
+                self._retained.labels(reason=reason).inc()
+            return True, reason
+        if self._dropped is not None:
+            self._dropped.inc()
+        return False, None
+
+
+def chain_from_config():
+    """The serving engine's retention chain, built from the
+    MXNET_TELEMETRY_TRACE_* env tier.  Returns ``None`` when tracing is
+    disabled outright (``MXNET_TELEMETRY_TRACE_SAMPLE=0``) — the engine
+    then creates no TraceContext at all, the PR 3 kill-switch contract.
+    """
+    from .. import config
+    every_n = config.get("MXNET_TELEMETRY_TRACE_SAMPLE")
+    if not every_n:
+        return None
+    samplers = [ErrorSampler()] \
+        if config.get("MXNET_TELEMETRY_TRACE_ERRORS") else []
+    tail_k = config.get("MXNET_TELEMETRY_TRACE_TAIL_K")
+    if tail_k > 0:
+        samplers.append(TailSampler(tail_k))
+    samplers.append(PeriodicSampler(every_n))
+    from . import registry
+    reg = registry()
+    return SamplerChain(
+        samplers,
+        retained_counter=reg.counter(
+            "mxnet_telemetry_traces_retained_total",
+            "finished traces kept by the retention chain, by the first "
+            "affirmative sampler (error / tail_topk / tail_p99 / "
+            "periodic)", labelnames=("reason",)),
+        dropped_counter=reg.counter(
+            "mxnet_telemetry_traces_dropped_total",
+            "finished traces discarded by the retention chain (traced "
+            "cheaply, not retained — fast uniform traffic)"))

@@ -1,0 +1,283 @@
+"""Live observability plane: a stdlib-only telemetry HTTP daemon.
+
+PR 3 left serving processes observable only post-hoc (snapshot files);
+a scraper could not poll a live process and an operator could not pull
+one request's span tree mid-incident.  This module serves the existing
+exporters over ``http.server.ThreadingHTTPServer`` — no third-party
+dependency, per the container constraint:
+
+- ``GET /metrics``       Prometheus text exposition (render_prometheus)
+- ``GET /metrics.json``  self-contained metrics+traces JSON document
+                         (render_json — the same file format
+                         tools/telemetry_dump.py consumes offline)
+- ``GET /traces``        retained trace ids + one-line summaries
+                         (name, e2e ms, retained_by, failed reason)
+- ``GET /traces/<id>``   one request's full span tree
+- ``GET /healthz``       liveness: uptime, queue depth + occupancy
+                         summed over live engines, trace-store size
+
+Start it explicitly (``telemetry.start_server(port)``) or let the
+``MXNET_TELEMETRY_PORT`` env knob start it — at telemetry import for
+any process, or lazily at ServingEngine construction, in which case
+``ServingEngine.close()`` releases it (refcounted across co-resident
+engines) so reload-in-a-loop neither leaks the port nor the thread.
+
+Concurrency: every request handler renders from a point-in-time
+``Registry.collect()`` snapshot (instrument locks are held per-value,
+never across the render), so a scrape racing engine mutation can never
+observe a torn exposition document — tests parse every response under
+a pounding thread to hold that line.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..base import MXNetError
+
+__all__ = ["TelemetryServer", "start_server", "stop_server",
+           "server_address"]
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the server sets .telemetry_server on the class instance (see
+    # TelemetryServer.__init__); keep HTTP/1.1 so scrapers reuse
+    # connections
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # noqa: A003 - stdlib signature
+        pass                             # scrapes must not spam stderr
+
+    # ------------------------------------------------------------ responses
+    def _send(self, code, body, content_type):
+        data = body.encode("utf-8") if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code, obj):
+        self._send(code, json.dumps(obj, indent=1, sort_keys=True),
+                   "application/json")
+
+    # ------------------------------------------------------------- routing
+    def do_GET(self):                    # noqa: N802 - stdlib signature
+        try:
+            self._route(self.path.split("?", 1)[0].rstrip("/") or "/")
+        except (BrokenPipeError, ConnectionResetError):
+            pass                         # scraper hung up mid-response
+        except Exception as e:           # never kill the handler thread
+            try:
+                self._send_json(500, {"error": str(e)})
+            except Exception:
+                pass
+
+    def _route(self, path):
+        from . import render_prometheus, render_json, tracing
+        if path == "/metrics":
+            self._send(200, render_prometheus(), PROM_CONTENT_TYPE)
+        elif path == "/metrics.json":
+            self._send(200, render_json(), "application/json")
+        elif path == "/traces":
+            self._send_json(200, _trace_index())
+        elif path.startswith("/traces/"):
+            tid = path[len("/traces/"):]
+            tree = tracing.get_trace(tid)
+            if tree is None:
+                self._send_json(404, {
+                    "error": "trace %r not found (evicted or never "
+                             "retained)" % tid,
+                    "stored": len(tracing.recent_trace_ids())})
+            else:
+                self._send_json(200, tree)
+        elif path in ("/", "/healthz"):
+            self._send_json(200, _healthz(self.server.telemetry_server))
+        else:
+            self._send_json(404, {
+                "error": "unknown route %r" % path,
+                "routes": ["/metrics", "/metrics.json", "/traces",
+                           "/traces/<id>", "/healthz"]})
+
+
+def _trace_index():
+    """One summary row per retained trace, oldest first — enough to
+    pick a trace id without pulling every tree."""
+    from . import tracing
+    rows = []
+    for tid, tree in tracing.all_traces().items():
+        root = tree.get("root", {})
+        row = {"trace_id": tid, "name": root.get("name"),
+               "dur_ms": root.get("dur_ms")}
+        if tree.get("retained_by"):
+            row["retained_by"] = tree["retained_by"]
+        for child in root.get("children", ()):
+            if child.get("name") == "failed":
+                row["failed"] = (child.get("meta") or {}).get("reason")
+                break
+        rows.append(row)
+    return {"count": len(rows), "traces": rows}
+
+
+def _healthz(server):
+    """Liveness + the two numbers an operator checks first: how deep
+    the admission queues are and how full dispatched batches run.
+    Derived from the registry (collect() runs the engine refresh
+    callbacks), so it is exactly what /metrics would report."""
+    from . import registry, tracing
+    doc = registry().collect()
+    qd = doc.get("mxnet_serve_queue_depth", {}).get("series", [])
+    occ = doc.get("mxnet_serve_batch_occupancy", {}).get("series", [])
+    occ_count = sum(s.get("count") or 0 for s in occ)
+    occ_sum = sum(s.get("sum") or 0.0 for s in occ)
+    return {
+        "status": "ok",
+        "uptime_s": round(time.monotonic() - server.t_start, 3),
+        "port": server.port,
+        "engines": len(qd),
+        "queue_depth": sum(s.get("value") or 0 for s in qd),
+        "batch_occupancy": (occ_sum / occ_count if occ_count else 0.0),
+        "batches": occ_count,
+        "traces_stored": len(tracing.recent_trace_ids()),
+    }
+
+
+class TelemetryServer(object):
+    """One daemonized ThreadingHTTPServer bound at construction (so
+    ``port`` is final immediately, including the port-0 ephemeral
+    case) and serving until :meth:`stop`."""
+
+    def __init__(self, port, host=""):
+        try:
+            self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        except OSError as e:
+            raise MXNetError(
+                "telemetry server: cannot bind %s:%s (%s)"
+                % (host or "0.0.0.0", port, e))
+        self._httpd.daemon_threads = True
+        self._httpd.telemetry_server = self
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self.t_start = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="mxnet-telemetry-http", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        """Shut down and release the port; joins the acceptor thread so
+        a caller can rebind the same port immediately after."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+# -- process-wide singleton + engine refcounting ----------------------------
+#
+# Two owners exist: an EXPLICIT start_server() (operator code / the
+# import-time MXNET_TELEMETRY_PORT autostart), which only stop_server()
+# ends, and ENGINE-ACQUIRED servers — the first ServingEngine to find
+# MXNET_TELEMETRY_PORT set with no server running starts one, every
+# engine holds a reference, and the last close() stops it.  That makes
+# engine-reload loops leak-free without letting one engine's close tear
+# down a server the operator started deliberately.
+
+_LOCK = threading.Lock()
+_SERVER = None
+_MANUAL = False          # True: outlives engine refcounting
+_ENGINE_REFS = 0
+
+
+def start_server(port=None, host=""):
+    """Start (or replace) the process-wide telemetry HTTP server.
+    ``port`` defaults to ``MXNET_TELEMETRY_PORT``; 0 binds an ephemeral
+    port (read it back off the returned server's ``.port``)."""
+    global _SERVER, _MANUAL, _ENGINE_REFS
+    if port is None:
+        from .. import config
+        port = config.get("MXNET_TELEMETRY_PORT")
+    if port is None or int(port) < 0:
+        raise MXNetError(
+            "telemetry server: no port (pass one or set "
+            "MXNET_TELEMETRY_PORT >= 0; 0 = ephemeral)")
+    with _LOCK:
+        if _SERVER is not None:
+            # clear BEFORE binding the replacement: if the new bind
+            # fails, the module must know no server is live (a stale
+            # reference would report a dead address and stop engines
+            # from ever restarting the endpoint)
+            _SERVER.stop()
+            _SERVER = None
+            _MANUAL = False
+            _ENGINE_REFS = 0
+        _SERVER = TelemetryServer(port, host)
+        _MANUAL = True
+        return _SERVER
+
+
+def stop_server():
+    """Stop the process-wide server (no-op when none is running)."""
+    global _SERVER, _MANUAL, _ENGINE_REFS
+    with _LOCK:
+        if _SERVER is not None:
+            _SERVER.stop()
+        _SERVER = None
+        _MANUAL = False
+        _ENGINE_REFS = 0
+
+
+def server_address():
+    """``(host, port)`` of the live server, or ``None``."""
+    with _LOCK:
+        if _SERVER is None:
+            return None
+        return (_SERVER.host or "0.0.0.0", _SERVER.port)
+
+
+def engine_acquire():
+    """ServingEngine construction hook: ensure a server is running when
+    ``MXNET_TELEMETRY_PORT`` asks for one.  Returns True when this
+    engine now holds a reference (its close() must call
+    :func:`engine_release`); False when no server is configured or an
+    explicitly-started server already covers the process."""
+    global _SERVER, _ENGINE_REFS
+    with _LOCK:
+        if _SERVER is not None:
+            if _MANUAL:
+                return False             # operator-owned: engines hands off
+            _ENGINE_REFS += 1
+            return True
+        from .. import config
+        port = config.get("MXNET_TELEMETRY_PORT")
+        if port < 0:
+            return False
+        try:
+            _SERVER = TelemetryServer(port)
+        except MXNetError as e:
+            # a taken port must degrade observability, never break
+            # engine construction
+            import warnings
+            warnings.warn(str(e))
+            return False
+        _ENGINE_REFS = 1
+        return True
+
+
+def engine_release():
+    """Drop one engine reference; the last one out stops the server
+    (releasing port AND acceptor thread — engine-reload loops must not
+    accumulate either)."""
+    global _SERVER, _ENGINE_REFS
+    with _LOCK:
+        if _MANUAL or _SERVER is None:
+            return
+        _ENGINE_REFS = max(0, _ENGINE_REFS - 1)
+        if _ENGINE_REFS == 0:
+            _SERVER.stop()
+            _SERVER = None

@@ -15,10 +15,18 @@ submitting client to the serving worker:
 
 Finished traces land in a bounded in-process store retrievable by
 trace id (``MXNET_TELEMETRY_TRACE_CAPACITY``, oldest evicted) — the
-source ``tools/telemetry_dump.py`` renders span breakdowns from — and
-every span is bridged into the :mod:`mxnet_tpu.profiler` Chrome-trace
-ring as a categorized event carrying its ``trace_id`` arg, so one
-perfetto timeline shows requests and host regions interleaved.
+source ``tools/telemetry_dump.py`` and the live ``/traces`` endpoint
+render span breakdowns from — and every span is bridged into the
+:mod:`mxnet_tpu.profiler` Chrome-trace ring as a categorized event
+carrying its ``trace_id`` arg, so one perfetto timeline shows requests
+and host regions interleaved.
+
+A TraceContext built with a ``retention`` chain (sampling.py) defers
+the keep/drop decision to ``finish()``, when the end-to-end latency is
+known: dropped traces are never stored nor bridged (they cost one
+discarded object), kept traces carry a ``retained_by`` tag.  Without a
+chain, ``finish()`` stores unconditionally — the explicit
+``telemetry.trace(...)`` entry point keeps its PR 3 contract.
 
 Span timestamps use ``time.perf_counter()`` — the same clock the
 profiler ring is anchored to.
@@ -28,18 +36,32 @@ from __future__ import annotations
 import collections
 import contextlib
 import contextvars
+import itertools
+import random
 import threading
 import time
-import uuid
 
-__all__ = ["Span", "TraceContext", "current_trace", "activate", "trace",
-           "maybe_span", "get_trace", "recent_trace_ids", "all_traces",
-           "clear_traces", "store_capacity"]
+__all__ = ["Span", "TraceContext", "LazyTrace", "current_trace",
+           "activate", "trace", "maybe_span", "get_trace",
+           "recent_trace_ids", "all_traces", "clear_traces",
+           "store_capacity"]
 
 _CURRENT = contextvars.ContextVar("mxnet_tpu_trace", default=None)
 
 _STORE_LOCK = threading.Lock()
 _STORE = collections.OrderedDict()      # trace_id -> finished tree dict
+
+# Trace ids: 24 random bits fixed per process + a 40-bit atomic counter
+# (itertools.count is GIL-atomic), formatted to the same 16 hex chars as
+# the old uuid4 prefix.  uuid4 costs a urandom syscall (~70 us on this
+# class of host) — unaffordable now that EVERY serving request carries
+# a TraceContext and retention is decided at finish.
+_ID_BASE = random.getrandbits(24)
+_ID_SEQ = itertools.count()
+
+
+def _new_trace_id():
+    return "%06x%010x" % (_ID_BASE, next(_ID_SEQ) & 0xFFFFFFFFFF)
 
 
 def store_capacity():
@@ -87,14 +109,19 @@ class TraceContext(object):
     (client submit -> engine worker), and the lock makes the handoff
     safe without any happens-before choreography at the call sites.
     """
-    __slots__ = ("trace_id", "root", "_stack", "_lock", "finished")
+    __slots__ = ("trace_id", "root", "_stack", "_lock", "finished",
+                 "retention", "failed_reason")
 
-    def __init__(self, name="request", cat="trace"):
-        self.trace_id = uuid.uuid4().hex[:16]
+    def __init__(self, name="request", cat="trace", retention=None):
+        self.trace_id = _new_trace_id()
         self.root = Span(name, cat)
         self._stack = [self.root]
         self._lock = threading.Lock()
         self.finished = False
+        # sampling.SamplerChain (or None = always keep): consulted once
+        # at finish(), when the e2e latency is known
+        self.retention = retention
+        self.failed_reason = None
 
     # -- structured recording ---------------------------------------------
     @contextlib.contextmanager
@@ -149,13 +176,19 @@ class TraceContext(object):
         traffic an operator is debugging — still leaves a record."""
         if self.finished:
             return
+        self.failed_reason = str(reason)
         t = time.perf_counter()
         self.add("failed", t, t, "serve", meta={"reason": str(reason)})
         self.finish(t)
 
-    def finish(self, t1=None):
-        """Close the root, publish the tree to the bounded store, and
-        bridge every span into the profiler ring (when running)."""
+    def finish(self, t1=None, retained_by=None):
+        """Close the root; when the retention chain (if any) votes
+        keep, publish the tree to the bounded store and bridge every
+        span into the profiler ring (when running) — a dropped trace
+        inserts nothing and bridges nothing.  ``retained_by`` tags the
+        stored tree when the keep decision was made EXTERNALLY
+        (:class:`LazyTrace` decides before this object even exists).
+        """
         with self._lock:
             if self.finished:
                 return
@@ -165,7 +198,14 @@ class TraceContext(object):
                 if sp.t1 is None:
                     sp.t1 = t1
             self._stack = [self.root]
+        if self.retention is not None:
+            keep, retained_by = self.retention.decide(
+                (t1 - self.root.t0) * 1e3, self.failed_reason)
+            if not keep:
+                return
         tree = self.to_dict()
+        if retained_by is not None:
+            tree["retained_by"] = retained_by
         with _STORE_LOCK:
             _STORE[self.trace_id] = tree
             cap = store_capacity()
@@ -190,6 +230,68 @@ class TraceContext(object):
             for c in sp.children:
                 walk(c)
         walk(self.root)
+
+
+class LazyTrace(object):
+    """The cost-free way to trace EVERY serving request: one timestamp
+    at submit, one retention decision at finish — a real
+    :class:`TraceContext` (spans, store insert, profiler bridge) is
+    built ONLY for the kept minority, retroactively, from timestamps
+    the engine already holds.
+
+    The serving hot path pays ~one object allocation plus the sampler
+    chain's decision per dropped request; everything else (trace id,
+    span objects, locks, tree rendering) is deferred behind the keep
+    verdict.  Quacks like TraceContext where the engine and admission
+    controller touch it: ``abort(reason)`` on every failure path, and
+    ``finish(t1, build)`` where ``build(tc)`` attaches the batch-stage
+    spans to the freshly materialized context.
+    """
+    __slots__ = ("t0", "retention", "finished", "name", "cat")
+
+    def __init__(self, retention, name="serve.request", cat="serve"):
+        self.t0 = time.perf_counter()
+        self.retention = retention
+        self.finished = False
+        self.name = name
+        self.cat = cat
+
+    def _materialize(self):
+        tc = TraceContext(self.name, self.cat)
+        tc.root.t0 = self.t0
+        return tc
+
+    def finish(self, t1=None, build=None):
+        """Decide retention; when kept, materialize the TraceContext,
+        let ``build(tc)`` attach spans, and publish."""
+        if self.finished:
+            return
+        self.finished = True
+        t1 = time.perf_counter() if t1 is None else t1
+        keep, why = self.retention.decide((t1 - self.t0) * 1e3, None)
+        if not keep:
+            return
+        tc = self._materialize()
+        if build is not None:
+            build(tc)
+        tc.finish(t1, retained_by=why)
+
+    def abort(self, reason):
+        """Failure path (rejected/shed/expired/cancelled/dispatch
+        error): decide with the failure reason — the error sampler
+        keeps these unconditionally — and record why."""
+        if self.finished:
+            return
+        self.finished = True
+        t1 = time.perf_counter()
+        keep, why = self.retention.decide((t1 - self.t0) * 1e3,
+                                          str(reason))
+        if not keep:
+            return
+        tc = self._materialize()
+        tc.failed_reason = str(reason)
+        tc.add("failed", t1, t1, "serve", meta={"reason": str(reason)})
+        tc.finish(t1, retained_by=why)
 
 
 # -- contextvar propagation (same-thread nesting) ---------------------------

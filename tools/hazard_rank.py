@@ -28,10 +28,12 @@ Usage::
         --json > lint.json
     python tools/telemetry_dump.py snapshot telemetry.json   # or raw file
     python tools/hazard_rank.py lint.json telemetry.json [--top N] [--json]
+    python tools/hazard_rank.py lint.json --url http://host:9100
 
-The telemetry file is whatever the runtime wrote: a
+The telemetry source is whatever the runtime wrote — a
 ``telemetry.dump_state`` JSON document or a periodic snapshot
-(``MXNET_TELEMETRY_SNAPSHOT_FORMAT=json``).
+(``MXNET_TELEMETRY_SNAPSHOT_FORMAT=json``) — or the live
+``MXNET_TELEMETRY_PORT`` endpoint scraped via ``--url``.
 """
 from __future__ import annotations
 
@@ -182,12 +184,21 @@ def main(argv=None):
         description="rank graph_lint retrace hazards by observed "
                     "telemetry impact")
     ap.add_argument("lint_json", help="graph_lint --json output")
-    ap.add_argument("telemetry", help="telemetry dump/snapshot file")
+    ap.add_argument("telemetry", nargs="?",
+                    help="telemetry dump/snapshot file (or http:// URL)")
+    ap.add_argument("--url",
+                    help="scrape a live MXNET_TELEMETRY_PORT endpoint "
+                         "as the telemetry source instead of a file")
     ap.add_argument("--top", type=int, default=0,
                     help="print only the top N hazards")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     args = ap.parse_args(argv)
+    telemetry_src = args.url or args.telemetry
+    if not telemetry_src:
+        print("hazard_rank: pass a telemetry file or --url "
+              "http://host:port", file=sys.stderr)
+        return 2
 
     try:
         hazards = load_lint(args.lint_json)
@@ -197,10 +208,10 @@ def main(argv=None):
         return 2
     try:
         retraces, fp_engines, shared, exposure = \
-            load_observations(args.telemetry)
+            load_observations(telemetry_src)
     except Exception as e:
         print("hazard_rank: cannot read telemetry %r: %s"
-              % (args.telemetry, e), file=sys.stderr)
+              % (telemetry_src, e), file=sys.stderr)
         return 2
 
     rows = rank(hazards, retraces, fp_engines, shared, exposure)

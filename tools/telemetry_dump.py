@@ -1,31 +1,59 @@
 """Render telemetry state: metrics snapshots and per-request span trees.
 
 Consumes the self-contained JSON document the runtime writes
-(``telemetry.dump_state(path)``, or the periodic snapshot thread with
-``MXNET_TELEMETRY_SNAPSHOT_FORMAT=json``), or a live Prometheus-text
-snapshot (printed verbatim).  A serving process stays uninspected only
-until someone has one of those files::
+(``telemetry.dump_state(path)``, the periodic snapshot thread with
+``MXNET_TELEMETRY_SNAPSHOT_FORMAT=json``, or a rank-tagged
+``telemetry_rank<N>.json`` from the dist tier), a live Prometheus-text
+snapshot (printed verbatim) — or the live HTTP endpoint itself: every
+source argument also accepts ``http://host:port`` (``--url`` is an
+alias), which scrapes ``/metrics.json`` off a running
+``MXNET_TELEMETRY_PORT`` server::
 
   python tools/telemetry_dump.py snapshot telemetry.json
+  python tools/telemetry_dump.py snapshot --url http://host:9100
   python tools/telemetry_dump.py traces telemetry.json
   python tools/telemetry_dump.py trace 1c96ce8a1ace4cf6 telemetry.json
+  python tools/telemetry_dump.py top --url http://host:9100 --k 5
+  python tools/telemetry_dump.py aggregate shared/telemetry_rank*.json
 
 ``snapshot`` prints one line per series with histogram count/mean/max
 bucket; ``trace`` prints the request's span tree with per-stage start
 and duration — the "where did THIS request's latency go" view
 (queue-wait -> coalesce -> pad -> dispatch -> unpad for serving
-traffic).
+traffic).  ``top`` lists the K slowest retained traces with their
+dominant span (tail-biased retention makes these exactly the p99
+stragglers).  ``aggregate`` merges N rank-tagged snapshots into one
+document: every series gains a ``rank`` label, counters (and
+same-bucket histograms) get a summed ``rank="all"`` series, and gauges
+report per-rank spread (min/max/argmax) — a straggling worker is one
+command away.
 """
 import argparse
 import json
 import sys
 
 
-def load_doc(path):
-    """Parse a dump file: JSON documents load structurally; anything
-    else (Prometheus text) passes through as {'text': ...}."""
-    with open(path) as f:
-        raw = f.read()
+def _fetch_url(url):
+    """Scrape a live endpoint.  A bare http://host:port targets the
+    self-contained /metrics.json document; any explicit path is
+    fetched as-is (so /metrics passes through as Prometheus text)."""
+    from urllib.parse import urlparse
+    from urllib.request import urlopen
+    if urlparse(url).path in ("", "/"):
+        url = url.rstrip("/") + "/metrics.json"
+    with urlopen(url, timeout=10) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+def load_doc(src):
+    """Parse a dump source — a file path or an http(s) URL: JSON
+    documents load structurally; anything else (Prometheus text)
+    passes through as {'text': ...}."""
+    if src.startswith("http://") or src.startswith("https://"):
+        raw = _fetch_url(src)
+    else:
+        with open(src) as f:
+            raw = f.read()
     try:
         doc = json.loads(raw)
     except ValueError:
@@ -80,7 +108,10 @@ def format_metrics(metrics):
 
 def format_trace(tree):
     """Indented span tree with per-span offset + duration in ms."""
-    lines = ["trace %s" % tree["trace_id"]]
+    head = "trace %s" % tree["trace_id"]
+    if tree.get("retained_by"):
+        head += "  (retained by %s)" % tree["retained_by"]
+    lines = [head]
 
     def walk(span, depth):
         dur = span.get("dur_ms")
@@ -97,20 +128,218 @@ def format_trace(tree):
     return "\n".join(lines)
 
 
+def dominant_span(tree):
+    """(name, dur_ms) of the longest non-root span in one trace — the
+    stage that owns the request's latency (queue-wait vs dispatch is
+    the first question of every tail investigation)."""
+    best = (None, -1.0)
+
+    def walk(span, is_root):
+        nonlocal best
+        dur = span.get("dur_ms")
+        if not is_root and dur is not None and dur > best[1]:
+            best = (span.get("name"), dur)
+        for child in span.get("children", ()):
+            walk(child, False)
+
+    walk(tree.get("root", {}), True)
+    return best
+
+
+def slowest_traces(traces, k):
+    """The k slowest finished traces, slowest first."""
+    rows = [(tree["root"].get("dur_ms") or 0.0, tid, tree)
+            for tid, tree in traces.items()
+            if tree.get("root", {}).get("dur_ms") is not None]
+    rows.sort(key=lambda r: -r[0])
+    return rows[:k]
+
+
+# ---------------------------------------------------------------------------
+# cross-host aggregation
+# ---------------------------------------------------------------------------
+
+def _doc_rank(doc, src, index, used):
+    """Rank for one snapshot: the document's own 'rank' key (the rank
+    snapshotter stamps it), else rank<N> in the filename, else the
+    positional index; deduplicated so two files claiming one rank
+    cannot silently merge."""
+    import re
+    rank = doc.get("rank")
+    if rank is None:
+        m = re.search(r"rank(\d+)", src)
+        rank = int(m.group(1)) if m else index
+    rank = str(rank)
+    if rank in used:
+        rank = "%s.%d" % (rank, index)
+    used.add(rank)
+    return rank
+
+
+def _label_key(labels, drop=("rank",)):
+    return tuple(sorted((k, v) for k, v in labels.items()
+                        if k not in drop))
+
+
+def aggregate_docs(entries):
+    """Merge [(rank, doc)] into one rank-labeled document.
+
+    - every series is re-emitted with a ``rank`` label;
+    - counters gain a summed ``rank="all"`` series per distinct base
+      label set;
+    - histograms whose bucket boundaries agree across ranks gain a
+      merged ``rank="all"`` series (element-wise counts + sum/count);
+      disagreeing boundaries stay per-rank only (summing them would
+      lie about `le` semantics);
+    - gauges get a ``gauge_spread`` section instead of a sum (a summed
+      queue depth hides exactly the straggler this exists to find):
+      min / max / argmax-rank / spread per base label set.
+    """
+    metrics_out, spread = {}, {}
+    for rank, doc in entries:
+        for name, fam in (doc.get("metrics") or {}).items():
+            agg = metrics_out.setdefault(name, {
+                "kind": fam.get("kind"),
+                "doc": fam.get("doc", ""),
+                "labelnames": list(fam.get("labelnames", ())) + ["rank"],
+                "series": []})
+            for s in fam.get("series", ()):
+                s2 = dict(s)
+                s2["labels"] = dict(s.get("labels") or {})
+                s2["labels"]["rank"] = rank
+                agg["series"].append(s2)
+
+    for name, fam in metrics_out.items():
+        groups = {}
+        for s in fam["series"]:
+            groups.setdefault(_label_key(s["labels"]), []).append(s)
+        if fam["kind"] == "counter":
+            for key, members in sorted(groups.items()):
+                total = sum(m.get("value") or 0 for m in members)
+                fam["series"].append(
+                    {"labels": dict(key, rank="all"), "value": total})
+        elif fam["kind"] == "histogram":
+            for key, members in sorted(groups.items()):
+                bounds = {tuple(m.get("buckets") or ()) for m in members}
+                if len(bounds) != 1:
+                    continue
+                counts = [0] * (len(bounds.pop()) + 1)
+                for m in members:
+                    for i, c in enumerate(m.get("counts") or ()):
+                        counts[i] += c
+                fam["series"].append({
+                    "labels": dict(key, rank="all"),
+                    "buckets": list(members[0]["buckets"]),
+                    "counts": counts,
+                    "sum": sum(m.get("sum") or 0.0 for m in members),
+                    "count": sum(m.get("count") or 0 for m in members)})
+        elif fam["kind"] == "gauge":
+            for key, members in sorted(groups.items()):
+                vals = [(m.get("value"), m["labels"]["rank"])
+                        for m in members if m.get("value") is not None]
+                if not vals:
+                    continue
+                lo, lo_rank = min(vals)
+                hi, hi_rank = max(vals)
+                spread.setdefault(name, {})[_fmt_labels(dict(key)) or
+                                            "(no labels)"] = {
+                    "min": lo, "min_rank": lo_rank,
+                    "max": hi, "max_rank": hi_rank,
+                    "spread": hi - lo}
+    return {"format": "mxnet_tpu.telemetry/aggregate-1",
+            "ranks": [r for r, _ in entries],
+            "metrics": metrics_out,
+            "gauge_spread": spread}
+
+
+def format_gauge_spread(spread):
+    """Per-rank gauge spread, widest first — the straggler view."""
+    lines = []
+    rows = [(v["spread"], name, labels, v)
+            for name, by_label in spread.items()
+            for labels, v in by_label.items()]
+    rows.sort(key=lambda r: (-r[0], r[1], r[2]))
+    for _, name, labels, v in rows:
+        lines.append(
+            "%s%s  min=%s (rank %s)  max=%s (rank %s)  spread=%s"
+            % (name, "" if labels == "(no labels)" else labels,
+               _num(v["min"]), v["min_rank"],
+               _num(v["max"]), v["max_rank"], _num(v["spread"])))
+    return "\n".join(lines)
+
+
+def _resolve_source(args, what="snapshot file"):
+    src = getattr(args, "url", None) or getattr(args, "file", None)
+    if not src:
+        print("telemetry_dump: pass a %s or --url http://host:port"
+              % what, file=sys.stderr)
+        return None
+    return src
+
+
+def _add_source(parser):
+    parser.add_argument("file", nargs="?",
+                        help="dump/snapshot file (or an http:// URL)")
+    parser.add_argument("--url",
+                        help="scrape a live MXNET_TELEMETRY_PORT "
+                             "endpoint instead of reading a file")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="render mxnet_tpu telemetry dumps")
     sub = ap.add_subparsers(dest="cmd", required=True)
     p_snap = sub.add_parser("snapshot", help="render the metrics snapshot")
-    p_snap.add_argument("file")
+    _add_source(p_snap)
     p_list = sub.add_parser("traces", help="list stored trace ids")
-    p_list.add_argument("file")
+    _add_source(p_list)
     p_tr = sub.add_parser("trace", help="render one request's span tree")
     p_tr.add_argument("trace_id")
-    p_tr.add_argument("file")
+    _add_source(p_tr)
+    p_top = sub.add_parser(
+        "top", help="K slowest retained traces with their dominant span")
+    p_top.add_argument("--k", type=int, default=10)
+    _add_source(p_top)
+    p_agg = sub.add_parser(
+        "aggregate",
+        help="merge rank-tagged snapshots into one rank-labeled document")
+    p_agg.add_argument("files", nargs="+",
+                       help="two or more telemetry_rank<N>.json snapshots")
+    p_agg.add_argument("--json", action="store_true", dest="as_json",
+                       help="print the merged document instead of text")
+    p_agg.add_argument("--out", help="also write the merged document here")
     args = ap.parse_args(argv)
 
-    doc = load_doc(args.file)
+    if args.cmd == "aggregate":
+        used, entries = set(), []
+        for i, src in enumerate(args.files):
+            doc = load_doc(src)
+            if "text" in doc:
+                print("aggregate needs JSON snapshots; %r is Prometheus "
+                      "text (re-dump with "
+                      "MXNET_TELEMETRY_SNAPSHOT_FORMAT=json)" % src,
+                      file=sys.stderr)
+                return 2
+            entries.append((_doc_rank(doc, src, i, used), doc))
+        merged = aggregate_docs(entries)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(merged, f, indent=1, sort_keys=True)
+        if args.as_json:
+            print(json.dumps(merged, indent=1, sort_keys=True))
+        else:
+            print("aggregated %d rank snapshot(s): %s"
+                  % (len(entries), ", ".join(r for r, _ in entries)))
+            print(format_metrics(merged["metrics"]))
+            if merged["gauge_spread"]:
+                print("\nper-rank gauge spread (widest first):")
+                print(format_gauge_spread(merged["gauge_spread"]))
+        return 0
+
+    src = _resolve_source(args)
+    if src is None:
+        return 2
+    doc = load_doc(src)
     if "text" in doc:                       # Prometheus text: verbatim
         print(doc["text"], end="")
         return 0
@@ -118,6 +347,19 @@ def main(argv=None):
         print(format_metrics(doc.get("metrics", {})))
         return 0
     traces = doc.get("traces", {})
+    if args.cmd == "top":
+        rows = slowest_traces(traces, args.k)
+        if not rows:
+            print("(no finished traces stored)")
+            return 0
+        print("%-16s %12s  %-12s %s"
+              % ("trace", "e2e ms", "retained_by", "dominant span"))
+        for dur, tid, tree in rows:
+            name, span_ms = dominant_span(tree)
+            print("%-16s %12.3f  %-12s %s"
+                  % (tid, dur, tree.get("retained_by", "-"),
+                     "%s (%.3f ms)" % (name, span_ms) if name else "-"))
+        return 0
     if args.cmd == "traces":
         if not traces:
             print("(no traces stored — is MXNET_TELEMETRY_TRACE_SAMPLE "
