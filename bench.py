@@ -43,19 +43,12 @@ import time
 
 import numpy as np
 
-_PEAKS_TFLOPS = {  # bf16 peak by device kind substring
-    "v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
-    "v6 lite": 918.0, "v6e": 918.0,
-    "v4": 275.0, "v3": 123.0, "v2": 45.0,
-}
-
-
 def _peak_for(device):
-    kind = getattr(device, "device_kind", "").lower()
-    for key, val in _PEAKS_TFLOPS.items():
-        if key in kind:
-            return val * 1e12
-    return None  # unknown device kind: no honest MFU denominator
+    """bf16 peak FLOP/s, or None for unknown kinds (no honest MFU
+    denominator).  The table lives in telemetry/step.py so this bench
+    and the live ``mxnet_train_mfu`` gauge share one source of truth."""
+    from mxnet_tpu.telemetry.step import peak_flops_for
+    return peak_flops_for(device)
 
 
 def _make_raw_rec(path, n, stored, seed=0):
@@ -150,9 +143,20 @@ def _device_main():
     labels = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.float32))
     compiled = step.lower(data_u8, labels, params, auxs, key).compile()
     try:
-        step_flops = compiled.cost_analysis().get("flops", 0.0)
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):        # older jax returns [dict]
+            ca = ca[0]
+        step_flops = ca.get("flops", 0.0)
     except Exception:
         step_flops = 0.0
+    # cross-check: the static analytic count (analysis/flops.py — the
+    # live mxnet_train_mfu gauge's numerator) against XLA's own number
+    # for the same program; reported side by side so drift is visible
+    try:
+        from mxnet_tpu.analysis.flops import count_flops
+        analytic_flops = count_flops(net, shapes, training=True)["total"]
+    except Exception:
+        analytic_flops = 0.0
 
     # ---- compute-only measurement (protocol: PROFILE_r04) ----
     # Corrected r4 protocol (PROFILE_r04.md finding 0): the r1-r3 K2-K1
@@ -310,6 +314,7 @@ def _device_main():
         "step_ms": round(dt * 1e3, 2),
         "batch": batch,
         "xla_gflops_per_step": round(step_flops / 1e9, 1),
+        "analytic_gflops_per_step": round(analytic_flops / 1e9, 1),
         "peak_tflops": round(peak / 1e12, 1) if peak else None,
         "device": getattr(dev, "device_kind", dev.platform),
         "platform": dev.platform,

@@ -20,7 +20,10 @@ Pass families (``DEFAULT_PASSES`` order):
   busting attr values, host-callback ops in hot paths (retrace.py);
 - ``padding`` — padding-soundness: classifies the graph row-local vs
   cross-position along serving's zero-padded axes, tracking the
-  constant each axis's pad slots are known to hold (padding.py).
+  constant each axis's pad slots are known to hold (padding.py);
+- ``flops``   — analytic per-op FLOP counting over the abstract
+  interpreter's per-node concrete shapes: the live MFU gauge's
+  numerator, cross-checked against XLA ``cost_analysis`` (flops.py).
 
 Verdicts drive rewrites, not just diagnostics: ``rewrite.py`` consumes
 the padding pass's structured violations and splices valid-length-
@@ -51,6 +54,7 @@ from .verifier import VerifierPass
 from .shapes import ShapeDtypePass
 from .retrace import RetraceHazardPass
 from .padding import PaddingSoundnessPass, classify_padding, PadViolation
+from .flops import FlopsPass, count_flops
 from .rewrite import RepairPlan, plan_repair, repair_serving_graph
 
 __all__ = [
@@ -61,6 +65,7 @@ __all__ = [
     "GraphView", "find_cycle", "splice_input", "redirect_entries",
     "VerifierPass", "ShapeDtypePass", "RetraceHazardPass",
     "PaddingSoundnessPass", "classify_padding", "PadViolation",
+    "FlopsPass", "count_flops",
     "RepairPlan", "plan_repair", "repair_serving_graph",
     "check_serving_graph", "verify",
 ]

@@ -114,6 +114,9 @@ def analyze(symbol, data_shapes=None, dtypes=None, policy=None,
         # the padding rules resolve axes/ranks from the shape
         # environment; without it they degrade to blanket conservatism
         names.insert(names.index("padding"), "shapes")
+    if "flops" in names and "shapes" not in names:
+        # the FLOP formulas read per-node concrete shapes
+        names.insert(names.index("flops"), "shapes")
     if "verify" not in names:
         names.insert(0, "verify")
     elif names[0] != "verify":

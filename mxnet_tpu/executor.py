@@ -503,26 +503,31 @@ class Executor:
 
     def forward(self, is_train=False, **kwargs):
         import jax
-        for k, v in kwargs.items():
-            if k not in self.arg_dict:
-                raise MXNetError("forward: unknown argument %r" % k)
-            sh = self._sharding.get(k) if self._sharding else None
-            if isinstance(v, NDArray):
-                v = v._data
-            if sh is None:
-                dev = self._ctx.jax_device()
-                if hasattr(v, "sharding"):
-                    # host-pipeline batches arrive on the CPU backend; move
-                    # them onto the executor's device when they differ
-                    if v.sharding.device_set != {dev}:
-                        v = jax.device_put(v, dev)
-                    self.arg_dict[k]._data = v
+        from .telemetry import step as _step
+        with _step.active_phase("h2d"):
+            # batch upload: attributed as the training step's h2d phase
+            # when a StepTimer is ambient (no-op otherwise)
+            for k, v in kwargs.items():
+                if k not in self.arg_dict:
+                    raise MXNetError("forward: unknown argument %r" % k)
+                sh = self._sharding.get(k) if self._sharding else None
+                if isinstance(v, NDArray):
+                    v = v._data
+                if sh is None:
+                    dev = self._ctx.jax_device()
+                    if hasattr(v, "sharding"):
+                        # host-pipeline batches arrive on the CPU backend;
+                        # move them onto the executor's device when they
+                        # differ
+                        if v.sharding.device_set != {dev}:
+                            v = jax.device_put(v, dev)
+                        self.arg_dict[k]._data = v
+                    else:
+                        self.arg_dict[k]._data = jax.device_put(
+                            _np.asarray(v), dev)
                 else:
-                    self.arg_dict[k]._data = jax.device_put(
-                        _np.asarray(v), dev)
-            else:
-                # batch feed: local slice on multi-process meshes
-                self.arg_dict[k]._data = self._place_local(v, sh)
+                    # batch feed: local slice on multi-process meshes
+                    self.arg_dict[k]._data = self._place_local(v, sh)
         if is_train:
             # lazy: the fused fwd+bwd program at backward() computes outputs
             # too, so running forward now would execute the graph twice.

@@ -91,37 +91,46 @@ class Trainer(object):
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimizer update scaled by 1/batch_size
-        (trainer.py:156)."""
+        (trainer.py:156).
+
+        Step attribution: joins the ambient StepTimer when a fit()-style
+        loop drives it; standalone gluon loops get each step() counted
+        as one step on the ``loop="trainer"`` series (kv_push/kv_pull
+        phases land from the kvstore veneer, optimizer self-time here).
+        """
         if not self._kv_initialized:
             self._init_kvstore()
 
         self._optimizer.rescale_grad = self._scale / batch_size
 
+        from ..telemetry import step as step_mod
         kv = self._kvstore_obj
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
-                continue
-            g = param.grad()
-            if kv is not None and "dist" in kv.type:
-                # cross-process gradient allreduce (DCN collectives): push
-                # the local grad, pull back the aggregate, update locally.
-                # This is only sound while the store has no updater — with
-                # one installed, push would apply the optimizer server-side
-                # and the pull below would feed a *weight* to the local
-                # updater as a gradient.
-                if getattr(kv, "_updater", None) is not None:
-                    raise MXNetError(
-                        "Trainer's dist path requires a store without an "
-                        "updater; use update_on_kvstore instead")
-                kv.push(i, g)
-                kv.pull(i, out=g)
+        with step_mod.ensure_step("trainer"), \
+                step_mod.active_phase("optimizer"):
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null":
+                    continue
+                g = param.grad()
+                if kv is not None and "dist" in kv.type:
+                    # cross-process gradient allreduce (DCN collectives):
+                    # push the local grad, pull back the aggregate, update
+                    # locally.  This is only sound while the store has no
+                    # updater — with one installed, push would apply the
+                    # optimizer server-side and the pull below would feed a
+                    # *weight* to the local updater as a gradient.
+                    if getattr(kv, "_updater", None) is not None:
+                        raise MXNetError(
+                            "Trainer's dist path requires a store without "
+                            "an updater; use update_on_kvstore instead")
+                    kv.push(i, g)
+                    kv.pull(i, out=g)
+                    self._updaters[0](i, g, param.data())
+                    continue
+                if kv is not None and self._update_on_kvstore:
+                    kv.push(i, g)
+                    kv.pull(i, out=param.data())
+                    continue
                 self._updaters[0](i, g, param.data())
-                continue
-            if kv is not None and self._update_on_kvstore:
-                kv.push(i, g)
-                kv.pull(i, out=param.data())
-                continue
-            self._updaters[0](i, g, param.data())
 
     def save_states(self, fname):
         assert self._optimizer is not None

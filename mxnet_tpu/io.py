@@ -39,6 +39,7 @@ def _observe_batch(iter_obj, t0):
     ``_telemetry_label`` so traffic is attributed to the class the
     user built."""
     from . import telemetry
+    from .telemetry import step as _step
     label = getattr(iter_obj, "_telemetry_label",
                     None) or type(iter_obj).__name__
     child = telemetry.bound(
@@ -48,6 +49,11 @@ def _observe_batch(iter_obj, t0):
             "host input-pipeline time to produce one batch, by iterator",
             ("iter",)).labels(iter=label))
     child.observe((time.perf_counter() - t0) * 1e3)
+    # span-only note on the ambient training step (fit's data_wait
+    # phase already owns this interval in the histograms — the trace
+    # just shows how much of the wait was batch PRODUCTION vs blocked
+    # time; prefetch-thread production has no ambient step and no-ops)
+    _step.annotate_active("io.batch[%s]" % label, t0)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
            "PrefetchingIter", "NDArrayIter", "MNISTIter", "CSVIter",

@@ -57,8 +57,13 @@ _KV_INSTR = {}          # direction -> memoized (ops, bytes, payload, lat)
 def _kv_observe(direction, nkeys, nbytes, t0):
     """Record one push/pull against the telemetry registry (callers
     gate on telemetry.enabled() so the disabled path costs nothing;
-    children memoized per direction — no registry lock per op)."""
+    children memoized per direction — no registry lock per op).  The
+    same measured interval lands on the ambient training StepTimer as
+    the kv_push/kv_pull step phase, joining the per-direction series
+    to the per-step attribution without timing the call twice."""
     from . import telemetry
+    from .telemetry import step as _step
+    _step.observe_active("kv_" + direction, t0)
 
     def _bind():
         return (

@@ -6,6 +6,7 @@ forward_backward:189, score:205, predict:320, fit:376).
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 
@@ -174,6 +175,12 @@ class BaseModule(object):
         is queued: JAX dispatch is async, so host-side IO for batch t+1
         overlaps the device computing batch t — the same overlap the
         reference gets from its dependency engine's prefetch.
+
+        When telemetry is enabled, every step's wall time is attributed
+        to phases (data_wait / h2d / fwd_bwd / kv_push / kv_pull /
+        optimizer / metric — telemetry/step.py) on the ``loop="fit"``
+        series, with tail-biased per-step span trees and a live
+        analytic-FLOPs MFU gauge.
         """
         if num_epoch is None:
             raise ValueError("fit() needs num_epoch")
@@ -193,25 +200,62 @@ class BaseModule(object):
         if not isinstance(eval_metric, metric.EvalMetric):
             eval_metric = metric.create(eval_metric)
 
+        from ..telemetry import step as step_mod
+        try:
+            # the device this module is bound to (Module._context), so
+            # MFU peak / memory watermark report against the training
+            # chip, not whatever jax.devices()[0] happens to be
+            dev = self._context[0].jax_device()
+        except Exception:
+            dev = None
+        st = step_mod.fit_timer(self._symbol, train_data.provide_data,
+                                train_data.provide_label, device=dev)
+
         for epoch in range(begin_epoch, num_epoch):
             t_epoch = time.time()
             eval_metric.reset()
 
-            for nbatch, (batch, upcoming) in \
-                    enumerate(_lookahead_iter(train_data)):
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(batch)
-                self.update()
-                if upcoming is not None:
-                    self.prepare(upcoming)
-                self.update_metric(eval_metric, batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                _invoke(batch_end_callback,
-                        BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                      eval_metric=eval_metric,
-                                      locals=locals()))
+            nbatch = 0
+            lookahead = _lookahead_iter(train_data)
+            while True:
+                if st is not None:
+                    st.begin_step()
+                exhausted = False
+                try:
+                    with (step_mod.activate(st) if st is not None
+                          else contextlib.nullcontext()):
+                        with step_mod.active_phase("data_wait"):
+                            pair = next(lookahead, None)
+                        if pair is None:
+                            exhausted = True
+                        else:
+                            batch, upcoming = pair
+                            if monitor is not None:
+                                monitor.tic()
+                            with step_mod.active_phase("fwd_bwd"):
+                                self.forward_backward(batch)
+                            self.update()   # optimizer/kv phases inside
+                            if upcoming is not None:
+                                self.prepare(upcoming)
+                            with step_mod.active_phase("metric"):
+                                self.update_metric(eval_metric,
+                                                   batch.label)
+                            if monitor is not None:
+                                monitor.toc_print()
+                            _invoke(batch_end_callback,
+                                    BatchEndParam(epoch=epoch,
+                                                  nbatch=nbatch,
+                                                  eval_metric=eval_metric,
+                                                  locals=locals()))
+                finally:
+                    if st is not None:
+                        if exhausted:
+                            st.abort_step()
+                        else:
+                            st.end_step()
+                if exhausted:
+                    break
+                nbatch += 1
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

@@ -478,9 +478,13 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
         from .. import profiler
+        from ..telemetry import step as step_mod
         self._params_dirty = True
-        with profiler.record_span("update", "update"):
-            self._update_impl()
+        # step attribution: self-time is the optimizer math — nested
+        # kv_push/kv_pull phases (kvstore.py) subtract themselves
+        with step_mod.active_phase("optimizer"):
+            with profiler.record_span("update", "update"):
+                self._update_impl()
 
     def _update_impl(self):
         if self._update_on_kvstore:

@@ -32,6 +32,7 @@ class Monitor(object):
         self.step = 0
         self.activated = False
         self.exes = []
+        self._published = set()     # tensor labels this Monitor created
         self.logger = logging.getLogger(__name__)
 
     def stat_helper(self, name, arr):
@@ -60,6 +61,7 @@ class Monitor(object):
                         "latest Monitor stat_func value per monitored "
                         "tensor", ("tensor",)).labels(tensor=name)
                 ).set(value)
+                self._published.add(name)
 
     def install(self, exe):
         """Attach to an executor (ref Monitor.install)."""
@@ -95,3 +97,22 @@ class Monitor(object):
     def toc_print(self):
         for step, name, stat in self.toc():
             self.logger.info("Batch: %7d %30s %s", step, name, stat)
+
+    def close(self):
+        """Reclaim this Monitor's telemetry gauge series (mirrors
+        ``ServingEngine.close()``): a train-reload loop that builds a
+        Monitor per run must not grow one orphaned
+        ``mxnet_monitor_tensor_stat`` series per monitored tensor per
+        run in every future scrape.  The shared memo cache entries are
+        dropped too, so a LATER Monitor re-binds fresh children instead
+        of writing to removed (scrape-invisible) instruments."""
+        from . import telemetry
+        fam = telemetry.registry().get("mxnet_monitor_tensor_stat")
+        for name in self._published:
+            if fam is not None:
+                fam.remove(tensor=name)
+            _STAT_GAUGES.pop(name, None)
+        self._published.clear()
+        self.activated = False
+        self.queue = []
+        self.exes = []

@@ -9,6 +9,7 @@ program.  With M microbatches and P stages the scan runs M+P-1 ticks.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 
 __all__ = ["pipeline_shard_map", "pipeline_stage_fn",
@@ -402,6 +403,8 @@ class PipelineModule(object):
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
+        self._own_step = None   # StepTimer step opened by fb, closed
+        #                         by update (standalone attribution)
 
     # -- homogeneous path --------------------------------------------------
     def _bind_homo(self, data_shapes):
@@ -545,17 +548,55 @@ class PipelineModule(object):
 
     def forward_backward(self, data_batch):
         import jax.numpy as jnp
-        x = jnp.asarray(data_batch.data[0].asnumpy())
-        y = jnp.asarray(data_batch.label[0].asnumpy())
+        from .. import telemetry
+        from ..telemetry import step as step_mod
+        st = step_mod.active_timer()
+        if st is None or st._t0 is None:
+            # standalone driver (this module is not a BaseModule, so no
+            # fit() opens a step): the step spans forward_backward
+            # through update() — opening it only in update() would lose
+            # the h2d staging below to the void
+            if self._own_step is not None:      # fb without update()
+                self._own_step.abort_step()
+                self._own_step = None
+            st = None
+            if telemetry.enabled():
+                st = step_mod.default_timer("pipeline")
+                st.begin_step()
+                self._own_step = st
+        with (st.phase("h2d") if st is not None
+              else contextlib.nullcontext()):
+            # staging the batch onto the mesh is this driver's upload
+            x = jnp.asarray(data_batch.data[0].asnumpy())
+            y = jnp.asarray(data_batch.label[0].asnumpy())
         self._pending = (x, y)
 
     def update(self):
+        from ..telemetry import step as step_mod
         x, y = self._pending
-        if self._hetero:
-            self._loss, self._packed, self._packed_aux = self._hstep(
-                self._packed, self._packed_aux, x, y)
+
+        def dispatch():
+            if self._hetero:
+                self._loss, self._packed, self._packed_aux = self._hstep(
+                    self._packed, self._packed_aux, x, y)
+            else:
+                self._loss, self._params = self._train_step(self._params,
+                                                            x, y)
+
+        own = self._own_step
+        if own is not None:
+            # close the step forward_backward opened
+            self._own_step = None
+            try:
+                with own.phase("fwd_bwd"):
+                    dispatch()
+            finally:
+                own.end_step()
         else:
-            self._loss, self._params = self._train_step(self._params, x, y)
+            # driven under an ambient fit()-style step (or telemetry
+            # off): attribute into it / no-op
+            with step_mod.active_phase("fwd_bwd"):
+                dispatch()
         return self._loss
 
     @property

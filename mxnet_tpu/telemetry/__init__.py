@@ -53,9 +53,12 @@ from .export import (render_prometheus, render_json, write_snapshot,
                      start_rank_snapshotter, lint_metric_names,
                      METRIC_NAME_RE)
 from .sampling import (PeriodicSampler, TailSampler, ErrorSampler,
-                       SamplerChain, chain_from_config)
+                       SamplerChain, chain_from_config,
+                       persist_tail_state, restore_tail_state)
 from .server import (TelemetryServer, start_server, stop_server,
                      server_address)
+from .step import (StepTimer, PHASES, STEP_SECONDS_BUCKETS,
+                   PEAKS_TFLOPS, peak_flops_for)
 
 __all__ = [
     "Registry", "Counter", "Gauge", "Histogram", "Family",
@@ -67,8 +70,10 @@ __all__ = [
     "start_snapshotter", "stop_snapshotter", "start_rank_snapshotter",
     "lint_metric_names", "METRIC_NAME_RE",
     "PeriodicSampler", "TailSampler", "ErrorSampler", "SamplerChain",
-    "chain_from_config",
+    "chain_from_config", "persist_tail_state", "restore_tail_state",
     "TelemetryServer", "start_server", "stop_server", "server_address",
+    "StepTimer", "PHASES", "STEP_SECONDS_BUCKETS", "PEAKS_TFLOPS",
+    "peak_flops_for",
     "enabled", "set_enabled", "registry", "counter", "gauge",
     "histogram", "bound", "reset", "dump_state", "trace_sample_every",
 ]
@@ -161,6 +166,11 @@ def _maybe_autostart():
     from .. import config
     if not enabled():
         return
+    if config.get("MXNET_TELEMETRY_SNAPSHOT_PATH"):
+        # ROADMAP 5c: the TailSampler's moving-p99 window survives a
+        # process reload through a snapshot-path sidecar — written at
+        # exit here, restored by the first chain_from_config() call
+        atexit.register(persist_tail_state)
     if config.get("MXNET_TELEMETRY_SNAPSHOT_SECS") > 0:
         try:
             start_snapshotter()

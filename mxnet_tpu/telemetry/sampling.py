@@ -36,10 +36,14 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import json
+import os
 import threading
 
 __all__ = ["PeriodicSampler", "TailSampler", "ErrorSampler",
-           "SamplerChain", "chain_from_config"]
+           "SamplerChain", "chain_from_config",
+           "persist_tail_state", "restore_tail_state",
+           "tail_state_path"]
 
 # sliding latency window backing the moving p99 estimate; recomputed
 # every _P99_REFRESH observations (sorting 512 floats ~10 us, amortized
@@ -89,6 +93,38 @@ class TailSampler(object):
         self._widx = 0
         self._nobs = 0
         self._p99 = None
+
+    # -- persistence (ROADMAP 5c: the moving-p99 estimate must survive
+    # a serving-process reload, or every restart re-traces the first
+    # ~100+top-K requests as "tail" while the window re-warms) --------
+    def state(self):
+        """JSON-able snapshot of the sliding window + top-K reservoir."""
+        with self._lock:
+            return {"k": self.k, "window": list(self._window),
+                    "widx": self._widx, "nobs": self._nobs,
+                    "p99": self._p99, "heap": list(self._heap)}
+
+    def restore(self, state):
+        """Adopt a snapshot from :meth:`state` (trimmed to this
+        sampler's window/K bounds); malformed fields are ignored —
+        restoring stale state must never break trace retention."""
+        try:
+            window = [float(x) for x in state.get("window", [])]
+            heap = sorted(float(x) for x in state.get("heap", []))
+            p99 = state.get("p99")
+            p99 = float(p99) if p99 is not None else None
+            nobs = int(state.get("nobs", 0))
+            widx = int(state.get("widx", 0))
+        except (AttributeError, TypeError, ValueError):
+            return      # every conversion happens BEFORE any mutation
+        with self._lock:
+            self._window = window[-_P99_WINDOW:]
+            self._widx = widx % _P99_WINDOW \
+                if len(self._window) >= _P99_WINDOW else 0
+            self._nobs = max(nobs, 0)
+            self._p99 = p99
+            self._heap = heap[-self.k:]
+            heapq.heapify(self._heap)
 
     def decide(self, dur_ms, failed_reason):
         if self.k <= 0 or dur_ms is None:
@@ -167,6 +203,11 @@ def chain_from_config():
     MXNET_TELEMETRY_TRACE_* env tier.  Returns ``None`` when tracing is
     disabled outright (``MXNET_TELEMETRY_TRACE_SAMPLE=0``) — the engine
     then creates no TraceContext at all, the PR 3 kill-switch contract.
+
+    A freshly built TailSampler is seeded from the last persisted
+    window (:func:`restore_tail_state`, auto-loaded once per process
+    from the snapshot-path sidecar) and tracked so
+    :func:`persist_tail_state` can serialize it at shutdown.
     """
     from .. import config
     every_n = config.get("MXNET_TELEMETRY_TRACE_SAMPLE")
@@ -176,7 +217,20 @@ def chain_from_config():
         if config.get("MXNET_TELEMETRY_TRACE_ERRORS") else []
     tail_k = config.get("MXNET_TELEMETRY_TRACE_TAIL_K")
     if tail_k > 0:
-        samplers.append(TailSampler(tail_k))
+        ts = TailSampler(tail_k)
+        st = _restored_tail_state()
+        if st:
+            ts.restore(st)
+            _consume_restored()     # first chain after start only
+        _LIVE_TAIL.append(ts)
+        if len(_LIVE_TAIL) > 8:
+            # bounded strong refs (the atexit persist must still see a
+            # sampler after its fit()-local timer is GC'd) — evict the
+            # LEAST-observed, not the oldest: a reload loop churning
+            # fresh chains must never push the warmed long-lived
+            # window out of persistence reach
+            _LIVE_TAIL.remove(min(_LIVE_TAIL, key=lambda t: t._nobs))
+        samplers.append(ts)
     samplers.append(PeriodicSampler(every_n))
     from . import registry
     reg = registry()
@@ -191,3 +245,96 @@ def chain_from_config():
             "mxnet_telemetry_traces_dropped_total",
             "finished traces discarded by the retention chain (traced "
             "cheaply, not retained — fast uniform traffic)"))
+
+
+# -- moving-p99 persistence across reloads (ROADMAP 5c) ---------------------
+#
+# The TailSampler's p99 estimate needs ~100 observations to arm; a
+# reload loop that rebuilds the chain every restart spends that whole
+# warmup keeping everything "tail".  The window is serialized as a
+# sidecar of the snapshot path (atomic replace, same discipline as
+# every snapshot write) at interpreter exit and restored into the
+# first chain built after start.
+
+_LIVE_TAIL = []         # TailSamplers built by chain_from_config (kept
+#                         strongly, bounded to the 8 newest: a fit()'s
+#                         StepTimer dies with fit, but its window must
+#                         still be serializable at interpreter exit)
+_RESTORED = None        # loaded state, adopted by the next TailSampler
+_AUTOLOAD_DONE = False
+
+
+def tail_state_path(path=None):
+    """Explicit ``path`` wins; else the MXNET_TELEMETRY_SNAPSHOT_PATH
+    sidecar ``<path>.tailstate.json``; None when neither is set."""
+    if path:
+        return path
+    from .. import config
+    base = config.get("MXNET_TELEMETRY_SNAPSHOT_PATH")
+    return (base + ".tailstate.json") if base else None
+
+
+def _live_tail_sampler():
+    """The sampler worth persisting: the one that has observed the
+    most traffic — NOT simply the newest, or a just-built toy chain
+    (a 3-step fit in a serving process) would overwrite the long-lived
+    chain's warmed window in the sidecar at exit."""
+    if not _LIVE_TAIL:
+        return None
+    return max(_LIVE_TAIL, key=lambda t: t._nobs)
+
+
+def persist_tail_state(path=None):
+    """Serialize the MOST-OBSERVED live TailSampler's window/heap/p99
+    to the sidecar file (see :func:`_live_tail_sampler`).  Returns the
+    path written, or None (no live sampler, no path, or a failed
+    write — persistence is advisory)."""
+    p = tail_state_path(path)
+    ts = _live_tail_sampler()
+    if not p or ts is None:
+        return None
+    tmp = "%s.tmp.%d" % (p, os.getpid())
+    try:
+        with open(tmp, "w") as f:
+            json.dump(ts.state(), f)
+        os.replace(tmp, p)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return p
+
+
+def restore_tail_state(path=None):
+    """Load a persisted window so the NEXT TailSampler built (the next
+    chain_from_config call) starts warm.  Returns the loaded state or
+    None.  Called automatically (once, from the default sidecar) the
+    first time a chain is built; call explicitly to restore from a
+    non-default path or to re-arm after telemetry.reset()."""
+    global _RESTORED, _AUTOLOAD_DONE
+    _AUTOLOAD_DONE = True
+    p = tail_state_path(path)
+    if not p or not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            _RESTORED = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return _RESTORED
+
+
+def _restored_tail_state():
+    if not _AUTOLOAD_DONE:
+        restore_tail_state()
+    return _RESTORED
+
+
+def _consume_restored():
+    """Adopt-once: a chain built hours into the process must NOT be
+    re-seeded from the boot-time sidecar (its window would reset the
+    p99 estimate backward to pre-warmup traffic)."""
+    global _RESTORED
+    _RESTORED = None

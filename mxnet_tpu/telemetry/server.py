@@ -135,7 +135,7 @@ def _healthz(server):
     occ = doc.get("mxnet_serve_batch_occupancy", {}).get("series", [])
     occ_count = sum(s.get("count") or 0 for s in occ)
     occ_sum = sum(s.get("sum") or 0.0 for s in occ)
-    return {
+    out = {
         "status": "ok",
         "uptime_s": round(time.monotonic() - server.t_start, 3),
         "port": server.port,
@@ -145,6 +145,14 @@ def _healthz(server):
         "batches": occ_count,
         "traces_stored": len(tracing.recent_trace_ids()),
     }
+    # training processes: step count + live MFU per instrumented loop
+    steps = doc.get("mxnet_train_steps_total", {}).get("series", [])
+    if steps:
+        out["train_steps"] = sum(s.get("value") or 0 for s in steps)
+        out["train_mfu"] = {
+            s["labels"].get("loop", "?"): s.get("value") or 0.0
+            for s in doc.get("mxnet_train_mfu", {}).get("series", [])}
+    return out
 
 
 class TelemetryServer(object):

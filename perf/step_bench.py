@@ -9,6 +9,15 @@ experiment knobs so each PROFILE_r04 lever is one command:
   python perf/step_bench.py --trace /tmp/xp      # 3-step xplane capture
   python perf/step_bench.py --batch 512
 
+Plus the training-path telemetry overhead gate (the serve_bench
+protocol applied to fit()): ``--telemetry`` times a toy Module.fit
+workload in off-on-off triples, compares the median of the centered
+ratios against ``--telemetry-tol`` PLUS the same-session A/A noise
+floor, and exits 1 on a real regression.  ``--record`` writes the row
+to BENCH_step_telemetry.json:
+
+  python perf/step_bench.py --telemetry --record BENCH_step_telemetry.json
+
 Wall-clock per-call timing through the dev tunnel is unreliable for micro
 ops (identical calls appear to be served from a cache), but the full train
 step chains params call-to-call (donated), so the K2-K1 marginal on real
@@ -23,6 +32,102 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_train_telemetry_overhead(steps=60, batch=256, feature=256,
+                                 hidden=512, classes=10, repeats=3,
+                                 tol=0.02):
+    """Training-path telemetry overhead: fit() throughput with the
+    step-attribution plane ON (phase timers, per-step trace retention,
+    MFU gauge) must stay within ``tol`` of the OFF path.
+
+    serve_bench's estimator, verbatim: each repeat times an off-on-off
+    TRIPLE of identical one-epoch fit() calls on two pre-warmed
+    modules (one per mode — instruments bind per fit), the gate
+    compares the median centered ratio mean(off_a, off_b)/on against
+    tol PLUS the A/A noise floor median(|1 - off_a/off_b|), so an
+    oversubscribed host cannot report scheduler chaos as telemetry
+    cost — nor hide a real regression that clears the floor.
+    """
+    import logging
+    import statistics
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    rng = np.random.RandomState(0)
+    n = steps * batch
+    X = rng.randn(n, feature).astype(np.float32)
+    Y = rng.randint(0, classes, (n,)).astype(np.float32)
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    quiet = logging.getLogger("step_bench.quiet")
+    quiet.setLevel(logging.ERROR)
+
+    def make(enabled):
+        telemetry.set_enabled(enabled)
+        try:
+            it = mx.io.NDArrayIter(X, Y, batch_size=batch)
+            mod = mx.mod.Module(net, context=mx.cpu(), logger=quiet)
+            # warmup fit: bind + compile; later fit() calls reuse the
+            # bound executor (fit ignores re-bind/re-init), so the
+            # timed rounds measure warm steps, not XLA compiles
+            mod.fit(it, num_epoch=1,
+                    optimizer_params={"learning_rate": 0.01})
+        finally:
+            telemetry.set_enabled(None)
+        return mod, it
+
+    mod_off, it_off = make(False)
+    mod_on, it_on = make(True)
+
+    def round_s(mod, it, enabled):
+        telemetry.set_enabled(enabled)
+        try:
+            it.reset()
+            t0 = time.perf_counter()
+            mod.fit(it, num_epoch=1,
+                    optimizer_params={"learning_rate": 0.01})
+            return time.perf_counter() - t0
+        finally:
+            telemetry.set_enabled(None)
+
+    off_s = on_s = float("inf")
+    centered, nulls = [], []
+    # re-fitting a bound module warns (already bound / already
+    # initialized) once per timed round — that is the point here, so
+    # silence warnings for the timed rounds
+    logging.disable(logging.WARNING)
+    try:
+        for _ in range(repeats):
+            off_a = round_s(mod_off, it_off, False)
+            on_i = round_s(mod_on, it_on, True)
+            off_b = round_s(mod_off, it_off, False)
+            off_s = min(off_s, off_a, off_b)
+            on_s = min(on_s, on_i)
+            centered.append((off_a + off_b) / 2.0 / on_i)
+            nulls.append(abs(1.0 - off_a / off_b))
+    finally:
+        logging.disable(logging.NOTSET)
+    regression = 1.0 - statistics.median(centered)
+    noise_floor = statistics.median(nulls)
+    return {
+        "workload": "fit[%d steps x batch %d, %d-%d-%d mlp]"
+                    % (steps, batch, feature, hidden, classes),
+        "steps_per_s_telemetry_off": round(steps / off_s, 1),
+        "steps_per_s_telemetry_on": round(steps / on_s, 1),
+        "regression": round(regression, 4),
+        "noise_floor": round(noise_floor, 4),
+        "tol": tol,
+        "ok": regression < tol + noise_floor,
+    }
 
 
 def main():
@@ -49,7 +154,44 @@ def main():
     ap.add_argument("--reps", type=int, default=3,
                     help="timed blocks; result is the min block average")
     ap.add_argument("--label", default="")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="run the training-path telemetry overhead "
+                         "gate (toy fit() workload, off-on-off "
+                         "centered-median estimator + A/A noise "
+                         "floor) instead of the ResNet step bench")
+    ap.add_argument("--telemetry-tol", type=float, default=0.02,
+                    help="allowed fractional fit() regression with "
+                         "telemetry on (default 0.02 = 2%%)")
+    ap.add_argument("--telemetry-steps", type=int, default=60,
+                    help="steps per timed fit() round in the gate")
+    ap.add_argument("--record", metavar="PATH",
+                    help="write the telemetry-gate row to this JSON "
+                         "file (BENCH_step_telemetry.json bookkeeping)")
     args = ap.parse_args()
+
+    if args.telemetry:
+        # --reps is the bench's one repeat knob: here it counts
+        # off-on-off triples (vs timed blocks for the ResNet bench)
+        row = run_train_telemetry_overhead(
+            steps=args.telemetry_steps, repeats=args.reps,
+            tol=args.telemetry_tol)
+        print(json.dumps(row))
+        if args.record:
+            with open(args.record, "w") as f:
+                json.dump({"train_telemetry_overhead": row}, f,
+                          indent=1, sort_keys=True)
+                f.write("\n")
+        if not row["ok"]:
+            print("FAIL: training telemetry costs %.2f%% (tol %.2f%% "
+                  "+ measured noise floor %.2f%%)"
+                  % (row["regression"] * 1e2, row["tol"] * 1e2,
+                     row["noise_floor"] * 1e2))
+            sys.exit(1)
+        print("OK: training telemetry overhead %.2f%% < %.2f%% tol "
+              "+ %.2f%% noise floor"
+              % (row["regression"] * 1e2, row["tol"] * 1e2,
+                 row["noise_floor"] * 1e2))
+        return
 
     os.environ["MXNET_CONV_DOT_1X1"] = "1" if args.conv1x1 == "dot" else "0"
 
@@ -172,9 +314,8 @@ def main():
         averages.append((time.perf_counter() - t0) / K)
     dt = min(averages)
 
-    peak = {"v5 lite": 197e12, "v5e": 197e12}.get(
-        next((kk for kk in ("v5 lite", "v5e")
-              if kk in getattr(dev, "device_kind", "").lower()), None))
+    from mxnet_tpu.telemetry.step import peak_flops_for
+    peak = peak_flops_for(dev)
     mfu = step_flops / dt / peak if (peak and step_flops and not on_cpu) else 0
     print(json.dumps({
         "label": args.label or f"conv1x1={args.conv1x1}",

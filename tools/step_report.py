@@ -1,0 +1,251 @@
+"""Training-step attribution report: where does each step's wall go?
+
+Renders the ``mxnet_train_*`` series (telemetry/step.py) as one
+attribution table per training loop — phase rows (data_wait / h2d /
+fwd_bwd / kv_push / kv_pull / optimizer / metric), an explicit
+**unattributed residual** row (step wall minus the phase sum — the
+breakdown must confess what it could not attribute), the phase
+coverage ratio, and the MFU / FLOPs / compile / device-memory scalars
+— plus the input-pipeline production histograms (io.py) next to the
+loop's measured data_wait so "iterator too slow" vs "loop never
+waited" is one read.
+
+Sources: a telemetry JSON snapshot (``telemetry.dump_state``, the
+snapshot thread, or a rank snapshot), a live endpoint via ``--url``,
+or SEVERAL rank snapshots — which are aggregated first
+(tools/telemetry_dump.py machinery), rendering the fleet-summed
+``rank="all"`` table and a per-phase straggler section naming the rank
+whose mean phase time is largest::
+
+  python tools/step_report.py telemetry.json
+  python tools/step_report.py --url http://host:9100
+  python tools/step_report.py shared/telemetry_rank*.json   # straggler view
+"""
+import argparse
+import json
+import sys
+
+from telemetry_dump import load_doc, aggregate_docs, _doc_rank
+
+#: canonical row order (telemetry/step.py PHASES); unknown phases sort after
+PHASE_ORDER = ("data_wait", "h2d", "fwd_bwd", "kv_push", "kv_pull",
+               "optimizer", "metric")
+
+RESIDUAL_ROW = "unattributed residual"
+
+
+def _series(metrics, name):
+    return (metrics.get(name) or {}).get("series", [])
+
+
+def _scalar(metrics, name, loop, rank, reduce=None):
+    """One scalar for (loop, rank).  Aggregated documents carry no
+    rank="all" series for GAUGES (aggregate_docs only spreads them),
+    so when asked for the fleet value this falls back to reducing the
+    per-rank series with ``reduce`` (mean for ratios like MFU, max for
+    watermarks)."""
+    vals = []
+    for s in _series(metrics, name):
+        lab = s.get("labels", {})
+        if lab.get("loop") != loop:
+            continue
+        srank = lab.get("rank", rank)
+        if srank == rank:
+            return s.get("value")
+        if rank == "all" and s.get("value") is not None:
+            vals.append(s["value"])
+    if rank == "all" and vals and reduce is not None:
+        return reduce(vals)
+    return None
+
+
+def build_report(doc):
+    """{(loop, rank): table dict} from one (possibly aggregated)
+    telemetry document.  ``rank`` is None for single-host snapshots;
+    aggregated docs contribute their ``rank="all"`` fleet sums."""
+    metrics = doc.get("metrics", {})
+    out = {}
+    for s in _series(metrics, "mxnet_train_step_seconds"):
+        lab = s.get("labels", {})
+        if not s.get("count"):
+            continue
+        key = (lab.get("loop", "?"), lab.get("rank"))
+        if key[1] is not None and key[1] != "all":
+            continue        # per-rank detail lives in the straggler view
+        out[key] = {"loop": key[0], "rank": key[1],
+                    "steps": s["count"], "wall_s": s["sum"] or 0.0,
+                    "phases": {}}
+    for s in _series(metrics, "mxnet_train_step_phase_seconds"):
+        lab = s.get("labels", {})
+        key = (lab.get("loop", "?"), lab.get("rank"))
+        row = out.get(key)
+        if row is None or not s.get("count"):
+            continue
+        row["phases"][lab.get("phase", "?")] = {
+            "steps": s["count"], "total_s": s["sum"] or 0.0}
+    for key, row in out.items():
+        loop, rank = key
+        attributed = sum(p["total_s"] for p in row["phases"].values())
+        row["attributed_s"] = attributed
+        row["residual_s"] = max(row["wall_s"] - attributed, 0.0)
+        row["coverage"] = attributed / row["wall_s"] if row["wall_s"] \
+            else 0.0
+        mean = lambda vs: sum(vs) / len(vs)     # noqa: E731
+        for name, field, reduce in (
+                ("mxnet_train_mfu", "mfu", mean),
+                ("mxnet_train_step_flops", "step_flops", max),
+                ("mxnet_train_steps_total", "steps_total", sum),
+                ("mxnet_train_step_compiles_total", "compile_steps", sum),
+                ("mxnet_train_device_mem_peak_bytes",
+                 "device_mem_peak_bytes", max)):
+            v = _scalar(metrics, name, loop, rank, reduce)
+            if v is not None:
+                row[field] = v
+    return out
+
+
+def _phase_sort_key(name):
+    try:
+        return (0, PHASE_ORDER.index(name))
+    except ValueError:
+        return (1, name)
+
+
+def format_table(row):
+    lines = []
+    wall, steps = row["wall_s"], row["steps"]
+    head = "loop=%s" % row["loop"]
+    if row.get("rank"):
+        head += " rank=%s" % row["rank"]
+    lines.append("%s  (%d steps, wall %.3f s, %.2f ms/step)"
+                 % (head, steps, wall, wall / steps * 1e3 if steps else 0))
+    lines.append("  %-24s %6s %10s %10s %8s"
+                 % ("phase", "steps", "total s", "ms/step", "% wall"))
+    for name in sorted(row["phases"], key=_phase_sort_key):
+        p = row["phases"][name]
+        lines.append("  %-24s %6d %10.4f %10.3f %7.2f%%"
+                     % (name, p["steps"], p["total_s"],
+                        p["total_s"] / p["steps"] * 1e3 if p["steps"] else 0,
+                        p["total_s"] / wall * 1e2 if wall else 0))
+    lines.append("  %-24s %6s %10.4f %10.3f %7.2f%%"
+                 % (RESIDUAL_ROW, "-", row["residual_s"],
+                    row["residual_s"] / steps * 1e3 if steps else 0,
+                    row["residual_s"] / wall * 1e2 if wall else 0))
+    lines.append("  phase coverage: %.2f%% of step wall"
+                 % (row["coverage"] * 1e2))
+    scal = []
+    if row.get("mfu"):
+        scal.append("mfu=%.4f" % row["mfu"])
+    if row.get("step_flops"):
+        scal.append("step_flops=%.4g" % row["step_flops"])
+    if row.get("compile_steps") is not None:
+        scal.append("steps_with_compiles=%d" % row["compile_steps"])
+    if row.get("device_mem_peak_bytes"):
+        scal.append("device_mem_peak=%.4g MB"
+                    % (row["device_mem_peak_bytes"] / 1e6))
+    if scal:
+        lines.append("  " + "  ".join(scal))
+    return "\n".join(lines)
+
+
+def format_io(metrics):
+    """Input-pipeline production cost next to the loop's data_wait."""
+    rows = []
+    for s in _series(metrics, "mxnet_io_batch_latency_ms"):
+        if not s.get("count"):
+            continue
+        lab = s.get("labels", {})
+        if lab.get("rank") not in (None, "all"):
+            continue
+        rows.append("  %-24s batches=%-6d mean=%.3f ms"
+                    % (lab.get("iter", "?"), s["count"],
+                       (s["sum"] or 0.0) / s["count"]))
+    if not rows:
+        return ""
+    return ("input pipeline (production cost; the loop's data_wait is "
+            "the blocked share):\n" + "\n".join(rows))
+
+
+def format_stragglers(doc):
+    """Per-phase straggler attribution from the aggregate's
+    histogram-mean spread: the max_rank is the straggling rank."""
+    spread = (doc.get("histogram_spread") or {}).get(
+        "mxnet_train_step_phase_seconds") or {}
+    rows = []
+    for labels, v in sorted(spread.items(),
+                            key=lambda kv: -kv[1]["spread"]):
+        rows.append("  %-40s straggler rank %s (mean %.3f ms; fastest "
+                    "rank %s at %.3f ms, spread %.3f ms)"
+                    % (labels, v["max_rank"], v["max"] * 1e3,
+                       v["min_rank"], v["min"] * 1e3, v["spread"] * 1e3))
+    if not rows:
+        return ""
+    return "per-phase straggler attribution (widest spread first):\n" \
+        + "\n".join(rows)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render the training-step attribution table")
+    ap.add_argument("files", nargs="*",
+                    help="telemetry JSON snapshot(s); two or more "
+                         "rank snapshots are aggregated first")
+    ap.add_argument("--url",
+                    help="scrape a live MXNET_TELEMETRY_PORT endpoint "
+                         "instead of reading files")
+    ap.add_argument("--loop", help="only report this loop label")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report instead of text")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        doc = load_doc(args.url)
+    elif len(args.files) == 1:
+        doc = load_doc(args.files[0])
+    elif len(args.files) > 1:
+        used, entries = set(), []
+        for i, src in enumerate(args.files):
+            d = load_doc(src)
+            if "text" in d:
+                print("step_report needs JSON snapshots; %r is "
+                      "Prometheus text" % src, file=sys.stderr)
+                return 2
+            entries.append((_doc_rank(d, src, i, used), d))
+        doc = aggregate_docs(entries)
+    else:
+        print("step_report: pass snapshot file(s) or --url "
+              "http://host:port", file=sys.stderr)
+        return 2
+    if "text" in doc:
+        print("step_report needs a JSON snapshot (got Prometheus "
+              "text); re-dump with MXNET_TELEMETRY_SNAPSHOT_FORMAT="
+              "json or use /metrics.json", file=sys.stderr)
+        return 2
+
+    report = build_report(doc)
+    if args.loop:
+        report = {k: v for k, v in report.items() if k[0] == args.loop}
+    if args.as_json:
+        out = {"loops": sorted(report.values(),
+                               key=lambda r: (r["loop"], r["rank"] or "")),
+               "histogram_spread": doc.get("histogram_spread") or {}}
+        print(json.dumps(out, indent=1, sort_keys=True))
+        return 0
+    if not report:
+        print("(no mxnet_train_step_seconds series — did the loop run "
+              "with MXNET_TELEMETRY_ON=1?)")
+        return 1
+    blocks = [format_table(report[k]) for k in sorted(
+        report, key=lambda k: (k[0], k[1] or ""))]
+    io_block = format_io(doc.get("metrics", {}))
+    if io_block:
+        blocks.append(io_block)
+    straggler = format_stragglers(doc)
+    if straggler:
+        blocks.append(straggler)
+    print("\n\n".join(blocks))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

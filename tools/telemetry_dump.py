@@ -193,9 +193,15 @@ def aggregate_docs(entries):
       lie about `le` semantics);
     - gauges get a ``gauge_spread`` section instead of a sum (a summed
       queue depth hides exactly the straggler this exists to find):
-      min / max / argmax-rank / spread per base label set.
+      min / max / argmax-rank / spread per base label set;
+    - histograms with >= 2 observing ranks also get a
+      ``histogram_spread`` entry over their per-rank MEANS (sum/count)
+      — the training-step attribution plane leans on this: per
+      ``mxnet_train_step_phase_seconds{phase}`` label set it names the
+      rank whose mean phase time is largest, i.e. the straggler per
+      phase.
     """
-    metrics_out, spread = {}, {}
+    metrics_out, spread, hist_spread = {}, {}, {}
     for rank, doc in entries:
         for name, fam in (doc.get("metrics") or {}).items():
             agg = metrics_out.setdefault(name, {
@@ -220,6 +226,17 @@ def aggregate_docs(entries):
                     {"labels": dict(key, rank="all"), "value": total})
         elif fam["kind"] == "histogram":
             for key, members in sorted(groups.items()):
+                means = [(m["sum"] / m["count"], m["labels"]["rank"])
+                         for m in members
+                         if m.get("count") and m.get("sum") is not None]
+                if len(means) >= 2:
+                    lo, lo_rank = min(means)
+                    hi, hi_rank = max(means)
+                    hist_spread.setdefault(name, {})[
+                        _fmt_labels(dict(key)) or "(no labels)"] = {
+                        "min": lo, "min_rank": lo_rank,
+                        "max": hi, "max_rank": hi_rank,
+                        "spread": hi - lo}
                 bounds = {tuple(m.get("buckets") or ()) for m in members}
                 if len(bounds) != 1:
                     continue
@@ -249,7 +266,8 @@ def aggregate_docs(entries):
     return {"format": "mxnet_tpu.telemetry/aggregate-1",
             "ranks": [r for r, _ in entries],
             "metrics": metrics_out,
-            "gauge_spread": spread}
+            "gauge_spread": spread,
+            "histogram_spread": hist_spread}
 
 
 def format_gauge_spread(spread):
@@ -334,6 +352,10 @@ def main(argv=None):
             if merged["gauge_spread"]:
                 print("\nper-rank gauge spread (widest first):")
                 print(format_gauge_spread(merged["gauge_spread"]))
+            if merged["histogram_spread"]:
+                print("\nper-rank histogram mean spread (stragglers "
+                      "first; max_rank is the straggling rank):")
+                print(format_gauge_spread(merged["histogram_spread"]))
         return 0
 
     src = _resolve_source(args)
