@@ -29,7 +29,13 @@ Verdicts drive rewrites, not just diagnostics: ``rewrite.py`` consumes
 the padding pass's structured violations and splices valid-length-
 driven SequenceMask / mean-renorm repairs, accepted only when
 re-analysis flips the verdict row-local (``plan_repair`` /
-``repair_serving_graph``; CLI ``graph_lint --fix``).
+``repair_serving_graph``; CLI ``graph_lint --fix``).  ``optimize.py``
+grows the same machinery into an optimizing pass pipeline (TVM/Relay
+mold): algebraic identity simplification, constant folding, CSE, DCE,
+and elementwise-fusion hints over a cloned Symbol, each candidate
+accepted ONLY when re-analysis verdicts are no worse than the input
+graph's (``optimize_graph``; CLI ``graph_lint --optimize``;
+``ServingEngine`` default-on via ``MXNET_SERVE_OPTIMIZE``).
 
 Entry points::
 
@@ -56,6 +62,8 @@ from .retrace import RetraceHazardPass
 from .padding import PaddingSoundnessPass, classify_padding, PadViolation
 from .flops import FlopsPass, count_flops
 from .rewrite import RepairPlan, plan_repair, repair_serving_graph
+from .optimize import (OptPlan, OptAction, optimize_graph,
+                       register_opt_pass, DEFAULT_OPT_PASSES)
 
 __all__ = [
     "Severity", "Diagnostic", "Report", "AnalysisError",
@@ -67,6 +75,8 @@ __all__ = [
     "PaddingSoundnessPass", "classify_padding", "PadViolation",
     "FlopsPass", "count_flops",
     "RepairPlan", "plan_repair", "repair_serving_graph",
+    "OptPlan", "OptAction", "optimize_graph", "register_opt_pass",
+    "DEFAULT_OPT_PASSES",
     "check_serving_graph", "verify",
 ]
 

@@ -130,6 +130,19 @@ _RULES = {
 
 _DEFAULT_BWD = 1.0
 
+#: pure data-movement / materialization ops: no arithmetic happens —
+#: XLA's cost model counts copies, layout changes, and constant
+#: materialization as 0 flops, and the optimizer's constant folding
+#: (analysis/optimize.py) must register as a FLOP *reduction* in the
+#: lint report, which it only can if a baked ``_constant`` costs
+#: nothing at run time (the work moved to analysis time).
+_ZERO_FLOP_OPS = frozenset([
+    "_zeros", "_ones", "_full", "_arange", "_eye", "_constant",
+    "zeros_like", "ones_like",
+    "Reshape", "Flatten", "transpose", "expand_dims", "squeeze",
+    "SwapAxis", "_copy", "BlockGrad",
+])
+
 
 @register_pass
 class FlopsPass(AnalysisPass):
@@ -162,7 +175,12 @@ class FlopsPass(AnalysisPass):
                 attrs = dict(n.attrs)
             rule = _RULES.get(n.op.name)
             try:
-                if rule is not None:
+                if n.op.name in _ZERO_FLOP_OPS:
+                    # modeled as exactly zero arithmetic (copies/layout/
+                    # constants); contributes to neither total nor the
+                    # modeled fraction's numerator-vs-denominator gap
+                    fwd, bwd_mult = 0.0, 0.0
+                elif rule is not None:
                     fwd = float(rule[0](attrs, ins, out))
                     bwd_mult = rule[1]
                     modeled += fwd

@@ -144,6 +144,17 @@ VARIABLES = {v.name: v for v in [
          "for mean), and the repair is adopted only when re-analysis "
          "verdicts the rewritten graph row-local.  0 = always degrade "
          "as before (exact-length programs / max_batch=1)."),
+    _Var("MXNET_SERVE_OPTIMIZE", bool, True,
+         "Run the verdict-gated optimizing pass pipeline "
+         "(analysis/optimize.py: algebraic identities, constant "
+         "folding, CSE, dead-node elimination) over the graph the "
+         "serving ProgramCache compiles.  A candidate is adopted ONLY "
+         "when re-analysis verdicts — output shapes/dtypes and "
+         "padded-axis soundness — are no worse than the input "
+         "graph's, so accepted rewrites stay bitwise-identical to the "
+         "unoptimized batch-1 Predictor.  Requires MXNET_ANALYSIS_ON "
+         "(the acceptance protocol IS analysis); 0 = serve the graph "
+         "exactly as handed in."),
     _Var("MXNET_SERVE_PAD_CHECK", bool, False,
          "Runtime padding-soundness probe (debug; doubles dispatch "
          "cost): every serving batch is dispatched twice — zero pads "

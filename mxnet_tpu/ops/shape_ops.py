@@ -417,6 +417,19 @@ def _eye(attrs):
     return jnp.eye(attrs["N"], m, k=attrs["k"], dtype=np.dtype(attrs["dtype"]))
 
 
+@register("_constant", nin=0,
+          params={"value": P("float_tuple", ()), "shape": P("shape", ()),
+                  **_DT})
+def _constant(attrs):
+    """Baked literal tensor — what the optimizer's constant folder
+    (analysis/optimize.py) splices in place of an analysis-time-
+    evaluated subgraph.  ``value`` is the row-major flat element tuple;
+    the float-tuple/JSON round trip is exact for every dtype the folder
+    accepts (it verifies bitwise before baking)."""
+    arr = np.array(attrs["value"], dtype=np.float64).reshape(attrs["shape"])
+    return jnp.asarray(np.asarray(arr, dtype=np.dtype(attrs["dtype"])))
+
+
 # ---------------------------------------------------------------------------
 # Loss-ish / misc control flow
 # ---------------------------------------------------------------------------

@@ -19,6 +19,14 @@ uses — rather than ``Predictor``'s bind path: params/aux live on device
 once, each bucket shape becomes one cached XLA program, and
 ``CachedOp.trace_count`` is the **compile counter**: warm traffic must
 leave it unchanged, which tests and perf/serve_bench.py assert.
+
+The symbol handed in is the graph the engine decided to SERVE: by
+default (``MXNET_SERVE_OPTIMIZE``) the verdict-gated optimizer
+(``analysis/optimize.py``) has already run CSE / constant folding /
+DCE / algebraic simplification over it, so every bucket program traces
+the smaller graph — fewer nodes per trace, identical outputs (the
+acceptance protocol rejected any candidate whose re-analysis verdicts
+got worse).
 """
 from __future__ import annotations
 

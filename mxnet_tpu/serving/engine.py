@@ -35,7 +35,8 @@ the live HTTP endpoint (``MXNET_TELEMETRY_PORT``: /metrics, /traces,
 Env knobs (config.py): ``MXNET_SERVE_MAX_BATCH``,
 ``MXNET_SERVE_MAX_QUEUE``, ``MXNET_SERVE_BATCH_TIMEOUT_MS``,
 ``MXNET_SERVE_DEFAULT_DEADLINE_MS``, ``MXNET_SERVE_OVERLOAD_POLICY``,
-``MXNET_SERVE_SEQ_BUCKETS``, ``MXNET_SERVE_REPAIR``.
+``MXNET_SERVE_SEQ_BUCKETS``, ``MXNET_SERVE_REPAIR``,
+``MXNET_SERVE_OPTIMIZE``.
 """
 from __future__ import annotations
 
@@ -208,6 +209,21 @@ class _EngineTelemetry(object):
             "did not re-verify row-local: the engine fell back to the "
             "degrade path (exact-length programs / max_batch=1)",
             labelnames=("engine",))
+        self.opt_removed = reg.counter(
+            "mxnet_serve_opt_nodes_removed_total",
+            "graph nodes the construction-time optimizer pipeline "
+            "(analysis/optimize.py, MXNET_SERVE_OPTIMIZE) removed from "
+            "the served graph, per pass that disconnected them — the "
+            "candidate was adopted only after re-analysis verdicts "
+            "came back no worse than the input graph's",
+            labelnames=("engine", "pass"))
+        self.opt_rejected = reg.counter(
+            "mxnet_serve_opt_rejected_total",
+            "optimizer rewrites planned but thrown away because the "
+            "candidate graph's re-analysis verdicts came back worse "
+            "(the engine serves the unoptimized graph), per pass that "
+            "planned them",
+            labelnames=("engine", "pass"))
         self._engine_gauge_fams = (queue_depth_fam, cache_hits_fam,
                                    cache_misses_fam, compile_count_fam,
                                    entropy_fam)
@@ -234,7 +250,8 @@ class _EngineTelemetry(object):
         for fam in self._engine_gauge_fams:
             fam.remove(engine=self.engine_label)
         for fam in (self.shape_seen, self.retraces,
-                    self.repairs_applied, self.repairs_rejected):
+                    self.repairs_applied, self.repairs_rejected,
+                    self.opt_removed, self.opt_rejected):
             for values, _inst in fam.series():
                 if values[0] == self.engine_label:
                     fam.remove(*values)
@@ -315,14 +332,30 @@ class ServingEngine(object):
         self._hazard_label = "none"
         self.hazard_fingerprints = {}
         self._pad_check = config.get("MXNET_SERVE_PAD_CHECK")
+        self._preflight_pre = None       # (report, ctx) over the original
+        self._policy0 = self._policy     # policy before any degrade
         if config.get("MXNET_ANALYSIS_ON"):
             self._preflight(symbol, config.get("MXNET_ANALYSIS_STRICT"))
+        # optimizing pass pipeline (analysis/optimize.py): rewrite the
+        # graph the ProgramCache compiles — CSE, constant folding, DCE,
+        # algebraic identities — adopted ONLY when re-analysis verdicts
+        # are no worse than the input graph's.  Needs the analysis tier
+        # (the acceptance protocol IS analysis), so both knobs gate it.
+        self.opt_plan = None
+        if config.get("MXNET_SERVE_OPTIMIZE") \
+                and config.get("MXNET_ANALYSIS_ON"):
+            self._optimize_preflight(arg_params, aux_params)
+        # the preflight (report, ctx) pair is construction-time-only:
+        # drop it so the full per-node shape/dtype environment is not
+        # held resident for the engine's serving lifetime
+        self._preflight_pre = None
         # telemetry bundle: None when disabled — every instrumented
         # branch below gates on that, keeping the disabled hot path at
         # zero registry calls per request
         self._tm = _EngineTelemetry(self) if _telemetry.enabled() else None
         if self._tm is not None:
             self._record_repair_telemetry()
+            self._record_opt_telemetry()
         # trace-retention chain (telemetry/sampling.py): every request
         # is traced cheaply and kept/dropped at finish() — tail-biased
         # (top-K slowest + moving p99) with error keep and the
@@ -385,6 +418,7 @@ class ServingEngine(object):
         verdicts, report, ctx = check_serving_graph(
             symbol, self._data_shapes, self._policy, with_ctx=True)
         self.analysis_report = report
+        self._preflight_pre = (report, ctx)
         # fingerprint the retrace-linter's hazard findings: runtime
         # retrace events are counted under these labels, tying an
         # observed compile storm back to the static warning that
@@ -489,6 +523,87 @@ class ServingEngine(object):
         except Exception:
             return                      # advisory only: never block
         self._harvest_hazards(report)
+
+    def _optimize_preflight(self, arg_params, aux_params):
+        """Optimize the graph the ProgramCache compiles (the repaired
+        symbol when a repair was adopted).  The candidate is served
+        only when the plan's re-analysis verdicts are no worse than
+        the input graph's — padded-axis verdicts, output shapes, and
+        output dtypes all intact — so the compile-once contract and
+        bitwise parity with the batch-1 Predictor survive every
+        accepted rewrite.  A rejected (or crashed) optimization leaves
+        the engine serving the unoptimized graph."""
+        from ..analysis import optimize_graph
+        from ..analysis.rewrite import serving_pad_spec
+        try:
+            full, pad_axes = serving_pad_spec(self._data_shapes,
+                                              self._policy)
+            valid_lengths = None
+            if self._valid_name is not None:
+                full[self._valid_name] = (self._policy.max_batch,)
+                pad_axes["batch"][self._valid_name] = 0
+                valid_lengths = {self.repair_plan.label: self._valid_name}
+            dtypes = {n: self._dtype for n in self._data_shapes}
+            if self._valid_name is not None:
+                dtypes[self._valid_name] = np.dtype(np.float32)
+            for src in (arg_params or {}), (aux_params or {}):
+                for k, v in src.items():
+                    dt = getattr(v, "dtype", None)
+                    if dt is not None:
+                        dtypes.setdefault(k, np.dtype(dt))
+            # the preflight analysis covered exactly this symbol/spec
+            # unless a repair swapped the graph or a degrade changed
+            # the policy — reuse it then, re-analyze otherwise.  It
+            # also assumed float32 throughout (no dtype seeding), so
+            # any non-f32 tensor — engine data dtype OR a single
+            # mixed-precision param — forces a re-analysis with honest
+            # dtypes, or the cast-elimination guards would trust the
+            # wrong beliefs (e.g. delete a real f16->f32 upcast).
+            f32 = np.dtype(np.float32)
+            pre = self._preflight_pre \
+                if (self._serve_sym is self._sym
+                    and self._policy is self._policy0
+                    and all(np.dtype(d) == f32
+                            for d in dtypes.values())) else None
+            plan = optimize_graph(self._serve_sym, data_shapes=full,
+                                  dtypes=dtypes, policy=self._policy,
+                                  pad_axes=pad_axes, training=False,
+                                  valid_lengths=valid_lengths,
+                                  precomputed=pre)
+        except Exception as e:      # optimizer crash must never block
+            #                         construction: serve unoptimized
+            warnings.warn("ServingEngine: graph optimization crashed "
+                          "(%r); serving the unoptimized graph" % (e,))
+            return
+        self.opt_plan = plan
+        if plan.accepted and plan.symbol is not None and plan.rewrites:
+            self._serve_sym = plan.symbol
+        elif not plan.accepted:
+            warnings.warn("ServingEngine: graph optimization rejected "
+                          "(%s); serving the unoptimized graph"
+                          % plan.reason)
+
+    def _record_opt_telemetry(self):
+        """Mirror the construction-time optimizer outcome into the
+        registry (mxnet_serve_opt_*_total), per pass."""
+        tm = self._tm
+        plan = self.opt_plan
+        if plan is None:
+            return
+        if plan.accepted:
+            for p, st in plan.per_pass.items():
+                if st.get("nodes_removed"):
+                    tm.opt_removed.labels(tm.engine_label, p).inc(
+                        st["nodes_removed"])
+        else:
+            # only graph-changing actions count as rejected rewrites —
+            # fusion hints and DCE orphan sweeps were never candidate
+            # rewrites (keeps the counter consistent with
+            # stats()["optimizer"]["rejected"])
+            rej = collections.Counter(
+                a.pass_name for a in plan.rewrites)
+            for p, c in rej.items():
+                tm.opt_rejected.labels(tm.engine_label, p).inc(c)
 
     def _record_repair_telemetry(self):
         """Mirror the construction-time repair outcome into the
@@ -995,7 +1110,10 @@ class ServingEngine(object):
         dispatch/occupancy aggregates, program-cache traffic, retrace
         count, the construction-time repair outcome (``repairs``:
         actions applied / rejection reason / the valid-length input a
-        repaired graph is fed), and request latency percentiles (ms)
+        repaired graph is fed), the optimizer outcome (``optimizer``:
+        rewrites adopted or thrown away, node counts before/after —
+        the same numbers the ``mxnet_serve_opt_*`` counters carry),
+        and request latency percentiles (ms)
         over the last ≤4096 completions.  An empty latency window
         reports zeros for every latency field, never NaN or an
         exception."""
@@ -1020,6 +1138,22 @@ class ServingEngine(object):
                     "rejected": 1 if self._repair_rejected else 0,
                     "valid_length_input": self._valid_name,
                     "reason": self._repair_rejected,
+                },
+                "optimizer": {
+                    "applied": (len(self.opt_plan.rewrites)
+                                if self.opt_plan is not None
+                                and self.opt_plan.accepted else 0),
+                    "rejected": (len(self.opt_plan.rewrites)
+                                 if self.opt_plan is not None
+                                 and not self.opt_plan.accepted else 0),
+                    "nodes_before": (self.opt_plan.nodes_before
+                                     if self.opt_plan is not None
+                                     else None),
+                    "nodes_after": (self.opt_plan.nodes_after
+                                    if self.opt_plan is not None
+                                    else None),
+                    "reason": (self.opt_plan.reason
+                               if self.opt_plan is not None else None),
                 },
                 "latency_ms": {
                     "count": len(lat),

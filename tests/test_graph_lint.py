@@ -109,6 +109,98 @@ def test_fix_repairs_cross_position_graph_and_relints_clean(tmp_path,
 
 
 @pytest.mark.lint_graphs
+def test_optimize_model_zoo_sweep_strict(tmp_path, capsys):
+    """CI bar for the optimizer pipeline: graph_lint --optimize
+    --strict over the full lint_graphs exemplar set (symbolic models/
+    builders AND the traced gluon block) exits 0 with every plan
+    accepted — a pass regression (rejected candidate = verdict-
+    worsening rewrite) fails the suite here, not in production."""
+    import json
+    rc = _lint_main("mlp", "lenet", "resnet18", "resnet18_v1",
+                    "--optimize", "--strict", "--json",
+                    "--fix-dir", str(tmp_path))
+    raw = capsys.readouterr().out
+    assert rc == 0, raw
+    doc = json.loads(raw)
+    assert len(doc["graphs"]) == 4
+    for name, entry in doc["graphs"].items():
+        opt = entry["optimization"]
+        assert opt["accepted"] is True, (name, opt["reason"])
+        assert opt["nodes_after"] <= opt["nodes_before"]
+        assert set(opt["per_pass"]) == {"algebraic", "fold", "cse",
+                                        "dce", "fuse"}
+
+
+@pytest.mark.lint_graphs
+def test_optimize_emits_artifact_and_json_section(tmp_path, capsys):
+    """--optimize on a graph with duplicate + dead + constant work:
+    exit 0, <stem>.optimized.json emitted and re-lints clean at the
+    same bar, and the --json optimization section carries per-pass
+    counts plus the FLOP delta."""
+    import json
+    import mxnet_tpu as mx
+    d = mx.sym.Variable("data")
+    net = (mx.sym.exp(d, name="oa") + mx.sym.exp(d, name="ob")) \
+        + mx.sym.zeros((4,))
+    path = str(tmp_path / "dup-symbol.json")
+    net.save(path)
+    rc = _lint_main(path, "--shapes", "data=2,4", "--optimize",
+                    "--strict", "--json")
+    raw = capsys.readouterr().out
+    assert rc == 0, raw
+    doc = json.loads(raw)
+    entry = doc["graphs"][path]
+    opt = entry["optimization"]
+    assert opt["accepted"] and opt["nodes_before"] > opt["nodes_after"]
+    assert opt["per_pass"]["cse"]["applied"] == 1
+    assert opt["flops"]["delta_pct"] < 0
+    out_path = str(tmp_path / "dup-symbol.optimized.json")
+    assert entry["optimized_symbol"] == out_path
+    assert os.path.exists(out_path)
+    assert _lint_main(out_path, "--shapes", "data=2,4", "--strict") == 0
+    capsys.readouterr()
+
+
+@pytest.mark.lint_graphs
+def test_optimize_rejected_plan_fails_the_run(tmp_path, capsys,
+                                              monkeypatch):
+    """The documented exit contract: a REJECTED optimization plan (the
+    candidate re-analyzed worse — an optimizer bug) exits 1 even
+    without --strict; text and --json both carry the reason."""
+    import json
+    import mxnet_tpu as mx
+    from mxnet_tpu.analysis import optimize as opt_mod
+    from mxnet_tpu.ops import get_op
+    from mxnet_tpu.symbol.symbol import SymNode
+
+    def evil(state):
+        head, ix = state.symbol._outputs[0]
+        if head.name == "evil_cast":
+            return 0
+        op = get_op("Cast")
+        node = SymNode(op, "evil_cast",
+                       op.normalize({"dtype": "float16"}), [(head, ix)])
+        state.track(node)
+        state.symbol._outputs[0] = (node, 0)
+        state.record("evil", "fold", node, "downcast the output")
+        return 1
+
+    monkeypatch.setitem(opt_mod.OPT_PASSES, "algebraic", evil)
+    net = mx.sym.relu(mx.sym.Variable("data"), name="r")
+    path = str(tmp_path / "plain-symbol.json")
+    net.save(path)
+    rc = _lint_main(path, "--shapes", "data=2,4", "--optimize",
+                    "--json")
+    raw = capsys.readouterr().out
+    assert rc == 1, raw
+    doc = json.loads(raw)
+    opt = doc["graphs"][path]["optimization"]
+    assert opt["accepted"] is False and "dtype" in opt["reason"]
+    assert not os.path.exists(str(tmp_path
+                                  / "plain-symbol.optimized.json"))
+
+
+@pytest.mark.lint_graphs
 def test_fix_is_a_noop_on_clean_fixture_and_exit_codes(tmp_path, capsys):
     """--fix on a row-local lint_graphs fixture emits nothing and keeps
     exit 0; an unrepairable graph keeps its failing exit; --json emits

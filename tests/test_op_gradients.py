@@ -380,6 +380,7 @@ SKIPS = {
     "_zeros": "nullary init op", "_ones": "nullary init op",
     "_full": "nullary init op", "_arange": "nullary init op",
     "_eye": "nullary init op",
+    "_constant": "nullary init op (optimizer-baked literal)",
     # optimizer update rules (in-place state transitions, not differentiable
     # graph ops; validated against reference formulas in test_optimizer.py):
     "sgd_update": "optimizer state update",

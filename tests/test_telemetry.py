@@ -552,6 +552,12 @@ def test_stats_empty_latency_window_returns_zeros():
     assert st["retraces"] == 0
     assert st["program_cache"] == {"hits": 0, "misses": 0}
     assert st["batch_occupancy"] == 0.0
+    # the optimizer block is always present; a graph with nothing to
+    # rewrite reports zero applied/rejected and equal node counts
+    assert st["optimizer"]["applied"] == 0
+    assert st["optimizer"]["rejected"] == 0
+    assert st["optimizer"]["reason"] is None
+    assert st["optimizer"]["nodes_before"] == st["optimizer"]["nodes_after"]
 
 
 def test_profiler_dumps_self_describing(tmp_path):

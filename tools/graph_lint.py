@@ -25,6 +25,12 @@ Usage:
     python tools/graph_lint.py model-symbol.json \
         --shapes data=8,4,64 --seq-axis 1 --seq-buckets 4 --fix
 
+    # optimize it: run the verdict-gated pass pipeline (CSE, constant
+    # folding, DCE, algebraic identities; analysis/optimize.py), emit
+    # <stem>.optimized.json + per-pass before/after node counts
+    python tools/graph_lint.py model-symbol.json \
+        --shapes data=8,3,224,224 --optimize
+
 Dynamic dims are written as 0 (or '?') in --shapes; the retrace linter
 keys on them.  --strict exits nonzero on warnings too (CI bar: the
 model-zoo exemplars must lint clean — tests/test_graph_lint.py).
@@ -33,6 +39,15 @@ Exit codes (documented contract, tests/test_graph_lint.py):
   0  clean at the chosen bar
   1  warnings only, failing the bar (--strict; or a rejected --fix)
   2  hard failure: verifier/shape ERRORS, or a graph could not load
+--optimize interacts with the bar like --fix does: a REJECTED
+optimization plan (the candidate's re-analysis verdicts came back
+worse — an optimizer bug, never a user error) exits 1 even without
+--strict, while an accepted plan — including the common
+"nothing to rewrite" outcome — leaves the exit code to the findings
+themselves; --strict stays a property of the findings, not of how
+many rewrites were applied.  --optimize runs on the input graph as
+analyzed; to optimize a --fix artifact, re-run on the emitted
+<stem>.repaired.json.
 With --fix, a graph whose cross-position verdicts are all repaired
 (and whose rewritten graph re-lints clean) counts as passing; the
 repaired symbol JSON lands next to the input (or --fix-dir).  When
@@ -41,8 +56,11 @@ only SOME labels repaired, the artifact is named
 along the rejected axes — and the run keeps its failing exit code.
 
 --json prints one machine-readable document (findings with node/op/
-provenance/fingerprint, per-axis verdicts, repair outcomes) instead of
-text — tools/hazard_rank.py joins it against telemetry snapshots.
+provenance/fingerprint, per-axis verdicts, repair outcomes, and — with
+--optimize — an "optimization" section: per-pass applied/rejected
+action counts, nodes before/after, rejection reasons, the analytic
+FLOP delta, and fusion hints) instead of text — tools/hazard_rank.py
+joins it against telemetry snapshots.
 """
 from __future__ import annotations
 
@@ -147,10 +165,16 @@ def main(argv=None):
                     help="attempt masking repairs of cross-position "
                          "verdicts (analysis/rewrite.py); emit "
                          "<stem>.repaired.json + a repair report")
+    ap.add_argument("--optimize", action="store_true",
+                    help="run the verdict-gated optimizing pass "
+                         "pipeline (analysis/optimize.py: algebraic, "
+                         "fold, cse, dce + fusion hints); emit "
+                         "<stem>.optimized.json when rewrites were "
+                         "accepted and report per-pass node counts")
     ap.add_argument("--fix-dir", default=None,
-                    help="directory for --fix outputs (default: next "
-                         "to the input JSON, or the cwd for model "
-                         "names)")
+                    help="directory for --fix/--optimize outputs "
+                         "(default: next to the input JSON, or the "
+                         "cwd for model names)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="print one machine-readable JSON document "
                          "instead of text (hazard_rank.py input)")
@@ -217,6 +241,19 @@ def main(argv=None):
             failed, hard = _fix_graph(
                 analysis, spec, graph, shapes, pad_axes, policy, args,
                 passes, report, ctx, entry, fix_lines, failed, hard)
+        if args.optimize and not hard:
+            # the analysis above already covered this exact graph/spec
+            # whenever the default (full) pass set ran — forward it so
+            # --optimize pays for one candidate re-analysis, not a
+            # repeated pre-analysis.  --fix may have changed shapes/
+            # pad_axes for the NEXT analysis, but optimizes the input
+            # graph under the ORIGINAL spec, so only reuse when no
+            # repair ran.
+            pre = (report, ctx) if passes is None \
+                and not (args.fix and entry["repairs"]) else None
+            failed = _optimize_graph_cli(
+                analysis, spec, graph, shapes, pad_axes, policy, args,
+                entry, fix_lines, failed, pre)
         doc[spec] = entry
         if not args.as_json and (failed or not args.quiet):
             print("== %s ==" % spec)
@@ -260,6 +297,40 @@ def _shape_valid_lengths(graph, shapes):
     return shapes, valid_vars
 
 
+def _out_dir(args, spec):
+    """Artifact directory for --fix/--optimize emissions."""
+    return args.fix_dir or (os.path.dirname(spec)
+                            if os.path.sep in spec
+                            or spec.endswith(".json") else ".")
+
+
+def _optimize_graph_cli(analysis, spec, graph, shapes, pad_axes, policy,
+                        args, entry, fix_lines, failed, precomputed=None):
+    """--optimize: run the verdict-gated pass pipeline on the analyzed
+    graph, record the plan (per-pass applied/rejected counts, node
+    before/after, FLOP delta, fusion hints), and emit
+    <stem>.optimized.json when rewrites were accepted.  A REJECTED plan
+    fails the run even non-strict — it means the optimizer produced a
+    verdict-worsening candidate, which is a pipeline bug, and CI must
+    see it."""
+    plan = analysis.optimize_graph(graph, data_shapes=shapes,
+                                   policy=policy, pad_axes=pad_axes,
+                                   training=args.training,
+                                   precomputed=precomputed)
+    entry["optimization"] = plan.to_dict()
+    fix_lines.append(plan.describe())
+    if not plan.accepted:
+        return True
+    if plan.rewrites:
+        out_dir = _out_dir(args, spec)
+        stem = os.path.splitext(os.path.basename(spec))[0] or spec
+        out_path = os.path.join(out_dir or ".", stem + ".optimized.json")
+        plan.symbol.save(out_path)
+        entry["optimized_symbol"] = out_path
+        fix_lines.append("  optimized symbol written to %s" % out_path)
+    return failed
+
+
 def _fix_graph(analysis, spec, graph, shapes, pad_axes, policy, args,
                passes, report, ctx, entry, fix_lines, failed, hard):
     """--fix: repair every cross-position label (seq first), emit the
@@ -301,9 +372,7 @@ def _fix_graph(analysis, spec, graph, shapes, pad_axes, policy, args,
         pad_axes = {lb: dict(m) for lb, m in pad_axes.items()}
         pad_axes["batch"][plan.valid_length_name] = 0
     if last_plan is not None:
-        out_dir = args.fix_dir or (os.path.dirname(spec)
-                                   if os.path.sep in spec
-                                   or spec.endswith(".json") else ".")
+        out_dir = _out_dir(args, spec)
         stem = os.path.splitext(os.path.basename(spec))[0] or spec
         # a partially-repaired graph (some labels' repairs rejected —
         # it is STILL cross-position along those) must not be
