@@ -77,7 +77,7 @@ __all__ = [
     "RepairPlan", "plan_repair", "repair_serving_graph",
     "OptPlan", "OptAction", "optimize_graph", "register_opt_pass",
     "DEFAULT_OPT_PASSES",
-    "check_serving_graph", "verify",
+    "check_serving_graph", "check_decode_step", "verify",
 ]
 
 
@@ -112,3 +112,34 @@ def check_serving_graph(symbol, data_shapes, policy, training=False,
     if with_ctx:
         return dict(ctx.pad_verdicts), report, ctx
     return dict(ctx.pad_verdicts), report
+
+
+def check_decode_step(step_sym, data_shapes, state_names=(),
+                      valid_name=None, training=False):
+    """Soundness lint for a continuous-batching decode STEP graph
+    (serving/decode.py): is the step row-local along the SLOT axis?
+
+    The decode engine runs one persistent compiled step over a fixed
+    slot pool — axis 0 of every non-parameter input indexes slots, and
+    dead slots ride along in every dispatch holding whatever a freed
+    request left behind.  Soundness therefore demands more than the
+    one-shot engine's padding check: a live slot's outputs must depend
+    only on that slot's own row, with NO credit for zero pad slots —
+    state inputs (``state_names``) are seeded pad-dirty, so even a
+    "harmless" sum over stale garbage is a violation.
+
+    ``data_shapes`` are FULL slot-pool shapes ((num_slots,) + per-slot
+    shape) for every per-slot input: token vector, state buffers, and
+    any pos/valid vectors.  ``valid_name`` optionally names the
+    slot-occupancy vector (the ``__pad_valid_len__`` machinery the
+    masked step may key on).  Returns (verdict, Report) where verdict
+    is "row-local" / "cross-position" (or None when the graph is
+    structurally broken).
+    """
+    pad_axes = {"slot": {n: 0 for n in data_shapes}}
+    report, ctx = analyze(
+        step_sym, data_shapes=data_shapes, pad_axes=pad_axes,
+        training=training, pad_dirty=state_names,
+        valid_lengths={"slot": valid_name} if valid_name else None,
+        passes=("verify", "shapes", "padding"))
+    return ctx.pad_verdicts.get("slot"), report

@@ -37,7 +37,8 @@ class AnalysisContext(object):
     """
 
     def __init__(self, symbol, data_shapes=None, dtypes=None, policy=None,
-                 pad_axes=None, training=False, valid_lengths=None):
+                 pad_axes=None, training=False, valid_lengths=None,
+                 pad_dirty=None):
         self.symbol = symbol
         self.data_shapes = {k: (tuple(v) if v is not None else None)
                             for k, v in (data_shapes or {}).items()}
@@ -51,6 +52,12 @@ class AnalysisContext(object):
         # ``__pad_valid_len__ = <label>`` (rewrite.py marks the inputs
         # it creates, so a repaired graph re-analyzes standalone).
         self.valid_lengths = dict(valid_lengths or {})
+        # input names whose PAD slots hold arbitrary stale values, not
+        # serving's zeros — the decode engine's slot-resident state: a
+        # freed slot's KV cache / hidden state is never rewritten, so
+        # the padding pass must not credit zero-absorption (sum over
+        # "zero" pads) to those inputs.  Seeds _Pad(zero=False).
+        self.pad_dirty = frozenset(pad_dirty or ())
         self.view = None          # GraphView, set once certified acyclic
         self.structural_ok = None # verifier verdict; gates later passes
         # products of the shape/dtype abstract interpreter, keyed
@@ -102,7 +109,7 @@ def list_passes():
 
 def analyze(symbol, data_shapes=None, dtypes=None, policy=None,
             pad_axes=None, training=False, passes=None,
-            valid_lengths=None):
+            valid_lengths=None, pad_dirty=None):
     """Run a pass pipeline over ``symbol``; returns (Report, ctx).
 
     ``passes`` is an ordered iterable of pass names (default: the full
@@ -124,7 +131,8 @@ def analyze(symbol, data_shapes=None, dtypes=None, policy=None,
         names.insert(0, "verify")
     ctx = AnalysisContext(symbol, data_shapes=data_shapes, dtypes=dtypes,
                           policy=policy, pad_axes=pad_axes,
-                          training=training, valid_lengths=valid_lengths)
+                          training=training, valid_lengths=valid_lengths,
+                          pad_dirty=pad_dirty)
     report = Report()
     for name in names:
         if name != "verify" and ctx.structural_ok is False:

@@ -331,7 +331,11 @@ class PaddingSoundnessPass(AnalysisPass):
 
         for n in view.variables():
             if n.name in var_axes:
-                states[(id(n), 0)] = _Pad({var_axes[n.name]}, zero=True)
+                # pad_dirty inputs (decode slot-state: stale garbage in
+                # dead slots, never serving's zeros) must not earn the
+                # zero-absorption credit sum-like reductions rely on
+                states[(id(n), 0)] = _Pad(
+                    {var_axes[n.name]}, zero=n.name not in ctx.pad_dirty)
             else:
                 states[(id(n), 0)] = _EMPTY
 

@@ -125,6 +125,19 @@ VARIABLES = {v.name: v for v in [
          "shares programs; outputs are un-padded on the same axis "
          "(model must be row-independent along it).  Empty = off: "
          "every distinct example shape is its own bucket."),
+    _Var("MXNET_DECODE_SLOTS", int, 8,
+         "Slot-pool capacity of the continuous-batching decode engine "
+         "(serving/decode.py DecodeEngine): the persistent step program "
+         "is compiled ONCE at this batch extent, per-slot state (KV "
+         "cache / recurrent state) lives device-resident at this "
+         "leading dim, and requests join/leave the running batch "
+         "between steps with zero retraces."),
+    _Var("MXNET_DECODE_MAX_LEN", int, 128,
+         "Per-slot sequence capacity of the decode engine: the fixed "
+         "O(1) per-token cache layout (PAPERS.md 2603.09555) allocates "
+         "this many positions per slot up front; prompt length + "
+         "generated tokens may not exceed it (requests finish with "
+         "reason 'length' at the cap)."),
     _Var("MXNET_ANALYSIS_ON", bool, True,
          "Run the static-analysis passes (mxnet_tpu.analysis) at "
          "Predictor/ServingEngine construction: the IR verifier always, "

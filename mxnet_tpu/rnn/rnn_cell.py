@@ -92,6 +92,29 @@ class BaseRNNCell(object):
                                            self._init_counter)))
         return states
 
+    def begin_state_arrays(self, batch_size, dtype=None):
+        """Materialize zero initial-state HOST arrays from
+        ``state_info``: one ``numpy`` array per state, with every
+        batch placeholder (the ``0`` dim in each info shape) filled in
+        with ``batch_size``.
+
+        One materializer instead of every caller re-deriving shapes
+        from ``state_info`` by hand: zeros for a fed ``begin_state``,
+        bucketing-module init states, and sizing the per-slot
+        ``state_info`` handed to the continuous-batching decode engine
+        (serving/decode.py — its slot-pool state is this shape with
+        the batch placeholder as the slot dim; tests hold the two
+        sources to agreement).
+        """
+        import numpy as np
+        dt = np.dtype(dtype or np.float32)
+        out = []
+        for info in self.state_info:
+            shape = tuple(batch_size if d == 0 else d
+                          for d in info["shape"])
+            out.append(np.zeros(shape, dtype=dt))
+        return out
+
     # -- weight (un)packing: reference fused<->unfused layout -------------
     def unpack_weights(self, args):
         """Split this cell's stacked-gate i2h/h2h weight+bias into per-gate

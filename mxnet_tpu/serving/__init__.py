@@ -1,9 +1,14 @@
 """mxnet_tpu.serving — dynamic-batching inference runtime.
 
 A new layer on top of the executor stack (no reference analog: the
-reference stops at the single-client C predict API).  Three parts:
+reference stops at the single-client C predict API).  Four parts:
 
-- :mod:`.engine`    — request queue + dynamic batcher + worker thread;
+- :mod:`.engine`    — request queue + dynamic batcher + worker thread
+  (one-shot graphs: coalesce, pad, dispatch once, unpad);
+- :mod:`.decode`    — continuous batching for autoregressive decode:
+  iteration-level scheduling over a persistent slot pool, per-slot
+  state device-resident, requests joining/leaving between steps with
+  zero retraces;
 - :mod:`.buckets`   — shape-bucket policy and the compile-once program
   cache (CachedOp-backed, with a compile counter);
 - :mod:`.admission` — bounded queue, deadlines, overload shedding.
@@ -22,9 +27,13 @@ from .admission import (AdmissionController, Request, QueueFullError,
                         EngineClosedError)
 from .buckets import BucketPolicy, ProgramCache, pad_valid_lengths
 from .engine import ServingEngine
+from .decode import (DecodeEngine, DecodeResult, StepProgram,
+                     greedy_decode)
 
 __all__ = ["ServingEngine", "BucketPolicy", "ProgramCache",
            "pad_valid_lengths",
+           "DecodeEngine", "DecodeResult", "StepProgram",
+           "greedy_decode",
            "AdmissionController", "Request", "QueueFullError",
            "DeadlineExceededError", "ServerOverloadError",
            "EngineClosedError"]

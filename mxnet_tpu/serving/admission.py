@@ -81,12 +81,23 @@ class Request(object):
     ``TraceContext``) across the thread hop to the worker; retention —
     which requests yield a stored span tree — is decided at finish by
     the tail-biased sampler chain.
+
+    ``on_expire`` generalizes deadline accounting beyond the original
+    one-dispatch-per-request model: a MULTI-STEP request (continuous-
+    batching decode, serving/decode.py — its deadline is re-checked on
+    every scheduler iteration, queued or slot-resident) does not FAIL
+    at its deadline, it *completes with whatever it has*.  When set,
+    the expiry sweep calls ``on_expire(exc)`` and delivers the returned
+    value as the future's RESULT (a partial output carrying an
+    ``expired`` flag) instead of setting ``DeadlineExceededError``;
+    returning ``None`` falls back to the exception.  One-shot requests
+    leave it unset and keep the original fail-fast contract.
     """
     __slots__ = ("inputs", "group", "future", "t_enqueue", "deadline",
-                 "out_rows", "trace")
+                 "out_rows", "trace", "on_expire")
 
     def __init__(self, inputs, group, future, deadline=None,
-                 out_rows=None, trace=None):
+                 out_rows=None, trace=None, on_expire=None):
         self.inputs = inputs
         self.group = group
         self.future = future
@@ -94,6 +105,7 @@ class Request(object):
         self.deadline = deadline            # absolute time.monotonic()
         self.out_rows = out_rows
         self.trace = trace
+        self.on_expire = on_expire
 
     def expired(self, now=None):
         return self.deadline is not None and \
@@ -118,6 +130,11 @@ class AdmissionController(object):
         # two-per-batch under bursty load.
         self._wake_hint = int(wake_hint) if wake_hint else None
         self._queue = collections.deque()
+        # count of queued requests carrying a deadline, maintained at
+        # every queue mutation: the expiry sweep runs on EVERY decode
+        # scheduler iteration (sub-ms apart), and an O(queue) scan per
+        # step to discover "nothing can expire" is pure hot-path waste
+        self._n_deadlined = 0
         self._cond = threading.Condition()
         self._closed = False
         # monotonically increasing counters, guarded by _cond's lock
@@ -145,6 +162,8 @@ class AdmissionController(object):
             if len(self._queue) >= self.max_queue:
                 if self.overload_policy == "shed-oldest":
                     victim = self._queue.popleft()
+                    if victim.deadline is not None:
+                        self._n_deadlined -= 1
                     self.shed += 1
                     if tm is not None:
                         tm.shed.inc()
@@ -162,6 +181,8 @@ class AdmissionController(object):
                         % self.max_queue)
             if reject is None:
                 self._queue.append(req)
+                if req.deadline is not None:
+                    self._n_deadlined += 1
                 self.admitted += 1
                 if tm is not None:
                     tm.admitted.inc()
@@ -210,11 +231,35 @@ class AdmissionController(object):
             if decided:
                 return batch
 
+    def poll(self, max_batch):
+        """Non-blocking :meth:`take`: sweep deadlines, then pop the
+        head request's group immediately — possibly an empty list.
+        The continuous-batching decode worker admits between steps
+        with this: a running batch must never block on the queue (and
+        the embedded sweep keeps queued deadlines honest on every
+        scheduler iteration, not just when a slot frees).
+
+        Empty-queue fast path: no lock, no sweep (an empty queue has
+        nothing to expire).  A request admitted concurrently is picked
+        up by the next iteration's poll, one step (sub-ms) later."""
+        if not self._queue:
+            return []
+        with self._cond:
+            failures = self._sweep_locked()
+            batch = []
+            if self._queue:
+                batch = self._pop_group_locked(self._queue[0].group,
+                                               max_batch)
+        self._deliver(failures)
+        return batch
+
     def _pop_group_locked(self, group, max_batch):
         taken, keep = [], collections.deque()
         for r in self._queue:
             if r.group == group and len(taken) < max_batch:
                 taken.append(r)
+                if r.deadline is not None:
+                    self._n_deadlined -= 1
             else:
                 keep.append(r)
         self._queue = keep
@@ -230,12 +275,13 @@ class AdmissionController(object):
         the completing thread, and a callback that re-enters this
         controller (submit-on-failure retry) would deadlock on the
         non-reentrant condition lock."""
-        if not any(r.deadline is not None for r in self._queue):
+        if not self._n_deadlined:
             return []
         now = time.monotonic()
         live, failures = collections.deque(), []
         for r in self._queue:
             if r.expired(now):
+                self._n_deadlined -= 1
                 self.expired += 1
                 if self._telemetry is not None:
                     self._telemetry.expired.inc()
@@ -254,11 +300,33 @@ class AdmissionController(object):
         """Fail futures OUTSIDE the condition lock (see _sweep_locked).
         ``failures`` holds (Request, exception) pairs so a sampled
         trace on a failed request still gets finished (abort) instead
-        of silently vanishing from the trace store."""
+        of silently vanishing from the trace store.
+
+        Deadline expiry of a request that declared ``on_expire`` is
+        not a failure: the handler renders the partial output (tokens
+        generated so far + the ``expired`` flag) and the future
+        RESOLVES with it — multi-step decode clients always get their
+        partial generation back (see Request docstring)."""
         for req, exc in failures:
-            _fail_future(req.future, exc)
+            result = None
+            if req.on_expire is not None and \
+                    isinstance(exc, DeadlineExceededError):
+                try:
+                    result = req.on_expire(exc)
+                except Exception:   # handler bug: fall back to the error
+                    result = None
+            if result is None:
+                _fail_future(req.future, exc)
+                if req.trace is not None:
+                    req.trace.abort(type(exc).__name__)
+                continue
+            if not req.future.cancelled():
+                try:
+                    req.future.set_result(result)
+                except Exception:   # lost a cancel() race
+                    pass
             if req.trace is not None:
-                req.trace.abort(type(exc).__name__)
+                req.trace.abort("expired")
 
     def sweep(self):
         """Expire overdue queued requests now (also runs automatically
@@ -279,6 +347,7 @@ class AdmissionController(object):
                     r = self._queue.popleft()
                     failures.append((r, EngineClosedError(
                         "engine closed before dispatch")))
+                self._n_deadlined = 0
                 if self._telemetry is not None:
                     self._telemetry.queue_depth.set(0)
             self._cond.notify_all()

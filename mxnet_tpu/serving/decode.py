@@ -1,0 +1,1045 @@
+"""Continuous batching for autoregressive decode — iteration-level
+scheduling over a persistent slot pool.
+
+The one-shot engine (engine.py) coalesces requests into a batch,
+dispatches ONCE, and scatters results.  Sequence models cannot be
+served that way without catastrophic waste: a static batch holds every
+finished sequence hostage until the slowest member completes, and new
+requests wait for the whole batch to drain.  This module schedules at
+the *iteration* level instead (ROADMAP item 1 — THE millions-of-users
+workload):
+
+- **one persistent step program** compiled ONCE over a fixed-capacity
+  slot pool (``MXNET_DECODE_SLOTS`` slots x ``MXNET_DECODE_MAX_LEN``
+  positions).  Requests join and leave the running batch BETWEEN steps
+  with zero retraces — shapes never change, so the jit cache is never
+  busted (the compile counter is pinned across churn by tests);
+- **device-resident per-slot state**: recurrent state (h/c per
+  :meth:`~mxnet_tpu.rnn.rnn_cell.BaseRNNCell.begin_state_arrays`) or a
+  fixed-layout KV cache in the O(1)-per-token mold of PAPERS.md
+  "Compiler-First State Space Duality and Portable O(1) Autoregressive
+  Caching" (arxiv 2603.09555): a ``(slots, max_len, d)`` buffer
+  written at one position per step, never grown, never re-laid-out.
+  State stays in HBM across steps (buffers are donated to the step
+  dispatch off-CPU); the host ships only the per-step new-token id
+  vector and the slot-occupancy/valid vector, and receives only the
+  sampled token ids back;
+- **masked dead slots**: free slots ride along in every dispatch
+  holding whatever a finished request left behind.  That is sound
+  exactly when the step graph is row-local along the slot axis —
+  :func:`mxnet_tpu.analysis.check_decode_step` proves it at
+  construction with the same padding classifier serving already
+  trusts, seeding state inputs pad-DIRTY so stale garbage gets no
+  zero-absorption credit (``tools/graph_lint.py --decode-step`` runs
+  the same lint offline);
+- **bucketed prefill**: a prompt is consumed either token-by-token
+  through the running step batch (teacher forcing — no extra
+  programs), or, with a ``prefill_sym``, in ONE dispatch through the
+  existing :class:`~mxnet_tpu.serving.buckets.ProgramCache` at pow2
+  seq buckets, its output state scattered into the free slot;
+- **admission + per-step deadlines**: the same
+  :class:`~mxnet_tpu.serving.admission.AdmissionController` front door
+  (bounded queue, reject/shed overload policies); deadlines are
+  re-checked every iteration, and an expired request — queued or
+  mid-generation — completes with its PARTIAL output and the
+  ``expired`` flag instead of failing (``Request.on_expire``).
+
+Quick start::
+
+    eng = serving.DecodeEngine(step_sym, params, {}, state_info=[
+        {"name": "h", "shape": (H,)}, {"name": "c", "shape": (H,)}])
+    eng.warmup()
+    fut = eng.submit([bos_id], max_new_tokens=32)
+    res = fut.result()          # DecodeResult: tokens, finish_reason
+    eng.close()
+
+Step-graph contract: ``step_sym`` outputs ``[logits] + next_states``
+(exactly like ``BaseRNNCell.__call__``), over arguments ``token``
+(slot vector of last token ids), the state names from ``state_info``
+(each ``(slots,) + per_slot_shape``), and optionally ``pos`` (per-slot
+write position) and ``valid`` (1/0 occupancy).  The engine appends a
+greedy ``argmax`` head so only token ids cross the host boundary.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import warnings
+import weakref
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import telemetry as _telemetry
+from .admission import (AdmissionController, Request, EngineClosedError,
+                        _fail_future)
+from .buckets import ProgramCache, _next_pow2
+from .engine import _ENGINE_SEQ, _percentile
+
+__all__ = ["DecodeEngine", "DecodeResult", "StepProgram", "greedy_decode"]
+
+
+class DecodeResult(object):
+    """What a decode future resolves to: the generated token ids plus
+    how generation ended.
+
+    ``finish_reason`` is one of ``"eos"`` (the eos id was sampled),
+    ``"length"`` (max_new_tokens or the slot's max_len capacity),
+    ``"deadline"`` (the request's deadline passed mid-flight — tokens
+    holds the PARTIAL generation), or ``"closed"`` (engine shut down
+    without drain).  ``expired`` mirrors the deadline case.
+    """
+    __slots__ = ("tokens", "finish_reason", "n_steps", "prompt_len")
+
+    def __init__(self, tokens, finish_reason, n_steps=0, prompt_len=0):
+        self.tokens = np.asarray(tokens, dtype=np.int64)
+        self.finish_reason = finish_reason
+        self.n_steps = n_steps
+        self.prompt_len = prompt_len
+
+    @property
+    def expired(self):
+        return self.finish_reason == "deadline"
+
+    def __len__(self):
+        return len(self.tokens)
+
+    def __repr__(self):
+        return ("<DecodeResult %d tokens, %s>"
+                % (len(self.tokens), self.finish_reason))
+
+
+class DecodeRequest(Request):
+    """One decode request: a prompt plus generation bookkeeping the
+    scheduler mutates as the request moves queue -> slot -> done."""
+    __slots__ = ("prompt", "max_new", "tokens", "prompt_i", "slot",
+                 "t_join", "n_steps")
+
+    def __init__(self, prompt, max_new, future, deadline=None,
+                 trace=None):
+        super().__init__({}, ("__decode__",), future, deadline=deadline,
+                         trace=trace)
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.tokens = []            # generated ids (host mirror)
+        self.prompt_i = 0           # next prompt token to teacher-force
+        self.slot = None
+        self.t_join = None
+        self.n_steps = 0
+
+
+class StepProgram(object):
+    """The persistent compiled decode step over a fixed slot pool.
+
+    Wraps ``step_sym`` (outputs ``[logits] + next_states``) with a
+    greedy ``argmax`` head and compiles it ONCE at batch extent
+    ``num_slots`` — iteration-level scheduling never changes a shape,
+    so ``trace_count`` is the whole compile story: the step kernel,
+    plus one tiny row-write kernel per distinct state shape (slot
+    join/leave scatter), all exercised by ``DecodeEngine.warmup``.
+
+    Per-slot state lives as jax device buffers between calls; on
+    non-CPU backends the state arguments are DONATED to the dispatch,
+    so the pool is updated in place in HBM (the O(1) cache layout of
+    arxiv 2603.09555 — no growth, no re-layout, no host round-trip).
+    """
+
+    def __init__(self, step_sym, arg_params, aux_params, state_info,
+                 num_slots, token_name="token", pos_name="pos",
+                 valid_name="valid", ctx=None, dtype=np.float32):
+        import jax
+        import jax.numpy as jnp
+        from ..context import cpu
+        from ..executor import build_graph_fn, _count_xla_trace
+        from .. import symbol as sym
+        self._ctx = ctx or cpu()
+        self.num_slots = int(num_slots)
+        self._dtype = np.dtype(dtype)
+        self.state_info = [dict(s) for s in state_info]
+        self.state_names = [s["name"] for s in self.state_info]
+        self.token_name = token_name
+        if len(step_sym) != 1 + len(self.state_names):
+            raise MXNetError(
+                "decode step graph has %d outputs; expected 1 (logits) "
+                "+ %d next-state outputs (state_info order)"
+                % (len(step_sym), len(self.state_names)))
+        sampled = sym.argmax(step_sym[0], axis=1,
+                             name="__decode_sample__")
+        self._serve_sym = sym.Group(
+            [sampled] + [step_sym[i]
+                         for i in range(1, len(step_sym))])
+        arg_names = self._serve_sym.list_arguments()
+        aux_names = self._serve_sym.list_auxiliary_states()
+        if token_name not in arg_names:
+            raise MXNetError("decode step graph has no %r input "
+                             "(token_name); arguments: %s"
+                             % (token_name, arg_names))
+        missing = [n for n in self.state_names if n not in arg_names]
+        if missing:
+            raise MXNetError("decode step graph is missing state "
+                             "input(s) %s" % missing)
+        self.pos_name = pos_name if pos_name in arg_names else None
+        self.valid_name = valid_name if valid_name in arg_names else None
+        feeds = set([token_name] + self.state_names)
+        feeds.update(n for n in (self.pos_name, self.valid_name) if n)
+        lacking = [n for n in arg_names
+                   if n not in feeds and n not in (arg_params or {})]
+        if lacking:
+            raise MXNetError("StepProgram: params missing for %s"
+                             % lacking)
+        order = list(arg_names) + list(aux_names)
+        self._template = [None] * len(order)
+        for i, n in enumerate(order):
+            if n in feeds:
+                continue
+            src = arg_params if n in (arg_params or {}) else aux_params
+            self._template[i] = src[n].as_in_context(self._ctx)._data
+        self._feed_pos = {n: order.index(n) for n in feeds}
+        gf = build_graph_fn(self._serve_sym, arg_names, aux_names)
+        if gf.stochastic:
+            raise MXNetError(
+                "decode step graph contains stochastic ops (Dropout, "
+                "samplers): the persistent step must be deterministic "
+                "— greedy decode parity and per-slot bitwise "
+                "reproducibility both depend on it")
+        self._trace_count = 0
+        na = len(arg_names)
+        state_pos = tuple(order.index(n) for n in self.state_names)
+
+        def call(key, reset, *flat):
+            self._trace_count += 1      # runs once per XLA trace
+            _count_xla_trace()
+            # a joining slot's state is zeroed HERE, fused into the
+            # step program (``reset`` is a per-slot 1/0 host vector):
+            # a join costs no device dispatch of its own, unlike a
+            # write_row scatter (~ms each on CPU jax) per join.
+            # jnp.where, not multiply: stale rows may hold non-finite
+            # values and 0*inf would leak NaN into the fresh state.
+            flat = list(flat)
+            for i in state_pos:
+                s = flat[i]
+                r = reset.reshape((-1,) + (1,) * (s.ndim - 1))
+                flat[i] = jnp.where(r > 0, jnp.zeros((), s.dtype), s)
+            outs, _ = gf(flat[:na], flat[na:], key, False)
+            return outs
+
+        donate = ()
+        if jax.default_backend() != "cpu":
+            # in-place HBM update of the slot pool: the old state
+            # buffers are donated to the dispatch (CPU jax cannot
+            # honor donation and would warn per compile)
+            donate = tuple(2 + order.index(n) for n in self.state_names)
+        self._kernel = jax.jit(call, donate_argnums=donate)
+        from .. import random as _random
+        self._key = _random.next_key()     # dead input: deterministic
+
+        def set_row(buf, idx, row):
+            self._trace_count += 1
+            _count_xla_trace()
+            return buf.at[idx].set(row)
+
+        # one trace per distinct state shape; the slot index is a
+        # traced scalar so churn across slots never retraces
+        self._set_row = jax.jit(set_row)
+        self._jnp = jnp
+
+    @property
+    def trace_count(self):
+        return self._trace_count
+
+    def init_states(self):
+        """Fresh all-zero slot-pool state buffers (device)."""
+        out = {}
+        for info in self.state_info:
+            dt = np.dtype(info.get("dtype") or self._dtype)
+            out[info["name"]] = self._jnp.zeros(
+                (self.num_slots,) + tuple(info["shape"]), dtype=dt)
+        return out
+
+    def write_row(self, states, slot, rows):
+        """Scatter per-slot state rows (host or device arrays) into
+        ``slot`` of every buffer named in ``rows``; returns the updated
+        state dict.  The index is passed as a traced scalar — one
+        compile per state shape, ever."""
+        idx = self._jnp.asarray(slot, self._jnp.int32)
+        out = dict(states)
+        for name, row in rows.items():
+            out[name] = self._set_row(out[name], idx, row)
+        return out
+
+    def zero_row(self, states, slot):
+        """Zero one slot's rows in every state buffer (a joining
+        request must never inherit the previous occupant's state)."""
+        rows = {}
+        for info in self.state_info:
+            dt = np.dtype(info.get("dtype") or self._dtype)
+            rows[info["name"]] = np.zeros(tuple(info["shape"]), dtype=dt)
+        return self.write_row(states, slot, rows)
+
+    def step(self, tokens, pos, valid, states, reset=None):
+        """One decode iteration over the whole pool.  ``tokens``/
+        ``pos``/``valid`` are host float32 vectors of length
+        ``num_slots``; ``states`` the device buffers from
+        :meth:`init_states`/previous steps.  ``reset`` optionally
+        marks slots (1/0) whose state rows must read as fresh zeros
+        this step — how a join clears the previous occupant's rows
+        without a single extra device dispatch.  Returns (sampled ids
+        as a host float vector, new state dict) — the only
+        device->host traffic is the id vector."""
+        if reset is None:
+            reset = np.zeros((self.num_slots,), np.float32)
+        flat = list(self._template)
+        flat[self._feed_pos[self.token_name]] = tokens
+        if self.pos_name is not None:
+            flat[self._feed_pos[self.pos_name]] = pos
+        if self.valid_name is not None:
+            flat[self._feed_pos[self.valid_name]] = valid
+        for name in self.state_names:
+            flat[self._feed_pos[name]] = states[name]
+        outs = self._kernel(self._key, reset, *flat)
+        new_states = {name: outs[1 + i]
+                      for i, name in enumerate(self.state_names)}
+        return np.asarray(outs[0]), new_states
+
+
+def greedy_decode(program, prompt, max_new_tokens, eos_id=None,
+                  max_len=None):
+    """Reference single-request greedy decode: teacher-force the prompt
+    through ``program`` one token per step, then feed each argmax
+    sample back, alone in slot 0.  This is the bitwise ground truth
+    the continuous-batching engine is held to (tests/test_decode.py):
+    whatever company a request keeps in the slot pool, its tokens must
+    equal this loop's output exactly."""
+    states = program.init_states()
+    n = program.num_slots
+    tokens = np.zeros((n,), np.float32)
+    pos = np.zeros((n,), np.float32)
+    valid = np.zeros((n,), np.float32)
+    valid[0] = 1.0
+    prompt = list(prompt)
+    if not prompt:
+        raise MXNetError("greedy_decode needs a non-empty prompt")
+    tokens[0] = prompt[0]
+    out, p, i = [], 0, 1
+    while len(out) < max_new_tokens:
+        if max_len is not None and p >= max_len:
+            break
+        pos[0] = p
+        sampled, states = program.step(tokens, pos, valid, states)
+        p += 1
+        if i < len(prompt):             # still consuming the prompt
+            tokens[0] = prompt[i]
+            i += 1
+            continue
+        tok = int(sampled[0])
+        out.append(tok)
+        tokens[0] = sampled[0]
+        if eos_id is not None and tok == eos_id:
+            break
+    return np.asarray(out, dtype=np.int64)
+
+
+class _DecodeTelemetry(object):
+    """Decode engine's instrument bundle (mxnet_serve_decode_*), built
+    only when telemetry is enabled.  Shares the admission families
+    with the one-shot engine (AdmissionController reads ``admitted``/
+    ``rejected``/``shed``/``expired``/``queue_depth`` off this object)
+    so both engine kinds aggregate into one serving picture; decode-
+    specific series follow the PR 3-7 idiom — shared counters, per-
+    engine gauges reclaimed at close()."""
+
+    def __init__(self, engine):
+        reg = _telemetry.registry()
+        self.engine_label = str(next(_ENGINE_SEQ))
+        self.closed = False
+        self.requests = reg.counter(
+            "mxnet_serve_requests_total", "serving requests submitted")
+        self.admitted = reg.counter(
+            "mxnet_serve_admitted_total", "requests admitted")
+        self.rejected = reg.counter(
+            "mxnet_serve_rejected_total",
+            "requests rejected with QueueFullError backpressure")
+        self.shed = reg.counter(
+            "mxnet_serve_shed_total",
+            "requests shed under the shed-oldest overload policy")
+        self.expired = reg.counter(
+            "mxnet_serve_expired_total",
+            "requests expired past their deadline while queued")
+        queue_depth_fam = reg.gauge(
+            "mxnet_serve_queue_depth",
+            "pending admission-queue depth per engine",
+            labelnames=("engine",))
+        self.queue_depth = queue_depth_fam.labels(
+            engine=self.engine_label)
+        self.tokens = reg.counter(
+            "mxnet_serve_decode_tokens_total",
+            "tokens generated by continuous-batching decode engines")
+        self.steps = reg.counter(
+            "mxnet_serve_decode_steps_total",
+            "decode step-program dispatches (each steps every live "
+            "slot once)")
+        self.joins = reg.counter(
+            "mxnet_serve_decode_joins_total",
+            "requests that joined the running decode batch (slot "
+            "assigned between steps — never a retrace)")
+        self.leaves = reg.counter(
+            "mxnet_serve_decode_leaves_total",
+            "requests that left the decode batch, by how generation "
+            "ended (eos / length / deadline / closed / cancelled)",
+            labelnames=("reason",))
+        # label handles resolved ONCE: .labels() does registry work
+        # per call, and leaves are hot-path (one per finished request)
+        self._leave = {r: self.leaves.labels(reason=r)
+                       for r in ("eos", "length", "deadline", "closed",
+                                 "cancelled")}
+        self.evictions = reg.counter(
+            "mxnet_serve_decode_evictions_total",
+            "slot-resident requests evicted mid-generation by their "
+            "deadline: the future resolves with the PARTIAL tokens "
+            "and expired=True, and the slot frees for queued work")
+        self.step_ms = reg.histogram(
+            "mxnet_serve_decode_step_ms",
+            "wall time of one decode iteration (deadline sweep + step "
+            "dispatch + host bookkeeping)",
+            buckets=_telemetry.LATENCY_MS_BUCKETS)
+        slots_fam = reg.gauge(
+            "mxnet_serve_decode_slots",
+            "slot-pool capacity per decode engine",
+            labelnames=("engine",))
+        self.slots = slots_fam.labels(engine=self.engine_label)
+        occupied_fam = reg.gauge(
+            "mxnet_serve_decode_slots_occupied",
+            "slots currently generating per decode engine — "
+            "occupied/capacity is decode's batch-occupancy analog",
+            labelnames=("engine",))
+        self.occupied = occupied_fam.labels(engine=self.engine_label)
+        compile_fam = reg.gauge(
+            "mxnet_serve_compile_count",
+            "CachedOp trace counter — programs compiled so far, per "
+            "engine", labelnames=("engine",))
+        self.compile_count = compile_fam.labels(
+            engine=self.engine_label)
+        self._engine_gauge_fams = (queue_depth_fam, slots_fam,
+                                   occupied_fam, compile_fam)
+        self._engine = weakref.ref(engine)
+        reg.register_callback(self._refresh)
+
+    def leave(self, reason):
+        handle = self._leave.get(reason)
+        (handle if handle is not None
+         else self.leaves.labels(reason=reason)).inc()
+
+    def close(self):
+        self.closed = True
+        _telemetry.registry().unregister_callback(self._refresh)
+        self._remove_engine_series()
+
+    def _remove_engine_series(self):
+        for fam in self._engine_gauge_fams:
+            fam.remove(engine=self.engine_label)
+
+    def _refresh(self, reg):
+        eng = self._engine()
+        if eng is None:
+            reg.unregister_callback(self._refresh)
+            self._remove_engine_series()
+            return
+        self.slots.set(eng.num_slots)
+        self.occupied.set(eng._occupied_count())
+        self.compile_count.set(eng.compile_count)
+
+
+class DecodeEngine(object):
+    """Continuous-batching autoregressive decode over one frozen step
+    graph (module docstring has the architecture).
+
+    Parameters
+    ----------
+    step_sym : Symbol with outputs ``[logits] + next_states``.
+    arg_params, aux_params : trained weights (checkpoint artifacts).
+    state_info : list of ``{"name", "shape"[, "dtype"]}`` — per-slot
+        state buffers, in the order the step graph returns their next
+        values (``BaseRNNCell.state_info`` shapes with the batch dim
+        dropped; see ``begin_state_arrays`` for the cell-side analog).
+    num_slots, max_len : slot-pool geometry (defaults from
+        ``MXNET_DECODE_SLOTS`` / ``MXNET_DECODE_MAX_LEN``).
+    eos_id : sampling this id ends a request with reason "eos".
+    prefill_sym : optional prompt-consumption graph with outputs
+        ``[logits_at_last_valid_position] + state_rows`` over arguments
+        ``prefill_data_name`` ((1, T) prompt ids, T padded onto pow2
+        buckets) and ``prefill_len_name`` ((1,) live prompt length the
+        graph's masking keys on).  Either a length-polymorphic Symbol
+        or a callable ``T -> Symbol`` (the BucketingModule idiom — an
+        unrolled graph bakes its length in).  Compiled through the
+        one-shot bucket path (ProgramCache, one program per pow2
+        bucket); its state rows are scattered into the free slot.
+        Without it, prompts are teacher-forced token-by-token through
+        the running step batch (no extra programs).
+    """
+
+    def __init__(self, step_sym, arg_params, aux_params, state_info,
+                 token_name="token", pos_name="pos", valid_name="valid",
+                 num_slots=None, max_len=None, eos_id=None,
+                 prefill_sym=None, prefill_data_name="prompt",
+                 prefill_len_name="plen",
+                 max_queue=None, default_deadline_ms=None,
+                 overload_policy=None, ctx=None, dtype=np.float32,
+                 start=True):
+        from .. import config
+        if num_slots is None:
+            num_slots = config.get("MXNET_DECODE_SLOTS")
+        if max_len is None:
+            max_len = config.get("MXNET_DECODE_MAX_LEN")
+        if max_queue is None:
+            max_queue = config.get("MXNET_SERVE_MAX_QUEUE")
+        if default_deadline_ms is None:
+            default_deadline_ms = config.get(
+                "MXNET_SERVE_DEFAULT_DEADLINE_MS")
+        if overload_policy is None:
+            overload_policy = config.get("MXNET_SERVE_OVERLOAD_POLICY")
+        if num_slots < 1:
+            raise MXNetError("num_slots must be >= 1, got %d" % num_slots)
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.eos_id = eos_id
+        self._dtype = np.dtype(dtype)
+        self._default_deadline_s = float(default_deadline_ms) / 1e3
+        self.analysis_report = None
+        self.step_verdict = None
+        if config.get("MXNET_ANALYSIS_ON"):
+            self._preflight(step_sym, state_info, token_name, pos_name,
+                            valid_name, config.get("MXNET_ANALYSIS_STRICT"))
+        self._program = StepProgram(step_sym, arg_params, aux_params,
+                                    state_info, self.num_slots,
+                                    token_name=token_name,
+                                    pos_name=pos_name,
+                                    valid_name=valid_name,
+                                    ctx=ctx, dtype=dtype)
+        # prefill through the one-shot bucket path: one compiled
+        # program per pow2 prompt bucket, batch 1 (state rows scatter
+        # into exactly one free slot).  ``prefill_sym`` is either a
+        # length-polymorphic Symbol (one graph, ProgramCache's shape
+        # keys are the buckets) or — the BucketingModule idiom, since
+        # an unrolled graph bakes its length in — a callable
+        # ``T -> Symbol`` invoked once per bucket.
+        self._prefill_caches = {}
+        self._prefill_buckets = ()
+        self._prefill_data_name = prefill_data_name
+        self._prefill_len_name = prefill_len_name
+        if prefill_sym is not None:
+            buckets, b = [], 1
+            top = _next_pow2(self.max_len)
+            while b <= top:
+                buckets.append(b)
+                b <<= 1
+            self._prefill_buckets = tuple(buckets)
+            from ..symbol import Symbol as _Symbol
+            # Symbol is itself callable (compose), so "callable" alone
+            # cannot distinguish the T -> Symbol builder idiom
+            if not isinstance(prefill_sym, _Symbol) \
+                    and callable(prefill_sym):
+                for b in self._prefill_buckets:
+                    self._prefill_caches[b] = self._build_prefill(
+                        prefill_sym(b), arg_params, aux_params, ctx,
+                        dtype)
+            else:
+                shared = self._build_prefill(prefill_sym, arg_params,
+                                             aux_params, ctx, dtype)
+                for b in self._prefill_buckets:
+                    self._prefill_caches[b] = shared
+        self._tm = (_DecodeTelemetry(self)
+                    if _telemetry.enabled() else None)
+        self._trace_chain = (_telemetry.chain_from_config()
+                             if self._tm is not None else None)
+        self._owns_http_server = (_telemetry.server.engine_acquire()
+                                  if self._tm is not None else False)
+        self._adm = AdmissionController(max_queue=max_queue,
+                                        overload_policy=overload_policy,
+                                        wake_hint=self.num_slots,
+                                        telemetry=self._tm)
+        n = self.num_slots
+        self._slots = [None] * n        # DecodeRequest or None
+        self._tokens_np = np.zeros((n,), np.float32)
+        self._pos_np = np.zeros((n,), np.float32)
+        self._valid_np = np.zeros((n,), np.float32)
+        self._reset_np = np.zeros((n,), np.float32)
+        self._states = self._program.init_states()
+        self._lock = threading.Lock()
+        self._step_ms = collections.deque(maxlen=4096)
+        self._lat_ms = collections.deque(maxlen=4096)
+        self._steps = 0
+        self._joins = 0
+        self._leaves = 0
+        self._evictions = 0
+        self._tokens_out = 0
+        self._requests_served = 0
+        self._abort = False
+        self._worker = None
+        if start:
+            self.start()
+
+    def _build_prefill(self, psym, arg_params, aux_params, ctx, dtype):
+        """Wrap one prefill graph with the greedy head and compile-once
+        plumbing: outputs become [first sampled token id] + state rows."""
+        from .. import symbol as sym
+        if len(psym) != 1 + len(self._program.state_names):
+            raise MXNetError(
+                "prefill graph has %d outputs; expected 1 (logits at "
+                "the last valid position) + %d state rows"
+                % (len(psym), len(self._program.state_names)))
+        wrapped = sym.Group(
+            [sym.argmax(psym[0], axis=1,
+                        name="__decode_prefill_sample__")]
+            + [psym[i] for i in range(1, len(psym))])
+        return ProgramCache(
+            wrapped, arg_params, aux_params,
+            data_names=[self._prefill_data_name, self._prefill_len_name],
+            ctx=ctx, dtype=dtype)
+
+    # ---------------------------------------------------------- preflight
+    def _preflight(self, step_sym, state_info, token_name, pos_name,
+                   valid_name, strict):
+        """Construction-time soundness lint: the masked step must be
+        row-local along the SLOT axis with state seeded pad-dirty
+        (analysis.check_decode_step) — a cross-position step would let
+        one request's (or a dead slot's stale) values bleed into a
+        co-resident request's tokens."""
+        from ..analysis import check_decode_step, AnalysisError
+        n = self.num_slots
+        arg_names = set(step_sym.list_arguments())
+        shapes = {token_name: (n,)}
+        state_names = []
+        for info in state_info:
+            shapes[info["name"]] = (n,) + tuple(info["shape"])
+            state_names.append(info["name"])
+        for extra in (pos_name, valid_name):
+            if extra in arg_names:
+                shapes[extra] = (n,)
+        verdict, report = check_decode_step(
+            step_sym, shapes, state_names=state_names,
+            valid_name=valid_name if valid_name in arg_names else None)
+        self.analysis_report = report
+        self.step_verdict = verdict
+        if report.errors:
+            if strict:
+                report.raise_if_errors()
+            warnings.warn("DecodeEngine: step-graph verification "
+                          "failed:\n%s" % report.format())
+            return
+        if verdict == "cross-position":
+            detail = "\n".join("  " + str(d) for d in report.warnings) \
+                or "  (see report)"
+            msg = ("[padding] DecodeEngine: step graph is cross-"
+                   "position along the SLOT axis — co-resident "
+                   "requests (and stale state in freed slots) would "
+                   "contaminate each other's tokens:\n%s" % detail)
+            if strict:
+                raise AnalysisError(msg)
+            warnings.warn(msg + "\ncontinuing because "
+                          "MXNET_ANALYSIS_STRICT=0; decoded output "
+                          "WILL differ from single-request decode")
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self):
+        if self._adm.closed:
+            raise EngineClosedError(
+                "engine is closed; build a new DecodeEngine")
+        if self._worker is None:
+            self._worker = threading.Thread(target=self._run,
+                                            name="mxnet-decode-worker",
+                                            daemon=True)
+            self._worker.start()
+        return self
+
+    def close(self, drain=True):
+        """Stop admitting.  With ``drain``, queued AND slot-resident
+        requests run to completion first; otherwise queued futures
+        fail with EngineClosedError and in-flight requests resolve
+        with their PARTIAL tokens (finish_reason "closed")."""
+        if not drain:
+            self._abort = True
+        self._adm.close(drain=drain)
+        if self._worker is not None:
+            self._worker.join(timeout=None if drain else 60)
+            if not self._worker.is_alive():
+                self._worker = None
+        elif drain:
+            self._run()     # never started: drain on the caller's thread
+        if self._tm is not None:
+            self._tm.close()
+        if self._owns_http_server:
+            self._owns_http_server = False
+            _telemetry.server.engine_release()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------- client
+    def submit(self, prompt, max_new_tokens=None, deadline_ms=None):
+        """Enqueue one generation request; returns a Future resolving
+        to a :class:`DecodeResult`.
+
+        ``prompt`` is a non-empty sequence of token ids; generation
+        continues until ``eos_id`` is sampled, ``max_new_tokens`` are
+        out, the slot's ``max_len`` positions fill, or the deadline
+        passes (partial result, ``expired=True``)."""
+        if self._adm.closed:
+            raise EngineClosedError("decode engine is closed")
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise MXNetError("decode needs a non-empty prompt (feed at "
+                             "least a BOS token)")
+        if len(prompt) >= self.max_len:
+            raise MXNetError(
+                "prompt length %d leaves no room to generate within "
+                "max_len=%d positions" % (len(prompt), self.max_len))
+        cap = self.max_len - len(prompt)
+        if max_new_tokens is None:
+            max_new_tokens = cap
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        max_new_tokens = min(max_new_tokens, cap)
+        if deadline_ms is None and self._default_deadline_s > 0:
+            deadline_ms = self._default_deadline_s * 1e3
+        deadline = None if not deadline_ms else \
+            time.monotonic() + float(deadline_ms) / 1e3
+        fut = Future()
+        trace = None
+        if self._tm is not None:
+            self._tm.requests.inc()
+            if self._trace_chain is not None:
+                trace = _telemetry.LazyTrace(self._trace_chain,
+                                             name="decode.request")
+        req = DecodeRequest(prompt, max_new_tokens, fut,
+                            deadline=deadline, trace=trace)
+        # a deadline hit — queued or mid-generation — COMPLETES the
+        # request with whatever was generated (admission._deliver
+        # routes DeadlineExceededError through this instead of failing)
+        req.on_expire = lambda exc, r=req: DecodeResult(
+            r.tokens, "deadline", n_steps=r.n_steps,
+            prompt_len=len(r.prompt))
+        try:
+            self._adm.admit(req)
+        except Exception as e:
+            if trace is not None:
+                trace.abort(type(e).__name__)
+            raise
+        return fut
+
+    def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
+                 timeout=None):
+        """Synchronous convenience wrapper around :meth:`submit`."""
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           deadline_ms=deadline_ms).result(timeout=timeout)
+
+    # ------------------------------------------------------------- worker
+    def _occupied(self):
+        return [i for i, s in enumerate(self._slots) if s is not None]
+
+    def _occupied_count(self):
+        return sum(1 for s in self._slots if s is not None)
+
+    def _run(self):
+        while True:
+            try:
+                if self._abort:
+                    for i in self._occupied():
+                        self._finish_slot(i, "closed")
+                    return
+                occ = self._occupied()
+                free = self.num_slots - len(occ)
+                if not occ:
+                    batch = self._adm.take(free, 0.0)
+                    if batch is None:
+                        return          # closed and drained
+                    for r in batch:
+                        self._join(r)
+                    continue
+                # busy: admit opportunistically (never block a step),
+                # and keep queued deadlines honest even when no slot
+                # is free — expiry must not wait for a drain
+                if free:
+                    for r in self._adm.poll(free):
+                        self._join(r)
+                else:
+                    self._adm.sweep()
+                self._step_once()
+            except Exception as e:      # fail the batch, keep serving
+                for i in self._occupied():
+                    req = self._slots[i]
+                    self._slots[i] = None
+                    self._valid_np[i] = 0.0
+                    if not req.future.done():
+                        _fail_future(req.future, e)
+                    if req.trace is not None:
+                        req.trace.abort(type(e).__name__)
+                # a failed step dispatch may have consumed the DONATED
+                # state buffers (non-CPU backends): self._states would
+                # point at deleted arrays and wedge every later step —
+                # the pool is empty now, so fresh zeros lose nothing
+                self._states = self._program.init_states()
+                self._tokens_np.fill(0.0)
+                self._pos_np.fill(0.0)
+                self._reset_np.fill(0.0)
+
+    def _join(self, req):
+        """Seat one admitted request in a free slot BETWEEN steps: zero
+        (or prefill-fill) the slot's state rows, stage its first token,
+        mark the slot valid.  No shape changes anywhere — the next step
+        dispatch reuses the same compiled program."""
+        if not req.future.set_running_or_notify_cancel():
+            if req.trace is not None:
+                req.trace.abort("cancelled")
+            with self._lock:
+                self._leaves += 1     # stats() and the leaves series
+            if self._tm is not None:  # must carry the same numbers
+                self._tm.leave("cancelled")
+            return
+        slot = self._slots.index(None)
+        req.slot = slot
+        req.t_join = time.perf_counter()
+        self._slots[slot] = req
+        self._valid_np[slot] = 1.0
+        with self._lock:
+            self._joins += 1
+        if self._tm is not None:
+            self._tm.joins.inc()
+        if self._prefill_caches:
+            # a broken prefill dispatch is THIS request's failure, not
+            # the batch's: co-resident mid-generation requests share no
+            # state with it and must keep their partial generations
+            try:
+                self._prefill(req, slot)
+            except Exception as e:
+                self._slots[slot] = None
+                self._valid_np[slot] = 0.0
+                with self._lock:
+                    self._leaves += 1
+                if self._tm is not None:
+                    self._tm.leave("error")
+                _fail_future(req.future, e)
+                if req.trace is not None:
+                    req.trace.abort(type(e).__name__)
+                return
+        else:
+            # the previous occupant's state rows are cleared IN the
+            # next step dispatch (StepProgram reset mask) — a join
+            # costs zero device traffic of its own
+            self._reset_np[slot] = 1.0
+            self._tokens_np[slot] = req.prompt[0]
+            self._pos_np[slot] = 0.0
+            req.prompt_i = 1
+        self._check_finish(slot)
+
+    def _prefill(self, req, slot):
+        """One bucketed dispatch consumes the whole prompt: pad onto
+        the pow2 bucket grid, run the prefill program (batch 1), argmax
+        the last-valid-position logits into the first generated token,
+        scatter the output state rows into the free slot."""
+        plen = len(req.prompt)
+        bucket = next(b for b in self._prefill_buckets if b >= plen)
+        arr = np.zeros((1, bucket), np.float32)
+        arr[0, :plen] = req.prompt
+        feeds = {self._prefill_data_name: arr,
+                 self._prefill_len_name: np.asarray([plen], np.float32)}
+        outs = self._prefill_caches[bucket].run(feeds)
+        first = outs[0][0]
+        rows = {name: outs[1 + i][0]
+                for i, name in enumerate(self._program.state_names)}
+        self._states = self._program.write_row(self._states, slot, rows)
+        self._reset_np[slot] = 0.0      # prefill rows are live data
+        req.prompt_i = plen
+        req.tokens.append(int(first))
+        self._tokens_np[slot] = first
+        self._pos_np[slot] = float(plen)
+        with self._lock:
+            self._tokens_out += 1
+        if self._tm is not None:
+            self._tm.tokens.inc()
+
+    def _step_once(self):
+        t0 = time.perf_counter()
+        now = time.monotonic()
+        # per-iteration deadline check: an expired slot-resident
+        # request completes with its partial tokens and frees the slot
+        # for queued work — mid-generation eviction, not failure
+        for i in self._occupied():
+            if self._slots[i].expired(now):
+                self._finish_slot(i, "deadline")
+        occ = self._occupied()
+        if not occ:
+            return
+        sampled, self._states = self._program.step(
+            self._tokens_np, self._pos_np, self._valid_np, self._states,
+            reset=self._reset_np)
+        self._reset_np.fill(0.0)        # consumed: rows are zeroed now
+        new_tokens = 0
+        for i in occ:
+            req = self._slots[i]
+            req.n_steps += 1
+            self._pos_np[i] += 1.0
+            if req.prompt_i < len(req.prompt):
+                # teacher forcing: the sample is discarded, the next
+                # prompt token rides the next step
+                self._tokens_np[i] = req.prompt[req.prompt_i]
+                req.prompt_i += 1
+            else:
+                req.tokens.append(int(sampled[i]))
+                self._tokens_np[i] = sampled[i]
+                new_tokens += 1
+            self._check_finish(i)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self._steps += 1
+            self._tokens_out += new_tokens
+            self._step_ms.append(dt_ms)
+        if self._tm is not None:
+            self._tm.steps.inc()
+            if new_tokens:
+                self._tm.tokens.inc(new_tokens)
+            self._tm.step_ms.observe(dt_ms)
+
+    def _check_finish(self, slot):
+        req = self._slots[slot]
+        if req is None or not req.tokens:
+            return
+        if self.eos_id is not None and req.tokens[-1] == self.eos_id:
+            self._finish_slot(slot, "eos")
+        elif len(req.tokens) >= req.max_new:
+            self._finish_slot(slot, "length")
+        elif self._pos_np[slot] >= self.max_len:
+            # no position left to consume the staged token at: the
+            # fixed O(1) cache layout is full
+            self._finish_slot(slot, "length")
+
+    def _finish_slot(self, slot, reason):
+        """Leave the batch between steps: deliver the result, mark the
+        slot dead (valid=0) — its state rows stay as stale garbage,
+        which the row-local step verdict proves harmless, and the next
+        join rewrites them."""
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._valid_np[slot] = 0.0
+        self._tokens_np[slot] = 0.0
+        self._pos_np[slot] = 0.0
+        now = time.monotonic()
+        t1 = time.perf_counter()
+        res = DecodeResult(req.tokens, reason, n_steps=req.n_steps,
+                           prompt_len=len(req.prompt))
+        if not req.future.cancelled():
+            try:
+                req.future.set_result(res)
+            except Exception:
+                pass
+        with self._lock:
+            self._leaves += 1
+            self._requests_served += 1
+            if reason == "deadline":
+                self._evictions += 1
+            self._lat_ms.append((now - req.t_enqueue) * 1e3)
+        if self._tm is not None:
+            self._tm.leave(reason)
+            if reason == "deadline":
+                self._tm.evictions.inc()
+        if req.trace is not None:
+            t_join = req.t_join if req.t_join is not None else t1
+
+            def build(tc, _req=req, _t_join=t_join, _t1=t1,
+                      _reason=reason):
+                tc.add("queue-wait", tc.root.t0, _t_join, "serve")
+                tc.add("decode", _t_join, _t1, "serve",
+                       meta={"steps": _req.n_steps,
+                             "tokens": len(_req.tokens),
+                             "prompt_len": len(_req.prompt),
+                             "finish_reason": _reason})
+            req.trace.finish(t1, build=build)
+
+    # ------------------------------------------------------------ observe
+    def warmup(self):
+        """Compile everything live traffic will ever dispatch: the
+        persistent step program, the per-state row-write kernels, and
+        (with a prefill graph) one program per pow2 prompt bucket.
+        After this, joins/leaves/steps never trace — tests pin
+        ``compile_count`` across churn.  Returns the compile count.
+
+        The step runs TWICE on purpose: jax's executable cache keys on
+        argument sharding, and the kernel's own state outputs (every
+        live iteration's inputs) carry committed shardings that fresh
+        ``init_states`` buffers don't — one warm step would leave the
+        first live iteration paying a silent ~100ms recompile that the
+        trace counter cannot even see.  The row-write kernel likewise
+        warms against both a fresh buffer and a stepped one (the two
+        shardings a prefill scatter can meet)."""
+        states = self._program.init_states()
+        states = self._program.zero_row(states, 0)
+        n = self.num_slots
+        z = np.zeros((n,), np.float32)
+        _, states = self._program.step(z, z, z, states)
+        _, states = self._program.step(z, z, z, states)
+        rows = {}
+        for info in self._program.state_info:
+            dt = np.dtype(info.get("dtype") or self._program._dtype)
+            rows[info["name"]] = np.zeros(tuple(info["shape"]), dt)
+        self._program.write_row(states, 0, rows)
+        for b in self._prefill_buckets:
+            feeds = {self._prefill_data_name:
+                     np.zeros((1, b), np.float32),
+                     self._prefill_len_name:
+                     np.zeros((1,), np.float32)}
+            self._prefill_caches[b].run(feeds)
+        return self.compile_count
+
+    @property
+    def compile_count(self):
+        c = self._program.trace_count
+        seen = set()
+        for cache in self._prefill_caches.values():
+            if id(cache) not in seen:       # shared length-poly cache
+                seen.add(id(cache))
+                c += cache.compile_count
+        return c
+
+    def stats(self):
+        """Admission counters plus the ``decode`` block: slot-pool
+        geometry and occupancy, step/token/join/leave/eviction
+        counts, per-step and end-to-end latency percentiles — the
+        same numbers the ``mxnet_serve_decode_*`` series carry."""
+        snap = self._adm.stats()
+        with self._lock:
+            step = sorted(self._step_ms)
+            lat = sorted(self._lat_ms)
+            snap["decode"] = {
+                "slots": self.num_slots,
+                "slots_occupied": self._occupied_count(),
+                "max_len": self.max_len,
+                "steps": self._steps,
+                "tokens_generated": self._tokens_out,
+                "joins": self._joins,
+                "leaves": self._leaves,
+                "evictions": self._evictions,
+                "requests_served": self._requests_served,
+                "compile_count": self.compile_count,
+                "prefill": ("bucket" if self._prefill_caches
+                            else "step"),
+                "prefill_buckets": list(self._prefill_buckets),
+                "step_ms": {
+                    "count": len(step),
+                    "mean": float(np.mean(step)) if step else 0.0,
+                    "p50": _percentile(step, 0.50),
+                    "p99": _percentile(step, 0.99),
+                },
+                "latency_ms": {
+                    "count": len(lat),
+                    "mean": float(np.mean(lat)) if lat else 0.0,
+                    "p50": _percentile(lat, 0.50),
+                    "p99": _percentile(lat, 0.99),
+                },
+            }
+        return snap

@@ -145,6 +145,23 @@ def _healthz(server):
         "batches": occ_count,
         "traces_stored": len(tracing.recent_trace_ids()),
     }
+    # continuous-batching decode engines: pool occupancy + throughput
+    # counters (serving/decode.py), present only when one is live
+    dec_slots = doc.get("mxnet_serve_decode_slots", {}).get("series", [])
+    if dec_slots:
+        def _total(name):
+            return sum(s.get("value") or 0
+                       for s in doc.get(name, {}).get("series", []))
+        out["decode"] = {
+            "engines": len(dec_slots),
+            "slots": _total("mxnet_serve_decode_slots"),
+            "slots_occupied": _total("mxnet_serve_decode_slots_occupied"),
+            "tokens": _total("mxnet_serve_decode_tokens_total"),
+            "steps": _total("mxnet_serve_decode_steps_total"),
+            "joins": _total("mxnet_serve_decode_joins_total"),
+            "leaves": _total("mxnet_serve_decode_leaves_total"),
+            "evictions": _total("mxnet_serve_decode_evictions_total"),
+        }
     # training processes: step count + live MFU per instrumented loop
     steps = doc.get("mxnet_train_steps_total", {}).get("series", [])
     if steps:

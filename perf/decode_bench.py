@@ -1,0 +1,268 @@
+"""Token-throughput bench for continuous-batching decode
+(mxnet_tpu/serving/decode.py).
+
+Compares two schedulers over the SAME job list (one frozen LSTM step
+graph, per-request output lengths drawn from a capped geometric
+distribution — the mixed-length regime where static batching hurts):
+
+- **static**: the pre-continuous baseline — fill every slot, step the
+  pool until the SLOWEST resident request finishes, drain, refill.
+  Every finished sequence rides along dead until the batch completes,
+  and nobody joins mid-flight; per-batch cost is max(len) while useful
+  output is mean(len);
+- **continuous**: the ``DecodeEngine`` — iteration-level scheduling,
+  requests join/leave the running pool between steps, a finished
+  slot's place is re-filled from the queue on the very next
+  iteration.
+
+Both paths dispatch the identical compiled step program at the same
+slot-pool extent, so the tokens/s ratio isolates the *scheduling*
+win; job lists are identical (same seed, eos disabled, per-request
+``max_new_tokens`` from the geometric draw), so total generated
+tokens match exactly and the compile-once contract is asserted on
+both sides (retraces == 0 after warmup).
+
+  python perf/decode_bench.py                      # default sweep
+  python perf/decode_bench.py --requests 96 --slots 8 --mean-new 24
+  # defaults: hidden=128 so the step is compute-bound (python/thread
+  # noise on a small shared host cannot swamp the scheduling signal)
+  # and max_len=128 so the geometric tail is NOT truncated — the cap
+  # would trim exactly the stragglers static batching chokes on
+  python perf/decode_bench.py --check-speedup 2    # exit 1 if < 2x
+  python perf/decode_bench.py --record BENCH_decode.json
+
+A fast smoke variant runs in the tier-1 suite
+(tests/test_decode.py::test_decode_bench_smoke; the >=2x acceptance
+gate runs here, not there).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(vocab=32, embed=16, hidden=32, seed=0):
+    """One LSTM decode step: token + (h, c) -> [logits, h', c']."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.rnn.rnn_cell import LSTMCell
+    tok = mx.sym.Variable("token")
+    emb = mx.sym.Embedding(tok, input_dim=vocab, output_dim=embed,
+                           name="emb")
+    cell = LSTMCell(hidden, prefix="lstm_")
+    out, (h2, c2) = cell(emb, [mx.sym.Variable("h"),
+                               mx.sym.Variable("c")])
+    logits = mx.sym.FullyConnected(out, num_hidden=vocab, name="out_fc")
+    step = mx.sym.Group([logits, h2, c2])
+    rng = np.random.default_rng(seed)
+
+    def w(*shape, scale=1.0):
+        return mx.nd.array(
+            rng.standard_normal(shape).astype(np.float32) * scale)
+
+    params = {
+        "emb_weight": w(vocab, embed),
+        "lstm_i2h_weight": w(4 * hidden, embed, scale=0.5),
+        "lstm_i2h_bias": mx.nd.zeros((4 * hidden,)),
+        "lstm_h2h_weight": w(4 * hidden, hidden, scale=0.5),
+        "lstm_h2h_bias": mx.nd.zeros((4 * hidden,)),
+        "out_fc_weight": w(vocab, hidden),
+        "out_fc_bias": mx.nd.zeros((vocab,)),
+    }
+    state_info = [{"name": "h", "shape": (hidden,)},
+                  {"name": "c", "shape": (hidden,)}]
+    return step, params, state_info
+
+
+def make_jobs(requests, mean_new, max_len, vocab, seed=1):
+    """(prompt, max_new) per request: 1-token prompts, output lengths
+    geometric with the given mean, capped into the slot's capacity —
+    the mixed regime where one straggler pins a static batch."""
+    rng = np.random.default_rng(seed)
+    cap = max_len - 1                      # 1 position consumes the BOS
+    jobs = []
+    for _ in range(requests):
+        n = int(min(cap, rng.geometric(1.0 / mean_new)))
+        jobs.append(([int(rng.integers(vocab))], max(1, n)))
+    return jobs
+
+
+def static_rebatch_round(program, jobs, max_len):
+    """The baseline scheduler: batches of ``num_slots`` run to FULL
+    completion before the next batch starts.  Returns (total tokens,
+    seconds, step dispatches)."""
+    n = program.num_slots
+    states = program.init_states()
+    total = steps = 0
+    t0 = time.perf_counter()
+    queue = list(jobs)
+    while queue:
+        batch, queue = queue[:n], queue[n:]
+        tokens = np.zeros((n,), np.float32)
+        pos = np.zeros((n,), np.float32)
+        valid = np.zeros((n,), np.float32)
+        reset = np.zeros((n,), np.float32)
+        live = []
+        for i, (prompt, max_new) in enumerate(batch):
+            reset[i] = 1.0              # same in-step row clear the
+            tokens[i] = prompt[0]       # engine's joins use
+            valid[i] = 1.0
+            live.append({"prompt": list(prompt), "pi": 1,
+                         "out": 0, "max_new": max_new})
+        while any(r is not None for r in live):
+            sampled, states = program.step(tokens, pos, valid, states,
+                                           reset=reset)
+            reset.fill(0.0)
+            steps += 1
+            for i, r in enumerate(live):
+                if r is None:
+                    continue
+                pos[i] += 1.0
+                if r["pi"] < len(r["prompt"]):
+                    tokens[i] = r["prompt"][r["pi"]]
+                    r["pi"] += 1
+                else:
+                    tokens[i] = sampled[i]
+                    r["out"] += 1
+                    total += 1
+                if r["out"] >= r["max_new"] or pos[i] >= max_len:
+                    live[i] = None
+                    valid[i] = 0.0        # dead weight until the drain
+    return total, time.perf_counter() - t0, steps
+
+
+def continuous_round(eng, jobs):
+    """Offer every job up front (deep backlog — the regime continuous
+    batching exists for) and drain.  Returns (tokens, seconds)."""
+    t0 = time.perf_counter()
+    futs = [eng.submit(prompt, max_new_tokens=max_new)
+            for prompt, max_new in jobs]
+    results = [f.result(timeout=600) for f in futs]
+    dt = time.perf_counter() - t0
+    total = sum(len(r) for r in results)
+    bad = [r.finish_reason for r in results
+           if r.finish_reason not in ("length", "eos")]
+    if bad:
+        raise RuntimeError("continuous round lost requests: %s" % bad)
+    return total, dt
+
+
+def run_bench(requests=64, slots=8, max_len=128, mean_new=16, vocab=32,
+              embed=16, hidden=128, seed=0, repeat=3):
+    """One full comparison at a fixed geometry; returns the result row.
+
+    ``repeat`` rounds run INTERLEAVED (static, continuous, static,
+    continuous, ...) over one compiled program / one engine, and each
+    scheduler reports its best round — the serve_bench idiom: on a
+    shared noisy host the first rounds eat cold caches and frequency
+    ramps, and interleaving keeps slow minutes from landing on one
+    side of the comparison."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mxnet_tpu.serving.decode import DecodeEngine, StepProgram
+
+    step, params, state_info = build_model(vocab, embed, hidden, seed)
+    jobs = make_jobs(requests, mean_new, max_len, vocab, seed + 1)
+    want = sum(m for _, m in jobs)
+
+    prog = StepProgram(step, params, {}, state_info, num_slots=slots)
+    # warmup outside the timing; twice — the second step's committed
+    # state shardings are their own executable-cache key (see
+    # DecodeEngine.warmup)
+    st = prog.init_states()
+    st = prog.zero_row(st, 0)
+    z = np.zeros((slots,), np.float32)
+    _, st = prog.step(z, z, z, st)
+    prog.step(z, z, z, st)
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=slots,
+                       max_len=max_len, max_queue=requests + slots,
+                       default_deadline_ms=0)
+    eng.warmup()
+    c0 = prog.trace_count + eng.compile_count
+
+    best_s = best_c = 0.0
+    s_steps = steps0 = 0
+    for _ in range(max(1, repeat)):
+        s_tokens, s_dt, s_steps = static_rebatch_round(prog, jobs,
+                                                       max_len)
+        c_tokens, c_dt = continuous_round(eng, jobs)
+        if s_tokens != want or c_tokens != want:
+            raise RuntimeError(
+                "token accounting mismatch: want %d, static %d, "
+                "continuous %d" % (want, s_tokens, c_tokens))
+        best_s = max(best_s, s_tokens / s_dt)
+        best_c = max(best_c, c_tokens / c_dt)
+    retraces = prog.trace_count + eng.compile_count - c0
+    stats = eng.stats()["decode"]
+    eng.close()
+
+    row = {
+        "requests": requests,
+        "slots": slots,
+        "max_len": max_len,
+        "mean_new": mean_new,
+        "rounds": max(1, repeat),
+        "tokens": want,
+        "static_tps": best_s,
+        "static_steps": s_steps,
+        "continuous_tps": best_c,
+        "continuous_steps": stats["steps"] // max(1, repeat),
+        "speedup": best_c / best_s,
+        "retraces": retraces,
+        "step_p50_ms": stats["step_ms"]["p50"],
+        "step_p99_ms": stats["step_ms"]["p99"],
+    }
+    return row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="continuous-batching decode throughput bench")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--mean-new", type=int, default=16,
+                    help="mean of the geometric output-length draw")
+    ap.add_argument("--vocab", type=int, default=32)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--repeat", type=int, default=4,
+                    help="interleaved best-of-N rounds (scheduling is "
+                         "deterministic; repeats absorb host noise)")
+    ap.add_argument("--check-speedup", type=float, default=None,
+                    metavar="X", help="exit 1 unless continuous/static "
+                    "tokens-per-second ratio >= X")
+    ap.add_argument("--record", metavar="PATH",
+                    help="append the result row to this JSON file "
+                         "(BENCH_*.json bookkeeping)")
+    args = ap.parse_args(argv)
+
+    best = run_bench(requests=args.requests, slots=args.slots,
+                     max_len=args.max_len, mean_new=args.mean_new,
+                     vocab=args.vocab, hidden=args.hidden,
+                     repeat=args.repeat)
+    print(json.dumps(best))
+    print("best: %.1f tok/s continuous vs %.1f tok/s static "
+          "(%.2fx, %d retraces)"
+          % (best["continuous_tps"], best["static_tps"],
+             best["speedup"], best["retraces"]))
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump({"decode": best}, f, indent=1, sort_keys=True)
+            f.write("\n")
+    if best["retraces"]:
+        print("FAIL: %d post-warmup retraces (compile-once contract)"
+              % best["retraces"])
+        return 1
+    if args.check_speedup is not None and \
+            best["speedup"] < args.check_speedup:
+        print("FAIL: speedup %.2fx < required %.2fx"
+              % (best["speedup"], args.check_speedup))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,12 @@ Usage:
     python tools/graph_lint.py model-symbol.json \
         --shapes data=8,3,224,224 --optimize
 
+    # continuous-batching decode: is the masked step row-local along
+    # the SLOT axis (axis 0), with state inputs seeded pad-dirty?
+    python tools/graph_lint.py step-symbol.json --decode-step \
+        --shapes token=8 --shapes h=8,32 --shapes c=8,32 \
+        --decode-state h,c
+
 Dynamic dims are written as 0 (or '?') in --shapes; the retrace linter
 keys on them.  --strict exits nonzero on warnings too (CI bar: the
 model-zoo exemplars must lint clean — tests/test_graph_lint.py).
@@ -175,6 +181,27 @@ def main(argv=None):
                     help="directory for --fix/--optimize outputs "
                          "(default: next to the input JSON, or the "
                          "cwd for model names)")
+    ap.add_argument("--decode-step", action="store_true",
+                    help="lint a continuous-batching decode STEP graph "
+                         "(serving/decode.py): axis 0 of every --shapes "
+                         "input is the slot-pool axis, and the verdict "
+                         "must be row-local along it — a dead slot's "
+                         "stale values must never reach a live slot's "
+                         "outputs.  State inputs (--decode-state) are "
+                         "seeded pad-DIRTY, so even zero-absorbing "
+                         "reductions over them count as violations.  A "
+                         "cross-position slot verdict exits 1 even "
+                         "without --strict: the decode engine has no "
+                         "degrade path, unsound means unserveable")
+    ap.add_argument("--decode-state", default="", metavar="N1,N2,..",
+                    help="with --decode-step: comma list of slot-state "
+                         "input names (KV cache / recurrent state "
+                         "buffers; freed slots leave stale garbage in "
+                         "them, so they get no zero-pad credit)")
+    ap.add_argument("--decode-valid", default=None, metavar="NAME",
+                    help="with --decode-step: name of the slot-"
+                         "occupancy/valid vector input, if the step "
+                         "graph masks on one")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="print one machine-readable JSON document "
                          "instead of text (hazard_rank.py input)")
@@ -191,6 +218,14 @@ def main(argv=None):
         policy = _build_policy(args)
     except Exception as e:
         print("graph_lint: %s" % e, file=sys.stderr)
+        return 2
+
+    if args.decode_step and (args.fix or args.optimize
+                             or args.seq_axis is not None
+                             or args.seq_buckets):
+        print("graph_lint: --decode-step lints the step graph as-is "
+              "along the slot axis and cannot combine with --fix/"
+              "--optimize/--seq-axis/--seq-buckets", file=sys.stderr)
         return 2
 
     passes = tuple(p.strip() for p in args.passes.split(",")
@@ -212,6 +247,31 @@ def main(argv=None):
                 continue
             return 2
         shapes.update(cli_shapes)
+        if args.decode_step:
+            state_names = [s.strip() for s in
+                           args.decode_state.split(",") if s.strip()]
+            verdict, report = analysis.check_decode_step(
+                graph, shapes, state_names=state_names,
+                valid_name=args.decode_valid, training=args.training)
+            hard = bool(report.errors)
+            unsound = verdict == "cross-position"
+            failed = unsound or not report.clean(strict=args.strict)
+            doc[spec] = {"findings": report.to_list(),
+                         "verdicts": {"slot": verdict}, "repairs": []}
+            if not args.as_json and (failed or not args.quiet):
+                print("== %s ==" % spec)
+                print(report.format())
+                print("  decode-step slot axis: %s" % verdict)
+                if unsound:
+                    print("  FAIL: step graph is cross-position along "
+                          "the slot axis — a dead slot's stale state "
+                          "reaches live outputs; DecodeEngine cannot "
+                          "serve it")
+            if hard:
+                worst = 2
+            elif failed:
+                worst = max(worst, 1)
+            continue
         shapes, valid_vars = _shape_valid_lengths(graph, shapes)
         pad_axes = None
         if policy is not None and policy.seq_axis is not None:
