@@ -225,6 +225,44 @@ VARIABLES = {v.name: v for v in [
          "telemetry_rank<N>.json under this (shared) directory, and "
          "`tools/telemetry_dump.py aggregate <dir>/telemetry_rank*.json` "
          "merges them into one rank-labeled document.  Empty = off."),
+    _Var("MXNET_TELEMETRY_HISTORY_SECS", float, 1.0,
+         "Sampling interval of the in-process time-series recorder "
+         "(telemetry/recorder.py): every interval the metrics registry "
+         "is snapshotted into a bounded in-memory ring, giving true "
+         "rate()/delta()/windowed-quantile queries (GET /history) and "
+         "the SLO alert evaluation tick with zero external infra.  "
+         "Started lazily by the first ServingEngine/DecodeEngine (last "
+         "close() stops it) or explicitly via "
+         "telemetry.start_recorder().  0 = off."),
+    _Var("MXNET_TELEMETRY_HISTORY_WINDOW", int, 600,
+         "Ring capacity of the history recorder in samples (memory is "
+         "bounded by construction: deque(maxlen=N)).  At the default "
+         "1 s interval, 600 samples = a 10-minute trailing window — "
+         "enough for the 60 s/600 s multiwindow burn-rate rules."),
+    _Var("MXNET_TELEMETRY_ALERTS", bool, True,
+         "Evaluate SLO alert rules (telemetry/alerts.py) against the "
+         "history ring on every recorder sample.  Engines register "
+         "default rules at construction (queue-saturation and "
+         "deadline-miss burn rates, per-engine zero-progress watchdog "
+         "and retrace-storm) and remove them at close(); rule states "
+         "serve at GET /alerts, transitions stream over GET /events.  "
+         "0 = rules are neither registered nor evaluated."),
+    _Var("MXNET_TELEMETRY_WATCHDOG_SECS", float, 30.0,
+         "Zero-progress threshold for the engines' default watchdog "
+         "alert rules: a worker heartbeat that is BUSY (work queued or "
+         "a dispatch in flight) yet stamped no progress for this many "
+         "seconds fires <kind>_engine<N>_stalled — a wedged dispatch "
+         "or starved queue, named, not inferred."),
+    _Var("MXNET_FLIGHT_RECORDER_DIR", str, "",
+         "Black-box post-mortem directory.  When set, any alert "
+         "transition to firing (watchdog trips included) atomically "
+         "dumps a flight bundle — trailing history window, rule "
+         "states, retained traces, per-engine stats(), heartbeats, "
+         "all-thread stacks via faulthandler — as flight_*.json under "
+         "this directory (rate-limited, pruned to the newest 16), and "
+         "fatal signals (SIGSEGV/SIGFPE/SIGABRT) append stacks to "
+         "fatal_stacks.log via faulthandler.enable.  Read bundles "
+         "back with tools/telemetry_dump.py bundle.  Empty = off."),
     _Var("MXNET_TELEMETRY_TRACE_CAPACITY", int, 256,
          "Bound on the in-process finished-trace store; beyond it the "
          "oldest span trees are evicted (long serving runs must not "

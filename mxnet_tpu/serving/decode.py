@@ -115,7 +115,7 @@ class DecodeRequest(Request):
     """One decode request: a prompt plus generation bookkeeping the
     scheduler mutates as the request moves queue -> slot -> done."""
     __slots__ = ("prompt", "max_new", "tokens", "prompt_i", "slot",
-                 "t_join", "n_steps")
+                 "t_join", "n_steps", "t_first_tok", "t_last_tok")
 
     def __init__(self, prompt, max_new, future, deadline=None,
                  trace=None):
@@ -128,6 +128,10 @@ class DecodeRequest(Request):
         self.slot = None
         self.t_join = None
         self.n_steps = 0
+        # decode latency anatomy: first/last generated-token stamps
+        # feed the TTFT and inter-token (TPOT) histograms
+        self.t_first_tok = None
+        self.t_last_tok = None
 
 
 class StepProgram(object):
@@ -404,6 +408,27 @@ class _DecodeTelemetry(object):
             "wall time of one decode iteration (deadline sweep + step "
             "dispatch + host bookkeeping)",
             buckets=_telemetry.LATENCY_MS_BUCKETS)
+        # per-request tail latency the tokens/s counter cannot see
+        # (the 2603.09555 O(1)-per-token framing is throughput-only):
+        # TTFT = submit -> first generated token (queue wait + prefill
+        # + first step), TPOT = mean inter-token gap over a finished
+        # request's generation.  Engine-labeled so co-resident engines
+        # keep distinct tails AND the series reclaim at close().
+        ttft_fam = reg.histogram(
+            "mxnet_serve_decode_ttft_seconds",
+            "time to first token: submit -> first generated token id "
+            "(queue wait + prefill + first step), per decode engine",
+            labelnames=("engine",),
+            buckets=_telemetry.LATENCY_S_BUCKETS)
+        self.ttft = ttft_fam.labels(engine=self.engine_label)
+        tpot_fam = reg.histogram(
+            "mxnet_serve_decode_tpot_seconds",
+            "inter-token latency: mean gap between consecutive "
+            "generated tokens per finished request (>= 2 tokens), per "
+            "decode engine",
+            labelnames=("engine",),
+            buckets=_telemetry.LATENCY_S_BUCKETS)
+        self.tpot = tpot_fam.labels(engine=self.engine_label)
         slots_fam = reg.gauge(
             "mxnet_serve_decode_slots",
             "slot-pool capacity per decode engine",
@@ -422,7 +447,8 @@ class _DecodeTelemetry(object):
         self.compile_count = compile_fam.labels(
             engine=self.engine_label)
         self._engine_gauge_fams = (queue_depth_fam, slots_fam,
-                                   occupied_fam, compile_fam)
+                                   occupied_fam, compile_fam,
+                                   ttft_fam, tpot_fam)
         self._engine = weakref.ref(engine)
         reg.register_callback(self._refresh)
 
@@ -576,6 +602,29 @@ class DecodeEngine(object):
         self._tokens_out = 0
         self._requests_served = 0
         self._abort = False
+        # history/alerting plane (engine.py has the full story): the
+        # scheduler loop stamps a heartbeat, the engine registers for
+        # flight-recorder stats() capture, default SLO rules cover the
+        # decode plane (shared burn rates + per-engine zero-progress
+        # watchdog), and the recorder sampler is refcounted.
+        # Registered LAST — after the failure-prone slot-pool state
+        # allocation — so a constructor that raises never holds a
+        # rule, heartbeat, or recorder reference close() cannot drop.
+        self._hb_t = time.monotonic()
+        self._hb_busy = False
+        self._owns_recorder = False
+        self._alert_owner = None
+        self._obs_name = None
+        if self._tm is not None:
+            self._obs_name = "decode.%s" % self._tm.engine_label
+            _telemetry.recorder.register_heartbeat(self._obs_name,
+                                                   self._heartbeat)
+            _telemetry.recorder.register_engine(self._obs_name, self)
+            self._owns_recorder = _telemetry.recorder.recorder_acquire()
+            if config.get("MXNET_TELEMETRY_ALERTS"):
+                self._alert_owner = \
+                    _telemetry.register_engine_default_rules(
+                        "decode", self._tm.engine_label)
         self._worker = None
         if start:
             self.start()
@@ -669,6 +718,16 @@ class DecodeEngine(object):
             self._run()     # never started: drain on the caller's thread
         if self._tm is not None:
             self._tm.close()
+        if self._obs_name is not None:
+            _telemetry.recorder.unregister_heartbeat(self._obs_name)
+            _telemetry.recorder.unregister_engine(self._obs_name)
+            self._obs_name = None
+        if self._alert_owner is not None:
+            _telemetry.default_manager().remove_owner(self._alert_owner)
+            self._alert_owner = None
+        if self._owns_recorder:
+            token, self._owns_recorder = self._owns_recorder, False
+            _telemetry.recorder.recorder_release(token)
         if self._owns_http_server:
             self._owns_http_server = False
             _telemetry.server.engine_release()
@@ -746,8 +805,27 @@ class DecodeEngine(object):
     def _occupied_count(self):
         return sum(1 for s in self._slots if s is not None)
 
+    def _heartbeat(self):
+        """Watchdog probe: progress age of the scheduler loop, busy
+        when any slot is generating or work is queued.  A step program
+        wedged in dispatch (donated-buffer failure modes, a hung
+        backend) shows up as busy + growing age — named by this
+        heartbeat, not inferred from throughput silence."""
+        now = time.monotonic()
+        queued = len(self._adm)
+        occupied = self._occupied_count()
+        return {"age_s": now - self._hb_t,
+                "busy": bool(self._hb_busy or queued or occupied),
+                "in_step": bool(self._hb_busy),
+                "queued": queued, "slots_occupied": occupied,
+                "kind": "decode",
+                "engine": (self._tm.engine_label
+                           if self._tm is not None else None)}
+
     def _run(self):
         while True:
+            self._hb_t = time.monotonic()
+            self._hb_busy = False
             try:
                 if self._abort:
                     for i in self._occupied():
@@ -770,6 +848,7 @@ class DecodeEngine(object):
                         self._join(r)
                 else:
                     self._adm.sweep()
+                self._hb_busy = True    # a wedged step must read busy
                 self._step_once()
             except Exception as e:      # fail the batch, keep serving
                 for i in self._occupied():
@@ -857,12 +936,15 @@ class DecodeEngine(object):
         self._reset_np[slot] = 0.0      # prefill rows are live data
         req.prompt_i = plen
         req.tokens.append(int(first))
+        now = time.monotonic()
+        req.t_first_tok = req.t_last_tok = now
         self._tokens_np[slot] = first
         self._pos_np[slot] = float(plen)
         with self._lock:
             self._tokens_out += 1
         if self._tm is not None:
             self._tm.tokens.inc()
+            self._tm.ttft.observe(now - req.t_enqueue)
 
     def _step_once(self):
         t0 = time.perf_counter()
@@ -881,6 +963,7 @@ class DecodeEngine(object):
             reset=self._reset_np)
         self._reset_np.fill(0.0)        # consumed: rows are zeroed now
         new_tokens = 0
+        t_tok = time.monotonic()        # one stamp serves every slot
         for i in occ:
             req = self._slots[i]
             req.n_steps += 1
@@ -894,6 +977,11 @@ class DecodeEngine(object):
                 req.tokens.append(int(sampled[i]))
                 self._tokens_np[i] = sampled[i]
                 new_tokens += 1
+                if req.t_first_tok is None:
+                    req.t_first_tok = t_tok
+                    if self._tm is not None:
+                        self._tm.ttft.observe(t_tok - req.t_enqueue)
+                req.t_last_tok = t_tok
             self._check_finish(i)
         dt_ms = (time.perf_counter() - t0) * 1e3
         with self._lock:
@@ -948,6 +1036,15 @@ class DecodeEngine(object):
             self._tm.leave(reason)
             if reason == "deadline":
                 self._tm.evictions.inc()
+            if len(req.tokens) >= 2 and req.t_first_tok is not None \
+                    and req.t_last_tok is not None:
+                # mean inter-token gap over this request's generation:
+                # one observation per request keeps the hot loop at
+                # O(1) instrument calls while the histogram still
+                # carries the per-request tail the counter cannot
+                self._tm.tpot.observe(
+                    (req.t_last_tok - req.t_first_tok)
+                    / (len(req.tokens) - 1))
         if req.trace is not None:
             t_join = req.t_join if req.t_join is not None else t1
 

@@ -389,6 +389,29 @@ class ServingEngine(object):
         self._requests_served = 0
         self._occupancy_sum = 0.0
         self._warmup_batches = 0
+        # time-series history + SLO alerting (telemetry/recorder.py,
+        # alerts.py): the worker loop stamps a heartbeat the watchdog
+        # rule polls, the engine registers under the per-engine label
+        # so a flight-recorder bundle captures its stats(), and the
+        # first engine starts the sampler thread (refcounted; the last
+        # close() stops it).  All of it reclaimed at close().
+        # Registered LAST: a constructor that raises above never holds
+        # a rule, heartbeat, or recorder reference close() cannot drop.
+        self._hb_t = time.monotonic()
+        self._hb_busy = False
+        self._owns_recorder = False
+        self._alert_owner = None
+        self._obs_name = None
+        if self._tm is not None:
+            self._obs_name = "serve.%s" % self._tm.engine_label
+            _telemetry.recorder.register_heartbeat(self._obs_name,
+                                                   self._heartbeat)
+            _telemetry.recorder.register_engine(self._obs_name, self)
+            self._owns_recorder = _telemetry.recorder.recorder_acquire()
+            if config.get("MXNET_TELEMETRY_ALERTS"):
+                self._alert_owner = \
+                    _telemetry.register_engine_default_rules(
+                        "serve", self._tm.engine_label)
         self._worker = None
         if start:
             self.start()
@@ -655,6 +678,21 @@ class ServingEngine(object):
             self._run()    # never started: drain on the caller's thread
         if self._tm is not None:
             self._tm.close()
+        if self._obs_name is not None:
+            # observability plane detach: heartbeat, flight-recorder
+            # stats registration, and this engine's alert rules (shared
+            # burn-rate rules drop only at the last owner) all go —
+            # reload loops must not grow the watchdog poll or the rule
+            # table
+            _telemetry.recorder.unregister_heartbeat(self._obs_name)
+            _telemetry.recorder.unregister_engine(self._obs_name)
+            self._obs_name = None
+        if self._alert_owner is not None:
+            _telemetry.default_manager().remove_owner(self._alert_owner)
+            self._alert_owner = None
+        if self._owns_recorder:
+            token, self._owns_recorder = self._owns_recorder, False
+            _telemetry.recorder.recorder_release(token)
         if self._owns_http_server:
             # last engine out stops the HTTP endpoint: port + acceptor
             # thread are released, so reload loops cannot leak either
@@ -825,9 +863,29 @@ class ServingEngine(object):
         return self.submit(value, deadline_ms=deadline_ms,
                            **feeds).result(timeout=timeout)
 
+    def _heartbeat(self):
+        """Watchdog probe (telemetry/recorder.py): age since the worker
+        loop last made progress, and whether it HAS work — ``busy`` is
+        the false-positive guard: an idle engine blocked on an empty
+        queue is healthy however stale its stamp, while a worker that
+        is mid-dispatch (or has work queued) and stale is wedged."""
+        now = time.monotonic()
+        queued = len(self._adm)
+        return {"age_s": now - self._hb_t,
+                "busy": bool(self._hb_busy or queued),
+                "in_dispatch": bool(self._hb_busy),
+                "queued": queued, "kind": "serve",
+                "engine": (self._tm.engine_label
+                           if self._tm is not None else None)}
+
     # -------------------------------------------------------------- worker
     def _run(self):
         while True:
+            # heartbeat: progress stamp at every loop turn; busy only
+            # once work is actually in hand (the blocking take below
+            # may idle for hours on a quiet engine)
+            self._hb_t = time.monotonic()
+            self._hb_busy = False
             try:
                 reqs = self._adm.take(self._policy.max_batch,
                                       self._window_s)
@@ -837,6 +895,8 @@ class ServingEngine(object):
                 return                     # closed and drained
             if not reqs:
                 continue
+            self._hb_t = time.monotonic()
+            self._hb_busy = True
             t_pop = time.perf_counter()
             if self._tm is not None:
                 now_mono = time.monotonic()

@@ -44,7 +44,8 @@ from __future__ import annotations
 import atexit
 
 from .metrics import (Registry, Counter, Gauge, Histogram, Family,
-                      LATENCY_MS_BUCKETS, RATIO_BUCKETS, BYTES_BUCKETS)
+                      LATENCY_MS_BUCKETS, LATENCY_S_BUCKETS,
+                      RATIO_BUCKETS, BYTES_BUCKETS)
 from .tracing import (TraceContext, LazyTrace, Span, current_trace,
                       activate, trace, maybe_span, get_trace,
                       recent_trace_ids, all_traces, clear_traces)
@@ -56,13 +57,19 @@ from .sampling import (PeriodicSampler, TailSampler, ErrorSampler,
                        SamplerChain, chain_from_config,
                        persist_tail_state, restore_tail_state)
 from .server import (TelemetryServer, start_server, stop_server,
-                     server_address)
+                     server_address, publish_event, event_hub)
+from .recorder import (HistoryRecorder, FlightRecorder, start_recorder,
+                       stop_recorder, get_recorder, register_heartbeat,
+                       unregister_heartbeat, heartbeats, flight_recorder)
+from .alerts import (AlertRule, AlertManager, default_manager,
+                     register_engine_default_rules)
 from .step import (StepTimer, PHASES, STEP_SECONDS_BUCKETS,
                    PEAKS_TFLOPS, peak_flops_for)
 
 __all__ = [
     "Registry", "Counter", "Gauge", "Histogram", "Family",
-    "LATENCY_MS_BUCKETS", "RATIO_BUCKETS", "BYTES_BUCKETS",
+    "LATENCY_MS_BUCKETS", "LATENCY_S_BUCKETS", "RATIO_BUCKETS",
+    "BYTES_BUCKETS",
     "TraceContext", "LazyTrace", "Span", "current_trace", "activate",
     "trace", "maybe_span", "get_trace", "recent_trace_ids",
     "all_traces", "clear_traces",
@@ -72,6 +79,12 @@ __all__ = [
     "PeriodicSampler", "TailSampler", "ErrorSampler", "SamplerChain",
     "chain_from_config", "persist_tail_state", "restore_tail_state",
     "TelemetryServer", "start_server", "stop_server", "server_address",
+    "publish_event", "event_hub",
+    "HistoryRecorder", "FlightRecorder", "start_recorder",
+    "stop_recorder", "get_recorder", "register_heartbeat",
+    "unregister_heartbeat", "heartbeats", "flight_recorder",
+    "AlertRule", "AlertManager", "default_manager",
+    "register_engine_default_rules",
     "StepTimer", "PHASES", "STEP_SECONDS_BUCKETS", "PEAKS_TFLOPS",
     "peak_flops_for",
     "enabled", "set_enabled", "registry", "counter", "gauge",
@@ -159,6 +172,31 @@ def dump_state(path):
     return path
 
 
+# fatal-signal half of the flight recorder: faulthandler writes every
+# thread's stack to a file in the bundle directory on SIGSEGV/SIGFPE/
+# SIGABRT — the one failure mode no Python-level hook can narrate.
+# Module-global handle: faulthandler holds the fd for the process life.
+_FATAL_STACKS_FILE = None
+
+
+def _maybe_enable_fatal_stacks(config):
+    global _FATAL_STACKS_FILE
+    fr_dir = config.get("MXNET_FLIGHT_RECORDER_DIR")
+    if not fr_dir or _FATAL_STACKS_FILE is not None:
+        return
+    try:
+        import faulthandler
+        import os
+        os.makedirs(fr_dir, exist_ok=True)
+        _FATAL_STACKS_FILE = open(
+            os.path.join(fr_dir, "fatal_stacks.log"), "a")
+        faulthandler.enable(file=_FATAL_STACKS_FILE, all_threads=True)
+    except Exception as e:
+        import warnings
+        warnings.warn("flight recorder: cannot install fatal-signal "
+                      "stack dump (%s)" % e)
+
+
 # Periodic snapshots and the HTTP endpoint autostart when configured
 # (serving processes run unattended for days); a final snapshot lands
 # at interpreter exit, and the server socket closes cleanly.
@@ -166,6 +204,7 @@ def _maybe_autostart():
     from .. import config
     if not enabled():
         return
+    _maybe_enable_fatal_stacks(config)
     if config.get("MXNET_TELEMETRY_SNAPSHOT_PATH"):
         # ROADMAP 5c: the TailSampler's moving-p99 window survives a
         # process reload through a snapshot-path sidecar — written at

@@ -121,7 +121,16 @@ def render_json(registry=None, include_traces=True, meta=None):
     if registry is None:
         from . import registry as _default
         registry = _default()
-    doc = {"format": "mxnet_tpu.telemetry/1", "metrics": registry.collect()}
+    import time
+    # scrape_ts (wall clock) + scrape_monotonic stamp WHEN the snapshot
+    # was rendered: N rank snapshots in a shared dir were previously
+    # unorderable (each carried only its own uptime), so `telemetry_dump
+    # aggregate` could silently merge a fresh rank with a stale one —
+    # it now warns on >60 s wall-clock skew between ranks.
+    doc = {"format": "mxnet_tpu.telemetry/1",
+           "scrape_ts": time.time(),
+           "scrape_monotonic": time.monotonic(),
+           "metrics": registry.collect()}
     if include_traces:
         from . import tracing
         doc["traces"] = tracing.all_traces()

@@ -35,12 +35,17 @@ import threading
 from ..base import MXNetError
 
 __all__ = ["Counter", "Gauge", "Histogram", "Family", "Registry",
-           "LATENCY_MS_BUCKETS", "RATIO_BUCKETS", "BYTES_BUCKETS"]
+           "LATENCY_MS_BUCKETS", "LATENCY_S_BUCKETS", "RATIO_BUCKETS",
+           "BYTES_BUCKETS"]
 
 # Shared fixed boundaries (upper-inclusive, Prometheus `le` convention).
 # Latencies in ms spanning sub-queue-wait to multi-second XLA compiles:
 LATENCY_MS_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
                       100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0)
+# The same span in SECONDS, for the *_seconds families the decode
+# latency histograms use (TTFT/TPOT follow the OpenMetrics base-unit
+# convention, and per-token gaps live well under a millisecond):
+LATENCY_S_BUCKETS = tuple(b / 1e3 for b in LATENCY_MS_BUCKETS)
 # Ratios in [0, 1] (batch occupancy, padding waste):
 RATIO_BUCKETS = (0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 # Payload sizes (kvstore push/pull):

@@ -13,8 +13,32 @@ dependency, per the container constraint:
 - ``GET /traces``        retained trace ids + one-line summaries
                          (name, e2e ms, retained_by, failed reason)
 - ``GET /traces/<id>``   one request's full span tree
-- ``GET /healthz``       liveness: uptime, queue depth + occupancy
-                         summed over live engines, trace-store size
+- ``GET /healthz``       liveness: uptime, a wall-clock ``scrape_ts``
+                         (orders snapshots across ranks), queue depth +
+                         occupancy summed over live engines,
+                         trace-store size, firing-alert count
+- ``GET /alerts``        every SLO rule's state machine (alerts.py):
+                         firing first, with value/detail/annotations
+- ``GET /history``       windowed time-series queries over the
+                         in-process recorder ring (recorder.py):
+                         ``?series=<name>[&labels=k=v,..][&window=S]
+                         [&q=0.99]`` returns the samples plus exact
+                         delta / per-second rate (and the windowed
+                         quantile for histogram series)
+- ``GET /events``        Server-Sent Events stream pushing alert
+                         transitions, kept traces, and flight-recorder
+                         dumps as they happen (see below)
+
+SSE contract (``/events``): the stream opens with ``retry: 3000`` (the
+client's reconnect delay) and replays nothing by default.  Every event
+carries an incrementing ``id:``; a reconnecting client sends the
+standard ``Last-Event-ID`` header and the server replays every event
+still in its bounded replay ring (256), or emits ``event: reset`` when
+the id has already been evicted so the client knows events were lost.
+A ``: keep-alive`` comment goes out every 15 s (``?keepalive=<secs>``
+overrides) so idle proxies don't reap the connection; the response is
+close-delimited (``Connection: close``) — reconnect-and-resume IS the
+recovery path, never a half-resumed stream.
 
 Start it explicitly (``telemetry.start_server(port)``) or let the
 ``MXNET_TELEMETRY_PORT`` env knob start it — at telemetry import for
@@ -26,20 +50,124 @@ Concurrency: every request handler renders from a point-in-time
 ``Registry.collect()`` snapshot (instrument locks are held per-value,
 never across the render), so a scrape racing engine mutation can never
 observe a torn exposition document — tests parse every response under
-a pounding thread to hold that line.
+a pounding thread to hold that line.  SSE frames are written whole per
+event under the per-handler socket, so a concurrent subscriber sees
+complete frames or a clean disconnect.
 """
 from __future__ import annotations
 
+import collections
 import json
+import queue as _queue
 import threading
 import time
 
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse, parse_qs
 
 from ..base import MXNetError
 
 __all__ = ["TelemetryServer", "start_server", "stop_server",
-           "server_address"]
+           "server_address", "publish_event", "event_hub"]
+
+
+class _EventHub(object):
+    """Process-wide SSE fan-out: bounded replay ring + per-subscriber
+    bounded queues.  Publishers (alert transitions, kept traces,
+    flight dumps) pay one lock + deque append; a subscriber that stops
+    draining has its queue closed (sentinel) instead of back-pressuring
+    the publisher — observability must never slow the observed."""
+
+    def __init__(self, replay=256, sub_capacity=1024):
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._replay = collections.deque(maxlen=replay)
+        self._subs = []
+        self._sub_capacity = sub_capacity
+
+    def publish(self, event, data):
+        """Enqueue one event to every subscriber and the replay ring.
+        ``data`` must be JSON-able; returns the event id."""
+        payload = json.dumps(data, sort_keys=True, default=str)
+        with self._lock:
+            self._seq += 1
+            ev = (self._seq, event, payload)
+            self._replay.append(ev)
+            subs = list(self._subs)
+        for q in subs:
+            try:
+                q.put_nowait(ev)
+            except _queue.Full:
+                # slow consumer: close it out rather than drop silently
+                # — drain one slot so the close sentinel always fits
+                # (the queue was full, so nothing else could have made
+                # room between these two calls)
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    pass
+                try:
+                    q.put_nowait(None)
+                except _queue.Full:
+                    pass
+                self.unsubscribe(q)
+        return self._seq
+
+    def subscribe(self, last_event_id=None):
+        """(queue, replayed events, reset) — ``reset`` True when the
+        requested resume point predates the replay ring (the client
+        lost events and should resync via /alerts + /traces)."""
+        q = _queue.Queue(maxsize=self._sub_capacity)
+        replayed, reset = [], False
+        with self._lock:
+            if last_event_id is not None:
+                try:
+                    last = int(last_event_id)
+                except (TypeError, ValueError):
+                    last = None
+                if last is not None:
+                    oldest = self._replay[0][0] if self._replay \
+                        else self._seq + 1
+                    if last + 1 < oldest and last < self._seq:
+                        reset = True
+                    replayed = [ev for ev in self._replay if ev[0] > last]
+            self._subs.append(q)
+        return q, replayed, reset
+
+    def unsubscribe(self, q):
+        with self._lock:
+            try:
+                self._subs.remove(q)
+            except ValueError:
+                pass
+
+    def kick_all(self):
+        """Wake every subscriber with a close sentinel (server stop)."""
+        with self._lock:
+            subs = list(self._subs)
+        for q in subs:
+            try:
+                q.put_nowait(None)
+            except _queue.Full:
+                pass
+
+    def subscribers(self):
+        with self._lock:
+            return len(self._subs)
+
+
+_HUB = _EventHub()
+
+
+def event_hub():
+    """The process-wide SSE hub ``GET /events`` subscribers drain."""
+    return _HUB
+
+
+def publish_event(event, data):
+    """Publish one event (``alert`` / ``trace`` / ``flight`` / custom)
+    to every live ``/events`` subscriber and the replay ring."""
+    return _HUB.publish(event, data)
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -69,7 +197,13 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------- routing
     def do_GET(self):                    # noqa: N802 - stdlib signature
         try:
-            self._route(self.path.split("?", 1)[0].rstrip("/") or "/")
+            u = urlparse(self.path)
+            path = u.path.rstrip("/") or "/"
+            query = {k: v[-1] for k, v in parse_qs(u.query).items()}
+            if path == "/events":
+                self._serve_events(query)
+            else:
+                self._route(path, query)
         except (BrokenPipeError, ConnectionResetError):
             pass                         # scraper hung up mid-response
         except Exception as e:           # never kill the handler thread
@@ -78,7 +212,7 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:
                 pass
 
-    def _route(self, path):
+    def _route(self, path, query):
         from . import render_prometheus, render_json, tracing
         if path == "/metrics":
             self._send(200, render_prometheus(), PROM_CONTENT_TYPE)
@@ -96,13 +230,68 @@ class _Handler(BaseHTTPRequestHandler):
                     "stored": len(tracing.recent_trace_ids())})
             else:
                 self._send_json(200, tree)
+        elif path == "/alerts":
+            self._send_json(200, _alerts_doc())
+        elif path == "/history":
+            code, doc = _history_doc(query)
+            self._send_json(code, doc)
         elif path in ("/", "/healthz"):
             self._send_json(200, _healthz(self.server.telemetry_server))
         else:
             self._send_json(404, {
                 "error": "unknown route %r" % path,
                 "routes": ["/metrics", "/metrics.json", "/traces",
-                           "/traces/<id>", "/healthz"]})
+                           "/traces/<id>", "/alerts", "/history",
+                           "/events", "/healthz"]})
+
+    # ---------------------------------------------------------------- SSE
+    def _serve_events(self, query):
+        """Server-Sent Events: alert transitions + kept traces +
+        flight-recorder dumps, pushed as they happen (module docstring
+        has the keep-alive/reconnect contract)."""
+        srv = self.server.telemetry_server
+        try:
+            keepalive = max(0.01, float(query.get("keepalive", 15.0)))
+        except (TypeError, ValueError):
+            keepalive = 15.0
+        q, replayed, reset = _HUB.subscribe(
+            self.headers.get("Last-Event-ID"))
+        self.close_connection = True
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            w = self.wfile
+            w.write(b"retry: 3000\n\n")
+            if reset:
+                w.write(b"event: reset\ndata: "
+                        b"{\"reason\": \"replay window exceeded\"}\n\n")
+            for ev in replayed:
+                w.write(self._sse_frame(ev))
+            w.flush()
+            while not srv._stopping.is_set():
+                try:
+                    ev = q.get(timeout=keepalive)
+                except _queue.Empty:
+                    w.write(b": keep-alive\n\n")
+                    w.flush()
+                    continue
+                if ev is None:           # hub kicked us (stop / overflow)
+                    break
+                w.write(self._sse_frame(ev))
+                w.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass                         # subscriber hung up: normal
+        finally:
+            _HUB.unsubscribe(q)
+
+    @staticmethod
+    def _sse_frame(ev):
+        seq, event, payload = ev
+        return ("id: %d\nevent: %s\ndata: %s\n\n"
+                % (seq, event, payload)).encode("utf-8")
 
 
 def _trace_index():
@@ -124,12 +313,99 @@ def _trace_index():
     return {"count": len(rows), "traces": rows}
 
 
+def _alerts_doc():
+    """Every rule's state row (firing first) + evaluation metadata:
+    whether a recorder is actually sampling and at what interval — a
+    rule table nobody evaluates must be visibly dead, not quietly
+    green."""
+    from .alerts import default_manager
+    from .recorder import get_recorder
+    mgr = default_manager()
+    rec = get_recorder()
+    now = time.monotonic()
+    return {
+        "alerts": mgr.states(),
+        "firing": mgr.firing(),
+        "rules": len(mgr),
+        "evaluating": bool(rec is not None and rec.alerts is mgr),
+        "interval_s": rec.interval_s if rec is not None else None,
+        "last_eval_age_s": (round(now - mgr.last_eval, 3)
+                            if mgr.last_eval is not None else None),
+        "scrape_ts": time.time(),
+    }
+
+
+def _history_doc(query):
+    """(status, doc) for one ``/history`` query: the windowed sample
+    points of a series plus the derived delta / per-second rate —
+    computed from the SAME ring samples the response carries, so a
+    client can re-derive (and a test hand-check) every number."""
+    from .recorder import get_recorder
+    rec = get_recorder()
+    if rec is None:
+        return 503, {"error": "no history recorder running (set "
+                              "MXNET_TELEMETRY_HISTORY_SECS > 0 or call "
+                              "telemetry.start_recorder())"}
+    name = query.get("series")
+    if not name:
+        return 400, {"error": "pass ?series=<metric family name>",
+                     "series": rec.series_names()}
+    labels = None
+    if query.get("labels"):
+        labels = {}
+        for part in query["labels"].split(","):
+            if "=" not in part:
+                return 400, {"error": "labels must be k=v[,k=v...], "
+                                      "got %r" % query["labels"]}
+            k, v = part.split("=", 1)
+            labels[k.strip()] = v.strip()
+    window_s = None
+    if query.get("window"):
+        try:
+            window_s = float(query["window"])
+        except ValueError:
+            return 400, {"error": "window must be seconds, got %r"
+                                  % query["window"]}
+    kind = rec.kind(name)
+    if kind is None:
+        return 404, {"error": "series %r not in recorded history" % name,
+                     "series": rec.series_names()}
+    doc = {"series": name, "kind": kind, "labels": labels,
+           "window_s": window_s, "interval_s": rec.interval_s,
+           "samples_stored": len(rec), "scrape_ts": time.time()}
+    if kind == "histogram":
+        pts = rec.hist_points(name, labels, window_s)
+        doc["samples"] = [[t, v] for t, v in pts]
+        if query.get("q"):
+            try:
+                doc["quantile"] = {
+                    "q": float(query["q"]),
+                    "value": rec.quantile(name, float(query["q"]),
+                                          labels, window_s)}
+            except ValueError:
+                return 400, {"error": "q must be a float in [0, 1], "
+                                      "got %r" % query["q"]}
+    else:
+        pts = rec.points(name, labels, window_s)
+        doc["samples"] = [[t, v] for t, v in pts]
+    doc["delta"] = (pts[-1][1] - pts[0][1]) if len(pts) >= 2 else None
+    dt = (pts[-1][0] - pts[0][0]) if len(pts) >= 2 else 0.0
+    doc["rate_per_s"] = (doc["delta"] / dt
+                         if doc["delta"] is not None and dt > 0 else None)
+    return 200, doc
+
+
 def _healthz(server):
     """Liveness + the two numbers an operator checks first: how deep
     the admission queues are and how full dispatched batches run.
     Derived from the registry (collect() runs the engine refresh
-    callbacks), so it is exactly what /metrics would report."""
+    callbacks), so it is exactly what /metrics would report.
+    ``scrape_ts`` (wall clock) + ``scrape_monotonic`` stamp WHEN this
+    document was rendered: multi-rank aggregation needs an orderable
+    timestamp, which per-process uptime alone cannot give."""
     from . import registry, tracing
+    from .alerts import default_manager
+    from .recorder import get_recorder
     doc = registry().collect()
     qd = doc.get("mxnet_serve_queue_depth", {}).get("series", [])
     occ = doc.get("mxnet_serve_batch_occupancy", {}).get("series", [])
@@ -138,6 +414,8 @@ def _healthz(server):
     out = {
         "status": "ok",
         "uptime_s": round(time.monotonic() - server.t_start, 3),
+        "scrape_ts": time.time(),
+        "scrape_monotonic": time.monotonic(),
         "port": server.port,
         "engines": len(qd),
         "queue_depth": sum(s.get("value") or 0 for s in qd),
@@ -169,6 +447,13 @@ def _healthz(server):
         out["train_mfu"] = {
             s["labels"].get("loop", "?"): s.get("value") or 0.0
             for s in doc.get("mxnet_train_mfu", {}).get("series", [])}
+    # alerting plane: rule/firing counts + whether anything evaluates
+    mgr = default_manager()
+    if len(mgr):
+        rec = get_recorder()
+        out["alerts"] = {"rules": len(mgr), "firing": mgr.firing(),
+                         "evaluating": bool(rec is not None
+                                            and rec.alerts is mgr)}
     return out
 
 
@@ -189,6 +474,7 @@ class TelemetryServer(object):
         self.host = host
         self.port = self._httpd.server_address[1]
         self.t_start = time.monotonic()
+        self._stopping = threading.Event()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             kwargs={"poll_interval": 0.1},
@@ -197,7 +483,11 @@ class TelemetryServer(object):
 
     def stop(self):
         """Shut down and release the port; joins the acceptor thread so
-        a caller can rebind the same port immediately after."""
+        a caller can rebind the same port immediately after.  SSE
+        subscriber loops are kicked first so their handler threads exit
+        instead of idling out their keep-alive timers."""
+        self._stopping.set()
+        _HUB.kick_all()
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)

@@ -212,6 +212,19 @@ class TraceContext(object):
             while len(_STORE) > cap:
                 _STORE.popitem(last=False)
         self._bridge_to_profiler()
+        # push the keep to live /events subscribers (SSE): only the
+        # retained minority reaches this line, so the dropped-path
+        # cost stays zero; a hub failure must never fail a request
+        try:
+            from .server import publish_event
+            root = tree.get("root", {})
+            publish_event("trace", {
+                "trace_id": self.trace_id, "name": root.get("name"),
+                "dur_ms": root.get("dur_ms"),
+                "retained_by": tree.get("retained_by"),
+                "failed": self.failed_reason})
+        except Exception:
+            pass
 
     def to_dict(self):
         return {"trace_id": self.trace_id,

@@ -30,6 +30,11 @@ both sides (retraces == 0 after warmup).
   # would trim exactly the stragglers static batching chokes on
   python perf/decode_bench.py --check-speedup 2    # exit 1 if < 2x
   python perf/decode_bench.py --record BENCH_decode.json
+  python perf/decode_bench.py --telemetry          # exit 1 if the full
+      # observability plane costs more than --telemetry-tol tokens/s
+      # (off-on-off centered-median + same-session A/A noise floor,
+      # the serve_bench/step_bench protocol; --record writes
+      # BENCH_decode_telemetry.json)
 
 A fast smoke variant runs in the tier-1 suite
 (tests/test_decode.py::test_decode_bench_smoke; the >=2x acceptance
@@ -218,6 +223,120 @@ def run_bench(requests=64, slots=8, max_len=128, mean_new=16, vocab=32,
     return row
 
 
+def run_telemetry_overhead(requests=64, slots=8, max_len=128,
+                           mean_new=16, vocab=32, embed=16, hidden=128,
+                           seed=0, repeats=3, tol=0.02, http=True):
+    """Decode-plane telemetry overhead gate — the decode path had no
+    recorded telemetry-overhead number (serve_bench gates the one-shot
+    engine only, and decode adds per-token instrument writes: TTFT /
+    TPOT observations, step histograms, token counters, the history
+    recorder + alert evaluation, and heartbeat polling).
+
+    Protocol is the serve_bench/step_bench one verbatim: one engine
+    per mode (instruments bind at construction), identical job lists
+    drained through :func:`continuous_round`, each repeat timing an
+    off-on-off TRIPLE whose centered ratio cancels linear drift, the
+    median discarding bursty outliers, and the off/off pairs forming a
+    same-session A/A null whose median deviation is the host's own
+    measurement resolution (``noise_floor``).  The gate only fails
+    when the measured regression exceeds ``tol`` PLUS that floor.
+    With ``http`` the FULL plane runs: live endpoint + a background
+    scraper hammering ``GET /metrics`` across BOTH modes' rounds (so
+    its GIL share cancels in the A/B) — the marginal cost measured is
+    the telemetry plane's own.
+    """
+    import statistics
+    import threading
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving.decode import DecodeEngine
+
+    step, params, state_info = build_model(vocab, embed, hidden, seed)
+    jobs = make_jobs(requests, mean_new, max_len, vocab, seed + 1)
+
+    def make_engine(enabled):
+        telemetry.set_enabled(enabled)
+        try:
+            eng = DecodeEngine(step, params, {}, state_info,
+                               num_slots=slots, max_len=max_len,
+                               max_queue=requests + slots,
+                               default_deadline_ms=0)
+            eng.warmup()
+        finally:
+            telemetry.set_enabled(None)
+        return eng
+
+    eng_off = make_engine(False)
+    eng_on = make_engine(True)
+
+    server = scraper = None
+    stop_scrape = threading.Event()
+    scrapes = [0, 0.0]
+    if http:
+        import http.client
+        server = telemetry.start_server(0, host="127.0.0.1")
+
+        def hammer():
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=5)
+            while not stop_scrape.is_set():
+                try:
+                    t0 = time.perf_counter()
+                    conn.request("GET", "/metrics")
+                    body = conn.getresponse().read()
+                    assert body.startswith(b"#"), "unparseable scrape"
+                    scrapes[0] += 1
+                    scrapes[1] += time.perf_counter() - t0
+                except Exception:
+                    conn.close()
+                    if stop_scrape.is_set():
+                        return
+                stop_scrape.wait(0.1)
+        scraper = threading.Thread(target=hammer, daemon=True,
+                                   name="bench-scraper")
+        scraper.start()
+
+    off_tps = on_tps = 0.0
+    centered, nulls = [], []
+    try:
+        for _ in range(max(1, repeats)):
+            ta, dt_a = continuous_round(eng_off, jobs)
+            tn, dt_n = continuous_round(eng_on, jobs)
+            tb, dt_b = continuous_round(eng_off, jobs)
+            assert ta == tn == tb, "token accounting diverged"
+            off_tps = max(off_tps, ta / min(dt_a, dt_b))
+            on_tps = max(on_tps, tn / dt_n)
+            # tokens/s ratios: on/off > 1 means telemetry is FASTER
+            centered.append((ta / dt_a + tb / dt_b) / 2.0 / (tn / dt_n))
+            nulls.append(abs(1.0 - (ta / dt_a) / (tb / dt_b)))
+    finally:
+        stop_scrape.set()
+        if scraper is not None:
+            scraper.join(timeout=10)
+        if server is not None:
+            telemetry.stop_server()
+        eng_off.close()
+        eng_on.close()
+    regression = 1.0 - 1.0 / statistics.median(centered)
+    noise_floor = statistics.median(nulls)
+    return {
+        "requests": requests,
+        "slots": slots,
+        "mean_new": mean_new,
+        "rounds": max(1, repeats),
+        "tps_telemetry_off": round(off_tps, 1),
+        "tps_telemetry_on": round(on_tps, 1),
+        "regression": round(regression, 4),
+        "noise_floor": round(noise_floor, 4),
+        "tol": tol,
+        "http_server": bool(http),
+        "metrics_scrapes": scrapes[0],
+        "mean_scrape_ms": (round(scrapes[1] / scrapes[0] * 1e3, 3)
+                           if scrapes[0] else None),
+        "ok": regression < tol + noise_floor,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="continuous-batching decode throughput bench")
@@ -234,10 +353,47 @@ def main(argv=None):
     ap.add_argument("--check-speedup", type=float, default=None,
                     metavar="X", help="exit 1 unless continuous/static "
                     "tokens-per-second ratio >= X")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="run the decode telemetry overhead gate "
+                         "instead of the continuous-vs-static sweep: "
+                         "exit 1 if tokens/s regresses >= "
+                         "--telemetry-tol with the full plane on "
+                         "(registry + HTTP endpoint + scraper)")
+    ap.add_argument("--telemetry-tol", type=float, default=0.02,
+                    help="allowed fractional tokens/s regression with "
+                         "telemetry on (default 0.02 = 2%%)")
+    ap.add_argument("--no-http", action="store_true",
+                    help="telemetry gate without the HTTP server + "
+                         "scraper (registry-only overhead)")
     ap.add_argument("--record", metavar="PATH",
                     help="append the result row to this JSON file "
                          "(BENCH_*.json bookkeeping)")
     args = ap.parse_args(argv)
+
+    if args.telemetry:
+        row = run_telemetry_overhead(
+            requests=args.requests, slots=args.slots,
+            max_len=args.max_len, mean_new=args.mean_new,
+            vocab=args.vocab, hidden=args.hidden,
+            repeats=args.repeat, tol=args.telemetry_tol,
+            http=not args.no_http)
+        print(json.dumps(row))
+        if args.record:
+            with open(args.record, "w") as f:
+                json.dump({"decode_telemetry_overhead": row}, f,
+                          indent=1, sort_keys=True)
+                f.write("\n")
+        if not row["ok"]:
+            print("FAIL: telemetry costs %.2f%% tokens/s "
+                  "(tol %.2f%% + measured noise floor %.2f%%)"
+                  % (row["regression"] * 1e2, row["tol"] * 1e2,
+                     row["noise_floor"] * 1e2))
+            return 1
+        print("OK: decode telemetry overhead %.2f%% < %.2f%% tol "
+              "+ %.2f%% noise floor"
+              % (row["regression"] * 1e2, row["tol"] * 1e2,
+                 row["noise_floor"] * 1e2))
+        return 0
 
     best = run_bench(requests=args.requests, slots=args.slots,
                      max_len=args.max_len, mean_new=args.mean_new,

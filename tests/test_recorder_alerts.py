@@ -1,0 +1,1023 @@
+"""In-process history, SLO alerting, and the flight recorder (ISSUE 9).
+
+Coverage per the issue contract: ``/history`` rate queries over a
+synthetic counter match hand-computed deltas EXACTLY (the recorder ring
+is the only source of truth, and its memory is bounded by
+construction); alert rule state machines (pending/firing/resolved with
+both flap suppressors) unit-tested with explicit clocks; an INDUCED
+hang — the serving worker blocked mid-dispatch — fires the
+zero-progress watchdog on ``/alerts`` within the evaluation interval
+and atomically dumps a flight-recorder bundle naming the wedged engine,
+read back through ``tools/telemetry_dump.py bundle``; SSE ``/events``
+keep-alive + Last-Event-ID reconnect semantics hammered under
+concurrent publishers; and the whole plane — rules, heartbeats,
+recorder thread, SSE subscribers, TTFT/TPOT series — reclaimed on
+``close()`` across a reload loop.
+"""
+import glob
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.telemetry import recorder as trec
+
+
+def _drain_default_manager():
+    mgr = telemetry.default_manager()
+    with mgr._lock:
+        mgr._states.clear()
+    # a failed test must not leak its heartbeats / engine registrations
+    # into the next one's watchdog sweep
+    with trec._HB_LOCK:
+        trec._HEARTBEATS.clear()
+    with trec._ENG_LOCK:
+        trec._ENGINES.clear()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane(monkeypatch):
+    """Empty registry, no recorder thread, no alert rules, no flight
+    recorder dir bleeding between tests — and verify nothing we start
+    outlives the test."""
+    monkeypatch.delenv("MXNET_FLIGHT_RECORDER_DIR", raising=False)
+    telemetry.set_enabled(None)
+    telemetry.stop_recorder()
+    _drain_default_manager()
+    telemetry.reset()
+    telemetry.stop_server()
+    yield
+    telemetry.stop_server()
+    telemetry.stop_recorder()
+    _drain_default_manager()
+    telemetry.set_enabled(None)
+    telemetry.reset()
+    assert not [t for t in threading.enumerate()
+                if t.name == "mxnet-telemetry-recorder"]
+
+
+def _mlp(feature=6, hidden=16, classes=3, seed=0):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.default_rng(seed)
+    params = {
+        "fc1_weight": mx.nd.array(
+            rng.standard_normal((hidden, feature)).astype(np.float32)),
+        "fc1_bias": mx.nd.zeros((hidden,)),
+        "fc2_weight": mx.nd.array(
+            rng.standard_normal((classes, hidden)).astype(np.float32)),
+        "fc2_bias": mx.nd.zeros((classes,)),
+    }
+    return net, params
+
+
+def _engine(net, params, **kw):
+    kw.setdefault("ctx", mx.cpu())
+    kw.setdefault("batch_timeout_ms", 5.0)
+    return serving.ServingEngine(net, params, {}, {"data": (6,)}, **kw)
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d%s" % (port, path), timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def _import_tool(name):
+    tooldir = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+    sys.path.insert(0, tooldir)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.remove(tooldir)
+
+
+# ---------------------------------------------------------------------------
+# history recorder: exact deltas, bounded ring, windowed quantiles
+# ---------------------------------------------------------------------------
+
+def test_history_delta_and_rate_match_hand_computed():
+    """Counter increments between two hand-driven samples ARE the
+    delta — bit-exact, no estimation."""
+    rec = telemetry.HistoryRecorder(interval_s=1.0, window=64,
+                                    start=False)
+    c = telemetry.counter("mxnet_test_hist_total", "t")
+    c.inc(5)
+    rec.sample_now()
+    c.inc(7)
+    rec.sample_now()
+    c.inc(1)
+    rec.sample_now()
+    pts = rec.points("mxnet_test_hist_total")
+    assert [v for _, v in pts] == [5.0, 12.0, 13.0]
+    assert rec.delta("mxnet_test_hist_total") == 8.0      # 13 - 5 exact
+    dt = pts[-1][0] - pts[0][0]
+    assert rec.rate("mxnet_test_hist_total") == 8.0 / dt
+    assert rec.latest("mxnet_test_hist_total") == 13.0
+
+
+def test_history_endpoint_rate_matches_samples_exactly():
+    """The acceptance number: /history's delta and rate_per_s must be
+    recomputable from the very samples the response carries."""
+    rec = telemetry.start_recorder(interval_s=3600, window=64)
+    srv = telemetry.start_server(0, host="127.0.0.1")
+    c = telemetry.counter("mxnet_test_live_total", "t")
+    c.inc(3)
+    rec.sample_now()
+    c.inc(4)
+    rec.sample_now()
+    c.inc(10)
+    rec.sample_now()
+    doc = _get_json(srv.port,
+                    "/history?series=mxnet_test_live_total")
+    vals = [v for _, v in doc["samples"]]
+    assert vals == [3.0, 7.0, 17.0]
+    assert doc["delta"] == 14.0                           # hand-computed
+    t0, tn = doc["samples"][0][0], doc["samples"][-1][0]
+    assert doc["rate_per_s"] == 14.0 / (tn - t0)
+    assert doc["kind"] == "counter"
+    assert "scrape_ts" in doc
+
+
+def test_history_endpoint_error_paths():
+    srv = telemetry.start_server(0, host="127.0.0.1")
+    # no recorder at all -> 503 with a remediation hint
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get_json(srv.port, "/history?series=x")
+    assert e.value.code == 503
+    telemetry.start_recorder(interval_s=3600, window=8)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get_json(srv.port, "/history")                   # series missing
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get_json(srv.port, "/history?series=mxnet_nope_total")
+    assert e.value.code == 404
+
+
+def test_history_ring_memory_is_bounded():
+    rec = telemetry.HistoryRecorder(interval_s=1.0, window=8,
+                                    start=False)
+    c = telemetry.counter("mxnet_test_ring_total", "t")
+    for i in range(50):
+        c.inc()
+        rec.sample_now()
+    assert len(rec) == 8                       # deque(maxlen): by construction
+    pts = rec.points("mxnet_test_ring_total")
+    assert [v for _, v in pts] == [float(v) for v in range(43, 51)]
+    assert len(rec.export()["samples"]) == 8
+
+
+def test_history_label_subset_matching_sums():
+    rec = telemetry.HistoryRecorder(interval_s=1.0, window=8,
+                                    start=False)
+    fam = telemetry.counter("mxnet_test_lbl_total", "t",
+                            labelnames=("engine", "hazard"))
+    fam.labels(engine="0", hazard="a").inc(2)
+    fam.labels(engine="0", hazard="b").inc(3)
+    fam.labels(engine="1", hazard="a").inc(100)
+    rec.sample_now()
+    # subset match: {engine: 0} sums over the hazard fan-out
+    assert rec.points("mxnet_test_lbl_total",
+                      labels={"engine": "0"})[-1][1] == 5.0
+    assert rec.points("mxnet_test_lbl_total")[-1][1] == 105.0
+    assert rec.points("mxnet_test_lbl_total",
+                      labels={"engine": "2"}) == []
+
+
+def test_history_windowed_quantile_from_bucket_deltas():
+    """The windowed quantile must interpolate from the bucket-count
+    DELTA between the window endpoints — observations before the
+    window cannot contaminate it."""
+    rec = telemetry.HistoryRecorder(interval_s=1.0, window=16,
+                                    start=False)
+    h = telemetry.histogram("mxnet_test_q_ms", "t",
+                            buckets=(1.0, 2.0, 4.0, 8.0))
+    for _ in range(100):
+        h.observe(7.0)          # old regime: all in (4, 8]
+    rec.sample_now()
+    for _ in range(10):
+        h.observe(1.5)          # window regime: all in (1, 2]
+    rec.sample_now()
+    q = rec.quantile("mxnet_test_q_ms", 0.5)
+    # 10 in-window observations all land in (1, 2]: the median
+    # interpolates inside that bucket and must ignore the 100 old 7s
+    assert 1.0 < q <= 2.0
+    assert rec.quantile("mxnet_test_q_ms", 1.0) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# alert rule state machines (explicit clocks: no sleeps, no flakes)
+# ---------------------------------------------------------------------------
+
+def _rec_with_counter(name="mxnet_test_sm_total"):
+    reg = telemetry.Registry()
+    rec = telemetry.HistoryRecorder(interval_s=1.0, window=600,
+                                    registry=reg, start=False)
+    return rec, reg.counter(name, "t")
+
+
+def test_threshold_rule_pending_firing_resolved():
+    rec, c = _rec_with_counter()
+    mgr = telemetry.AlertManager(registry=telemetry.Registry())
+    mgr.add_rule(telemetry.AlertRule(
+        "r", "threshold", series="mxnet_test_sm_total", query="latest",
+        op=">", threshold=10.0, for_s=5.0, resolve_after_s=0.0))
+    t0 = rec.sample_now()                    # value 0: inactive
+    mgr.evaluate(rec, now=t0)
+    assert mgr.states()[0]["state"] == "inactive"
+    c.inc(11)
+    rec.sample_now()
+    mgr.evaluate(rec, now=t0 + 1)            # true, dwelling
+    assert mgr.states()[0]["state"] == "pending"
+    mgr.evaluate(rec, now=t0 + 3)            # still inside for_s
+    assert mgr.states()[0]["state"] == "pending"
+    mgr.evaluate(rec, now=t0 + 6.5)          # dwell served: fire
+    assert mgr.states()[0]["state"] == "firing"
+    assert mgr.firing() == 1
+    st = mgr.states()[0]
+    assert st["fired_count"] == 1 and st["value"] == 11.0
+    # a delta-query rule with for_s=0 fires the moment its window burns
+    mgr2 = telemetry.AlertManager(registry=telemetry.Registry())
+    mgr2.add_rule(telemetry.AlertRule(
+        "r2", "threshold", series="mxnet_test_sm_total", query="delta",
+        window_s=60.0, op=">", threshold=5.0))
+    mgr2.evaluate(rec, now=t0 + 7)           # delta 11 > 5: fires at once
+    assert mgr2.states()[0]["state"] == "firing"
+
+
+def test_pending_blip_cancels_without_firing():
+    """Flap suppressor #1: a condition that clears inside for_s never
+    fires — the pending state cancels back to inactive."""
+    rec, c = _rec_with_counter()
+    reg = telemetry.Registry()
+    mgr = telemetry.AlertManager(registry=reg)
+    mgr.add_rule(telemetry.AlertRule(
+        "blip", "threshold", series="mxnet_test_sm_total",
+        query="delta", window_s=2.0, op=">", threshold=0.0, for_s=10.0))
+    t0 = rec.sample_now()
+    c.inc(1)
+    rec.sample_now()
+    mgr.evaluate(rec, now=t0 + 1)
+    assert mgr.states()[0]["state"] == "pending"
+    # the delta window slides past the blip: condition false again
+    rec.sample_now()
+    mgr.evaluate(rec, now=rec.points("mxnet_test_sm_total")[-1][0] + 30)
+    assert mgr.states()[0]["state"] == "inactive"
+    assert mgr.states()[0]["fired_count"] == 0
+    fam = reg.get("mxnet_telemetry_alert_transitions_total")
+    counts = {tuple(v): inst.value for v, inst in fam.series()}
+    assert counts[("blip", "pending")] == 1
+    assert counts[("blip", "cancelled")] == 1
+    assert ("blip", "firing") not in counts
+
+
+def test_firing_dip_is_suppressed_by_resolve_after():
+    """Flap suppressor #2: a firing rule rides out a dip shorter than
+    resolve_after_s instead of resolve/refire churn."""
+    rec = telemetry.HistoryRecorder(interval_s=1.0, window=600,
+                                    registry=telemetry.Registry(),
+                                    start=False)
+    hb = {"age_s": 99.0, "busy": True}
+    trec.register_heartbeat("test.dip", lambda: hb)
+    try:
+        reg = telemetry.Registry()
+        mgr = telemetry.AlertManager(registry=reg)
+        mgr.add_rule(telemetry.AlertRule(
+            "dip", "watchdog", heartbeat="test.dip", threshold=10.0,
+            resolve_after_s=20.0))
+        t0 = time.monotonic()
+        mgr.evaluate(rec, now=t0)
+        assert mgr.states()[0]["state"] == "firing"
+        hb["age_s"] = 0.0                      # brief dip
+        mgr.evaluate(rec, now=t0 + 5)
+        assert mgr.states()[0]["state"] == "firing"   # suppressed
+        hb["age_s"] = 99.0                     # wedged again
+        mgr.evaluate(rec, now=t0 + 10)
+        assert mgr.states()[0]["state"] == "firing"
+        hb["age_s"] = 0.0                      # sustained recovery
+        mgr.evaluate(rec, now=t0 + 30)
+        mgr.evaluate(rec, now=t0 + 55)
+        assert mgr.states()[0]["state"] == "inactive"
+        fam = reg.get("mxnet_telemetry_alert_transitions_total")
+        counts = {tuple(v): inst.value for v, inst in fam.series()}
+        assert counts[("dip", "firing")] == 1          # fired ONCE
+        assert counts[("dip", "resolved")] == 1
+    finally:
+        trec.unregister_heartbeat("test.dip")
+
+
+def _fabricate_samples(rec, rows):
+    """Append ring samples with CHOSEN monotonic timestamps — the only
+    way to deterministically exercise the short/long window split."""
+    from mxnet_tpu.telemetry.recorder import _Sample
+    for t, scalars in rows:
+        rec._ring.append(_Sample(
+            t, t, {name: {(): float(v)} for name, v in scalars.items()},
+            {}))
+
+
+def test_burn_rate_requires_both_windows():
+    """The SRE multiwindow burn: a short spike whose long-window ratio
+    is still inside budget must NOT page (fast-burn pages need BOTH
+    windows over factor x budget)."""
+    rec = telemetry.HistoryRecorder(interval_s=1.0, window=600,
+                                    registry=telemetry.Registry(),
+                                    start=False)
+    mgr = telemetry.AlertManager(registry=telemetry.Registry())
+    mgr.add_rule(telemetry.AlertRule(
+        "burn", "burn_rate", num="mxnet_test_bad_total",
+        den="mxnet_test_all_total", budget=0.01, factor=10.0,
+        short_window_s=10.0, long_window_s=600.0))
+    # 10 minutes of clean traffic, then a 5 s spike of 90% errors:
+    # short ratio 90/100 = 0.9 > 0.1 bound, long 90/1100 = 0.08 < 0.1
+    _fabricate_samples(rec, [
+        (0.0, {"mxnet_test_all_total": 100000,
+               "mxnet_test_bad_total": 0}),
+        (595.0, {"mxnet_test_all_total": 100900,
+                 "mxnet_test_bad_total": 0}),
+        (600.0, {"mxnet_test_all_total": 101000,
+                 "mxnet_test_bad_total": 90}),
+    ])
+    assert mgr.evaluate(rec, now=600.0) == 0
+    st = mgr.states()[0]
+    assert st["state"] == "inactive"
+    assert st["detail"]["short_ratio"] > st["detail"]["burn_bound"]
+    assert st["detail"]["long_ratio"] < st["detail"]["burn_bound"]
+    # sustained burn: both windows cross -> page
+    _fabricate_samples(rec, [
+        (1195.0, {"mxnet_test_all_total": 101900,
+                  "mxnet_test_bad_total": 49000}),
+        (1200.0, {"mxnet_test_all_total": 102000,
+                  "mxnet_test_bad_total": 50090}),
+    ])
+    assert mgr.evaluate(rec, now=1200.0) == 1
+    st = mgr.states()[0]
+    assert st["state"] == "firing"
+    assert st["detail"]["short_ratio"] > st["detail"]["burn_bound"]
+    assert st["detail"]["long_ratio"] > st["detail"]["burn_bound"]
+
+
+def test_absence_rule_fires_when_series_vanishes():
+    reg = telemetry.Registry()
+    rec = telemetry.HistoryRecorder(interval_s=1.0, window=8,
+                                    registry=reg, start=False)
+    mgr = telemetry.AlertManager(registry=telemetry.Registry())
+    mgr.add_rule(telemetry.AlertRule(
+        "gone", "absence", series="mxnet_test_gone_total"))
+    c = reg.counter("mxnet_test_gone_total", "t")
+    c.inc()
+    t0 = rec.sample_now()
+    mgr.evaluate(rec, now=t0)
+    assert mgr.states()[0]["state"] == "inactive"
+    fam = reg.get("mxnet_test_gone_total")
+    fam.remove()                               # instrumentation rot
+    t1 = rec.sample_now()
+    mgr.evaluate(rec, now=t1)
+    assert mgr.states()[0]["state"] == "firing"
+
+
+def test_rule_validation_and_roundtrip():
+    with pytest.raises(MXNetError):
+        telemetry.AlertRule("x", "nonsense")
+    with pytest.raises(MXNetError):
+        telemetry.AlertRule("x", "threshold")          # no series
+    with pytest.raises(MXNetError):
+        telemetry.AlertRule("x", "burn_rate", num="a")  # no den
+    with pytest.raises(MXNetError):
+        telemetry.AlertRule("x", "watchdog")           # no heartbeat
+    r = telemetry.AlertRule(
+        "b", "burn_rate", num=("a_total", "b_total"), den="c_total",
+        budget=0.02, factor=6.0, short_window_s=30.0,
+        long_window_s=300.0, for_s=2.0, severity="ticket",
+        annotations={"engine": "3"})
+    r2 = telemetry.AlertRule.from_dict(r.to_dict())
+    assert r2.to_dict() == r.to_dict()
+
+
+def test_rule_series_reclaimed_and_shared_refcounts():
+    reg = telemetry.Registry()
+    mgr = telemetry.AlertManager(registry=reg)
+    rec = telemetry.HistoryRecorder(interval_s=1.0, window=8,
+                                    registry=telemetry.Registry(),
+                                    start=False)
+    hb = {"age_s": 99.0, "busy": True}
+    trec.register_heartbeat("test.rc", lambda: hb)
+    try:
+        mgr.add_rule(telemetry.AlertRule(
+            "rc", "watchdog", heartbeat="test.rc", threshold=1.0))
+        shared = telemetry.AlertRule(
+            "rc_shared", "threshold", series="mxnet_x_total",
+            query="delta", threshold=0.0)
+        mgr.add_rule(shared, owner="e0", shared=True)
+        mgr.add_rule(telemetry.AlertRule(
+            "rc_shared", "threshold", series="mxnet_x_total",
+            query="delta", threshold=0.0), owner="e1", shared=True)
+        assert len(mgr) == 2                   # one shared rule, 2 refs
+        # duplicate NON-shared registration is an error
+        with pytest.raises(MXNetError):
+            mgr.add_rule(telemetry.AlertRule(
+                "rc", "watchdog", heartbeat="test.rc", threshold=1.0))
+        t0 = time.monotonic()
+        mgr.evaluate(rec, now=t0)              # rc fires, series appear
+        fam = reg.get("mxnet_telemetry_alert_transitions_total")
+        assert any(v[0] == "rc" for v, _ in fam.series())
+        mgr.remove_owner("e0")
+        assert len(mgr) == 2                   # e1 still holds the shared
+        mgr.remove_owner("e1")
+        assert len(mgr) == 1
+        mgr.remove_rule("rc")
+        assert len(mgr) == 0
+        assert not list(fam.series())          # per-rule series reclaimed
+        state_fam = reg.get("mxnet_telemetry_alert_state")
+        assert not list(state_fam.series())
+    finally:
+        trec.unregister_heartbeat("test.rc")
+
+
+# ---------------------------------------------------------------------------
+# the acceptance path: induced hang -> watchdog -> flight bundle -> CLI
+# ---------------------------------------------------------------------------
+
+def test_wedged_worker_fires_watchdog_and_dumps_bundle(
+        tmp_path, monkeypatch):
+    """A worker thread blocked mid-dispatch must — with NO external
+    poller — (1) flip the zero-progress watchdog to firing on /alerts
+    within the evaluation interval, and (2) atomically dump a flight
+    bundle naming the wedged engine, with thread stacks and the
+    trailing history window, parseable by `telemetry_dump bundle`."""
+    frdir = str(tmp_path / "flight")
+    monkeypatch.setenv("MXNET_TELEMETRY_HISTORY_SECS", "0.1")
+    monkeypatch.setenv("MXNET_TELEMETRY_WATCHDOG_SECS", "0.4")
+    srv = telemetry.start_server(0, host="127.0.0.1")
+    net, params = _mlp()
+    # absorb the cold XLA compile in a throwaway engine (process-wide
+    # jit cache): a 0.4 s watchdog cannot tell a multi-second first
+    # compile from a hang, and the REAL production default (30 s) is
+    # sized above worst-case compiles for exactly this reason
+    warmer = _engine(net, params)
+    warmer.predict(np.zeros((6,), np.float32), timeout=60)
+    warmer.close()
+    eng = _engine(net, params)
+    label = eng._tm.engine_label
+    assert eng._owns_recorder                 # engine started the sampler
+    assert "serve.%s" % label in telemetry.heartbeats()
+    eng.predict(np.zeros((6,), np.float32), timeout=30)   # warm + healthy
+    # this engine's own first dispatch can still exceed the deliberately
+    # tight test watchdog: let any such trip resolve BEFORE arming the
+    # flight dir (flight_recorder() rebuilds per env change), so the
+    # one bundle below is the induced wedge and nothing else
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline \
+            and _get_json(srv.port, "/alerts")["firing"]:
+        time.sleep(0.05)
+    assert _get_json(srv.port, "/alerts")["firing"] == 0
+    monkeypatch.setenv("MXNET_FLIGHT_RECORDER_DIR", frdir)
+
+    wedge = threading.Event()
+    orig = eng._dispatch
+
+    def wedged_dispatch(reqs, t_pop=None):
+        wedge.wait(30)
+        return orig(reqs, t_pop)
+
+    eng._dispatch = wedged_dispatch
+    fut = eng.submit(np.zeros((6,), np.float32))
+    rule = "serve_engine%s_stalled" % label
+    try:
+        deadline = time.monotonic() + 15
+        row = None
+        while time.monotonic() < deadline:
+            doc = _get_json(srv.port, "/alerts")
+            rows = {a["name"]: a for a in doc["alerts"]}
+            if rows.get(rule, {}).get("state") == "firing":
+                row = rows[rule]
+                break
+            time.sleep(0.05)
+        assert row is not None, "watchdog never fired"
+        assert doc["evaluating"]              # the sampler IS the evaluator
+        assert row["annotations"]["engine"] == label  # wedged engine NAMED
+        assert row["value"] > 0.3             # the stall age
+
+        # the black box: one atomic bundle, no torn tmp files.  The
+        # firing state is visible on /alerts BEFORE the dump's
+        # os.replace lands (the recorder thread writes it right after
+        # the transition), so the bundle gets its own deadline.
+        deadline = time.monotonic() + 10
+        bundles = []
+        while time.monotonic() < deadline and not bundles:
+            bundles = glob.glob(os.path.join(frdir, "flight_*.json"))
+            time.sleep(0.05)
+        assert len(bundles) == 1
+    finally:
+        wedge.set()                # never leak a wedged engine: later
+        # tests share the process-global heartbeat/rule/hub state
+    assert not glob.glob(os.path.join(frdir, "*.tmp.*"))
+    bundle = json.load(open(bundles[0]))
+    assert bundle["format"] == "mxnet_tpu.telemetry/flight-1"
+    assert bundle["reason"] == "alert:%s" % rule
+    hb = bundle["heartbeats"]["serve.%s" % label]
+    assert hb["busy"] and hb["age_s"] > 0.3   # busy + zero progress
+    assert "serve.%s" % label in bundle["engines"]
+    assert bundle["history"]["samples"]       # trailing history window
+    assert "wedged_dispatch" in bundle["thread_stacks"]   # the smoking gun
+    assert [a for a in bundle["alerts"] if a["name"] == rule
+            and a["state"] == "firing"]
+
+    # ...and the CLI reads it back
+    telemetry_dump = _import_tool("telemetry_dump")
+    assert telemetry_dump.main(["bundle", bundles[0]]) == 0
+    assert telemetry_dump.main(
+        ["history", "--series", "mxnet_serve_queue_depth",
+         "--labels", "engine=%s" % label, bundles[0]]) == 0
+    assert telemetry_dump.main(
+        ["alerts", "--url",
+         "http://127.0.0.1:%d" % srv.port]) == 0
+
+    wedge.set()
+    fut.result(timeout=30)
+    eng.close()
+
+
+def test_bundle_cli_output_names_the_wedge(tmp_path, capsys):
+    """format_bundle renders the post-mortem narrative: reason, firing
+    rule, heartbeat age, history extent."""
+    rec = telemetry.HistoryRecorder(interval_s=1.0, window=8,
+                                    start=False)
+    telemetry.counter("mxnet_test_fr_total", "t").inc(2)
+    h = telemetry.histogram("mxnet_test_fr_ms", "t",
+                            buckets=(1.0, 2.0, 4.0))
+    lbl = telemetry.counter("mxnet_test_fr_lbl_total", "t",
+                            labelnames=("engine",))
+    lbl.labels(engine="0").inc(4)
+    lbl.labels(engine="1").inc(1)
+    rec.sample_now()
+    for _ in range(10):
+        h.observe(1.5)
+    lbl.labels(engine="0").inc(3)
+    rec.sample_now()
+    mgr = telemetry.AlertManager(registry=telemetry.Registry())
+    hb = {"age_s": 12.0, "busy": True, "queued": 3}
+    trec.register_heartbeat("serve.9", lambda: hb)
+    try:
+        mgr.add_rule(telemetry.AlertRule(
+            "w9", "watchdog", heartbeat="serve.9", threshold=1.0,
+            annotations={"engine": "9"}))
+        mgr.evaluate(rec, now=time.monotonic())
+        fr = telemetry.FlightRecorder(str(tmp_path), min_interval_s=0.0)
+        path = fr.dump("test", recorder=rec, alerts=mgr)
+        assert path and os.path.exists(path)
+    finally:
+        trec.unregister_heartbeat("serve.9")
+    telemetry_dump = _import_tool("telemetry_dump")
+    assert telemetry_dump.main(["bundle", path, "--no-stacks"]) == 0
+    out = capsys.readouterr().out
+    assert "w9" in out and "engine=9" in out
+    assert "serve.9" in out and "busy=True" in out
+    assert "history window: 2 samples" in out
+    # `alerts` over the bundle derives the firing count from the rows
+    # (bundles embed no endpoint summary keys)
+    assert telemetry_dump.main(["alerts", path]) == 0
+    out = capsys.readouterr().out
+    assert "1 firing" in out and "w9" in out
+    # offline history from the bundle reproduces the recorder's numbers
+    assert telemetry_dump.main(
+        ["history", "--series", "mxnet_test_fr_total", path]) == 0
+    out = capsys.readouterr().out
+    assert "delta=0" in out                   # flat between the 2 samples
+    # ...including the windowed quantile for histogram series: 10
+    # in-window 1.5s observations -> the median interpolates in (1, 2]
+    assert telemetry_dump.main(
+        ["history", "--series", "mxnet_test_fr_ms", "--q", "0.5",
+         path]) == 0
+    out = capsys.readouterr().out
+    assert "windowed q0.5 = 1.5" in out
+    # ...and label SUBSET matching, live-endpoint style: the bare name
+    # sums the engine fan-out, an exact label picks one series
+    assert telemetry_dump.main(
+        ["history", "--series", "mxnet_test_fr_lbl_total", path]) == 0
+    assert "delta=3" in capsys.readouterr().out     # 5 -> 8 summed
+    assert telemetry_dump.main(
+        ["history", "--series", "mxnet_test_fr_lbl_total",
+         "--labels", "engine=1", path]) == 0
+    assert "delta=0" in capsys.readouterr().out     # engine 1 was flat
+
+
+def test_flight_recorder_rate_limit_and_prune(tmp_path):
+    fr = telemetry.FlightRecorder(str(tmp_path), max_bundles=3,
+                                  min_interval_s=3600.0)
+    assert fr.dump("flap") is not None
+    assert fr.dump("flap") is None            # rate-limited per reason
+    assert fr.dump("other") is not None       # distinct reason passes
+    fr2 = telemetry.FlightRecorder(str(tmp_path), max_bundles=3,
+                                   min_interval_s=0.0)
+    for i in range(5):
+        assert fr2.dump("r%d" % i) is not None
+    assert len(glob.glob(str(tmp_path / "flight_*.json"))) == 3
+
+
+# ---------------------------------------------------------------------------
+# SSE /events: live push, keep-alive, reconnect replay, reset
+# ---------------------------------------------------------------------------
+
+def _read_sse(port, stop_when, timeout_s=10, headers=None, query=""):
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d/events%s" % (port, query),
+        headers=headers or {})
+    r = urllib.request.urlopen(req, timeout=timeout_s)
+    buf = b""
+    t_end = time.monotonic() + timeout_s
+    while time.monotonic() < t_end and not stop_when(buf):
+        chunk = r.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+    r.close()
+    return buf
+
+
+def _parse_sse(buf):
+    """Every complete frame must parse: id int, event name, data JSON —
+    the SSE analog of the torn-scrape gate."""
+    events = []
+    for frame in buf.decode().split("\n\n"):
+        if not frame.strip() or frame.startswith(":"):
+            continue                       # keep-alive comment
+        fields = {}
+        for line in frame.splitlines():
+            if line.startswith(":"):
+                continue
+            k, _, v = line.partition(": ")
+            fields.setdefault(k, v)
+        if "data" in fields and "event" in fields:
+            events.append((int(fields["id"]) if "id" in fields else None,
+                           fields["event"], json.loads(fields["data"])))
+    return events
+
+
+def test_sse_pushes_alert_transitions_and_keepalives():
+    srv = telemetry.start_server(0, host="127.0.0.1")
+    got = {}
+
+    def sub():
+        got["buf"] = _read_sse(
+            srv.port, lambda b: b"event: alert" in b,
+            query="?keepalive=0.1")
+    t = threading.Thread(target=sub, daemon=True)
+    t.start()
+    time.sleep(0.4)                        # let keep-alives accumulate
+    telemetry.publish_event("alert", {"rule": "x", "from": "pending",
+                                      "to": "firing"})
+    t.join(timeout=10)
+    buf = got["buf"]
+    assert buf.startswith(b"retry: 3000\n\n")      # reconnect delay
+    assert b": keep-alive\n\n" in buf              # idle-proxy defense
+    events = _parse_sse(buf)
+    assert ("alert", {"rule": "x", "from": "pending", "to": "firing"}) \
+        in [(e, d) for _, e, d in events]
+
+
+def test_sse_last_event_id_replay_and_reset():
+    srv = telemetry.start_server(0, host="127.0.0.1")
+    first = telemetry.publish_event("alert", {"n": 1})
+    telemetry.publish_event("alert", {"n": 2})
+    telemetry.publish_event("alert", {"n": 3})
+    # reconnect having seen event 1: exactly 2 and 3 replay, in order
+    buf = _read_sse(srv.port, lambda b: b.count(b"event: alert") >= 2,
+                    headers={"Last-Event-ID": str(first)})
+    events = _parse_sse(buf)
+    assert [d["n"] for _, e, d in events if e == "alert"] == [2, 3]
+    assert b"event: reset" not in buf
+    # push the replay ring (256) past eviction: resume point is gone
+    for i in range(300):
+        telemetry.publish_event("noise", {"i": i})
+    buf = _read_sse(srv.port, lambda b: b"event: reset" in b,
+                    headers={"Last-Event-ID": str(first)})
+    assert b"event: reset" in buf          # client told to resync
+
+
+def test_sse_frames_never_tear_under_concurrent_publishers():
+    """The torn-scrape hammer, SSE edition: four publisher threads
+    racing while a subscriber parses every received frame."""
+    srv = telemetry.start_server(0, host="127.0.0.1")
+    stop = threading.Event()
+
+    def pound(k):
+        i = 0
+        while not stop.is_set():
+            telemetry.publish_event("trace", {"publisher": k, "i": i})
+            i += 1
+            time.sleep(0.001)
+    publishers = [threading.Thread(target=pound, args=(k,), daemon=True)
+                  for k in range(4)]
+    for p in publishers:
+        p.start()
+    try:
+        buf = _read_sse(srv.port,
+                        lambda b: b.count(b"event: trace") >= 50)
+    finally:
+        stop.set()
+        for p in publishers:
+            p.join(timeout=5)
+    events = _parse_sse(buf)               # every frame parsed cleanly
+    ids = [i for i, e, _ in events if e == "trace"]
+    assert len(ids) >= 50
+    assert ids == sorted(ids)              # ordered, no duplicates
+    assert len(set(ids)) == len(ids)
+
+
+def test_sse_kept_traces_stream_to_events(monkeypatch):
+    """ROADMAP 5c residual: retained span trees announce themselves on
+    /events as they finish."""
+    monkeypatch.setenv("MXNET_TELEMETRY_TRACE_SAMPLE", "1")
+    srv = telemetry.start_server(0, host="127.0.0.1")
+    net, params = _mlp()
+    eng = _engine(net, params)
+    got = {}
+
+    def sub():
+        got["buf"] = _read_sse(srv.port,
+                               lambda b: b"event: trace" in b)
+    t = threading.Thread(target=sub, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    eng.predict(np.zeros((6,), np.float32), timeout=30)
+    t.join(timeout=10)
+    eng.close()
+    events = _parse_sse(got["buf"])
+    traces = [d for _, e, d in events if e == "trace"]
+    assert traces and traces[0]["trace_id"]
+    assert traces[0]["name"] == "serve.request"
+
+
+def test_sse_slow_consumer_closed_not_silently_lossy():
+    """A subscriber that stops draining gets a close sentinel (one
+    stale event traded for it) and is unsubscribed — publishers never
+    block and the client never keeps a silently-gappy stream."""
+    from mxnet_tpu.telemetry.server import _EventHub
+    hub = _EventHub(replay=8, sub_capacity=4)
+    q, _, _ = hub.subscribe()
+    assert hub.subscribers() == 1
+    for i in range(4):
+        hub.publish("e", {"i": i})         # queue now full
+    hub.publish("e", {"i": 4})             # overflow: close the consumer
+    assert hub.subscribers() == 0
+    drained = []
+    while not q.empty():
+        drained.append(q.get_nowait())
+    assert drained[-1] is None             # the close sentinel arrived
+
+
+def test_sse_subscribers_reclaimed_on_server_stop():
+    srv = telemetry.start_server(0, host="127.0.0.1")
+    hub = telemetry.event_hub()
+    t = threading.Thread(
+        target=lambda: _read_sse(srv.port, lambda b: False, timeout_s=30),
+        daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while hub.subscribers() == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert hub.subscribers() == 1
+    telemetry.stop_server()                # kicks the subscriber loop
+    t.join(timeout=10)
+    assert hub.subscribers() == 0
+
+
+# ---------------------------------------------------------------------------
+# reclaim on close(): the reload-loop leak gate, extended
+# ---------------------------------------------------------------------------
+
+def test_reload_loop_reclaims_rules_heartbeats_recorder(monkeypatch):
+    """Engine-reload loops must not grow the rule table, the heartbeat
+    poll, the recorder thread count, or the scrape — the PR 3/5 leak
+    gates extended over the whole observability plane."""
+    monkeypatch.setenv("MXNET_TELEMETRY_HISTORY_SECS", "0.2")
+    net, params = _mlp()
+    mgr = telemetry.default_manager()
+    for _ in range(3):
+        eng = _engine(net, params)
+        assert eng._owns_recorder
+        assert telemetry.get_recorder() is not None
+        assert len(mgr) == 4               # watchdog+retrace+2 shared burns
+        assert len(telemetry.heartbeats()) == 1
+        eng.close()
+        assert telemetry.get_recorder() is None
+        assert len(mgr) == 0
+        assert telemetry.heartbeats() == {}
+        assert not [t for t in threading.enumerate()
+                    if t.name == "mxnet-telemetry-recorder"]
+    # co-resident engines: shared burn rules refcount, last close wins
+    e1 = _engine(net, params)
+    e2 = _engine(net, params)
+    assert len(mgr) == 6                   # 2x(watchdog+retrace) + 2 shared
+    assert len(telemetry.heartbeats()) == 2
+    e1.close()
+    assert len(mgr) == 4                   # e2's rules + shared survive
+    assert telemetry.get_recorder() is not None
+    e2.close()
+    assert len(mgr) == 0 and telemetry.get_recorder() is None
+
+
+def test_operator_owned_recorder_survives_engine_close(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_HISTORY_SECS", "0.2")
+    rec = telemetry.start_recorder(interval_s=0.2)
+    net, params = _mlp()
+    eng = _engine(net, params)
+    assert not eng._owns_recorder          # operator owns it: hands off
+    eng.close()
+    assert telemetry.get_recorder() is rec
+    telemetry.stop_recorder()
+    assert telemetry.get_recorder() is None
+
+
+def test_stale_recorder_release_cannot_stop_newer_recorder(monkeypatch):
+    """Generation tokens: an engine whose recorder the operator
+    stopped/replaced mid-flight must not, at close(), stop the NEWER
+    recorder other engines still hold."""
+    monkeypatch.setenv("MXNET_TELEMETRY_HISTORY_SECS", "0.2")
+    net, params = _mlp()
+    e1 = _engine(net, params)
+    assert e1._owns_recorder
+    telemetry.stop_recorder()              # operator resets mid-flight
+    e2 = _engine(net, params)
+    rec2 = telemetry.get_recorder()
+    assert rec2 is not None and e2._owns_recorder
+    e1.close()                             # stale token: no-op
+    assert telemetry.get_recorder() is rec2
+    e2.close()
+    assert telemetry.get_recorder() is None
+
+
+def test_alerts_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_ALERTS", "0")
+    net, params = _mlp()
+    eng = _engine(net, params)
+    assert len(telemetry.default_manager()) == 0   # no rules registered
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# metric-name lint with the full plane active + scrape_ts satellites
+# ---------------------------------------------------------------------------
+
+def test_metric_name_lint_with_recorder_and_alerts_active(monkeypatch):
+    """The PR 5 lint gate re-asserted with recorder + alert series
+    live — including a FIRING rule so the transition counter and state
+    gauges exist on the endpoint."""
+    monkeypatch.setenv("MXNET_TELEMETRY_HISTORY_SECS", "0.05")
+    monkeypatch.setenv("MXNET_TELEMETRY_WATCHDOG_SECS", "1e-9")
+    srv = telemetry.start_server(0, host="127.0.0.1")
+    net, params = _mlp()
+    eng = _engine(net, params)
+    eng.predict(np.zeros((6,), np.float32), timeout=30)
+    hb = {"age_s": 99.0, "busy": True}
+    trec.register_heartbeat("test.lint", lambda: hb)
+    try:
+        telemetry.default_manager().add_rule(telemetry.AlertRule(
+            "lint_fire", "watchdog", heartbeat="test.lint",
+            threshold=1.0))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if telemetry.default_manager().firing():
+                break
+            time.sleep(0.02)
+        assert telemetry.default_manager().firing() >= 1
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/metrics" % srv.port,
+                timeout=10) as r:
+            text = r.read().decode()
+    finally:
+        trec.unregister_heartbeat("test.lint")
+        telemetry.default_manager().remove_rule("lint_fire")
+    assert "mxnet_telemetry_alerts_firing" in text
+    assert "mxnet_telemetry_alert_transitions_total" in text
+    assert telemetry.lint_metric_names(text) == []
+    eng.close()
+
+
+def test_healthz_and_rank_snapshots_stamp_scrape_ts(tmp_path):
+    """Bugfix satellite: /healthz and render_json carry wall-clock
+    scrape_ts + scrape_monotonic so multi-rank docs are orderable."""
+    srv = telemetry.start_server(0, host="127.0.0.1")
+    before = time.time()
+    hz = _get_json(srv.port, "/healthz")
+    after = time.time()
+    assert before <= hz["scrape_ts"] <= after
+    assert hz["scrape_monotonic"] > 0
+    doc = json.loads(telemetry.render_json())
+    assert before <= doc["scrape_ts"] <= time.time()
+    assert "scrape_monotonic" in doc
+
+
+def test_aggregate_warns_on_rank_scrape_skew(tmp_path, capsys):
+    telemetry_dump = _import_tool("telemetry_dump")
+    now = time.time()
+    for rank, ts in ((0, now), (1, now - 120.0)):
+        with open(str(tmp_path / ("telemetry_rank%d.json" % rank)),
+                  "w") as f:
+            json.dump({"format": "mxnet_tpu.telemetry/1",
+                       "scrape_ts": ts, "rank": rank,
+                       "metrics": {"mxnet_x_total": {
+                           "kind": "counter", "doc": "",
+                           "labelnames": [],
+                           "series": [{"labels": {}, "value": 1}]}}},
+                      f)
+    out_path = str(tmp_path / "agg.json")
+    rc = telemetry_dump.main(
+        ["aggregate", str(tmp_path / "telemetry_rank0.json"),
+         str(tmp_path / "telemetry_rank1.json"), "--out", out_path])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "WARNING" in err and "120" in err
+    assert "rank 1 oldest" in err
+    merged = json.load(open(out_path))
+    assert abs(merged["scrape_skew_s"] - 120.0) < 1.0
+    # within tolerance: silent
+    with open(str(tmp_path / "telemetry_rank1.json")) as f:
+        doc = json.load(f)
+    doc["scrape_ts"] = now - 1.0
+    with open(str(tmp_path / "telemetry_rank1.json"), "w") as f:
+        json.dump(doc, f)
+    rc = telemetry_dump.main(
+        ["aggregate", str(tmp_path / "telemetry_rank0.json"),
+         str(tmp_path / "telemetry_rank1.json")])
+    assert rc == 0
+    assert "WARNING" not in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# decode-plane satellites: TTFT / TPOT histograms + bench gate smoke
+# ---------------------------------------------------------------------------
+
+def _lstm_step(vocab=16, embed=8, hidden=16, seed=0):
+    sys.path.insert(0, os.path.dirname(__file__))
+    try:
+        from test_decode import _lstm_step as builder
+    finally:
+        sys.path.remove(os.path.dirname(__file__))
+    return builder(vocab, embed, hidden, seed)
+
+
+def test_decode_ttft_tpot_histograms_and_reclaim():
+    from mxnet_tpu.serving.decode import DecodeEngine
+    step, params, state_info = _lstm_step()
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                       max_len=32, default_deadline_ms=0)
+    eng.warmup()
+    label = eng._tm.engine_label
+    futs = [eng.submit([1, 2], max_new_tokens=4) for _ in range(3)]
+    for f in futs:
+        assert len(f.result(timeout=120).tokens) == 4
+    doc = telemetry.registry().collect()
+    for name in ("mxnet_serve_decode_ttft_seconds",
+                 "mxnet_serve_decode_tpot_seconds"):
+        series = doc[name]["series"]
+        mine = [s for s in series if s["labels"]["engine"] == label]
+        assert len(mine) == 1
+        # one observation per request (TTFT at first token, TPOT at
+        # finish), and TPOT only for >= 2-token generations
+        assert mine[0]["count"] == 3
+        assert mine[0]["sum"] > 0
+    # a 1-token generation gets a TTFT but NO TPOT (no gap to average)
+    assert len(eng.submit([3], max_new_tokens=1)
+               .result(timeout=120).tokens) == 1
+    doc = telemetry.registry().collect()
+    ttft = [s for s in doc["mxnet_serve_decode_ttft_seconds"]["series"]
+            if s["labels"]["engine"] == label][0]
+    tpot = [s for s in doc["mxnet_serve_decode_tpot_seconds"]["series"]
+            if s["labels"]["engine"] == label][0]
+    assert ttft["count"] == 4 and tpot["count"] == 3
+    eng.close()
+    doc = telemetry.registry().collect()
+    assert doc["mxnet_serve_decode_ttft_seconds"]["series"] == []
+    assert doc["mxnet_serve_decode_tpot_seconds"]["series"] == []
+
+
+def test_decode_bench_telemetry_gate_smoke():
+    """The --telemetry gate machinery end-to-end at smoke scale: token
+    accounting identical across modes, structural row contract (the
+    recorded acceptance run is BENCH_decode_telemetry.json)."""
+    perfdir = os.path.join(os.path.dirname(__file__), os.pardir, "perf")
+    sys.path.insert(0, perfdir)
+    try:
+        import decode_bench
+        row = decode_bench.run_telemetry_overhead(
+            requests=8, slots=4, max_len=32, mean_new=4, hidden=16,
+            repeats=1, http=True)
+    finally:
+        sys.path.remove(perfdir)
+    assert row["tps_telemetry_off"] > 0 and row["tps_telemetry_on"] > 0
+    assert row["metrics_scrapes"] > 0          # the hammer hammered
+    assert isinstance(row["ok"], bool)
+    assert "noise_floor" in row and "regression" in row

@@ -15,6 +15,10 @@ alias), which scrapes ``/metrics.json`` off a running
   python tools/telemetry_dump.py trace 1c96ce8a1ace4cf6 telemetry.json
   python tools/telemetry_dump.py top --url http://host:9100 --k 5
   python tools/telemetry_dump.py aggregate shared/telemetry_rank*.json
+  python tools/telemetry_dump.py alerts --url http://host:9100
+  python tools/telemetry_dump.py history --series mxnet_serve_requests_total \
+      --window 60 --url http://host:9100
+  python tools/telemetry_dump.py bundle /var/flight/flight_*.json
 
 ``snapshot`` prints one line per series with histogram count/mean/max
 bucket; ``trace`` prints the request's span tree with per-stage start
@@ -26,7 +30,20 @@ stragglers).  ``aggregate`` merges N rank-tagged snapshots into one
 document: every series gains a ``rank`` label, counters (and
 same-bucket histograms) get a summed ``rank="all"`` series, and gauges
 report per-rank spread (min/max/argmax) — a straggling worker is one
-command away.
+command away; snapshots whose wall-clock ``scrape_ts`` stamps disagree
+by more than 60 s draw a skew warning (one rank's document is stale —
+ordering or summing across them would lie).
+
+``alerts`` renders the SLO rule table (``GET /alerts`` live, or the
+``alerts`` section of a flight bundle): state, dwell, value, and the
+firing rules first.  ``history`` renders windowed series samples with
+the exact delta and per-second rate — live via ``GET /history``, or
+offline from the trailing-history window a flight bundle embeds.
+``bundle`` reads a black-box flight-recorder bundle
+(MXNET_FLIGHT_RECORDER_DIR, written atomically on alert firing /
+watchdog trip) and prints the post-mortem: the reason, firing rules,
+heartbeats naming the wedged worker, per-engine stats, history extent,
+and the all-thread stack dump.
 """
 import argparse
 import json
@@ -286,6 +303,202 @@ def format_gauge_spread(spread):
     return "\n".join(lines)
 
 
+# ---------------------------------------------------------------------------
+# alerts / history / flight bundles
+# ---------------------------------------------------------------------------
+
+def format_alerts(doc):
+    """Alert rule table, firing first (the /alerts ordering).  Flight
+    bundles embed only the state rows, so the header counts derive
+    from them when the endpoint's summary keys are absent."""
+    rows = doc.get("alerts", [])
+    firing = doc.get("firing")
+    if firing is None:
+        firing = sum(1 for r in rows if r.get("state") == "firing")
+    lines = ["%d rule(s), %d firing%s" % (
+        doc.get("rules", len(rows)), firing,
+        "" if doc.get("evaluating", True) else
+        "  [WARNING: no recorder evaluating — states are stale]")]
+    if not rows:
+        lines.append("(no alert rules registered)")
+        return "\n".join(lines)
+    lines.append("%-44s %-8s %10s %12s  %s"
+                 % ("rule", "state", "since_s", "value", "summary"))
+    for r in rows:
+        ann = r.get("annotations") or {}
+        summary = ann.get("summary", "")
+        if ann.get("engine") is not None:
+            summary = "[engine %s] %s" % (ann["engine"], summary)
+        lines.append("%-44s %-8s %10.1f %12s  %s"
+                     % (r["name"], r["state"], r.get("since_s", 0.0),
+                        _num(r.get("value")), summary))
+        if r.get("error"):
+            lines.append("    evaluation error: %s" % r["error"])
+    return "\n".join(lines)
+
+
+def _bucket_quantile(first, last, q):
+    """Windowed quantile from two exported histogram samples — the
+    bucket-count DELTA between them is a histogram of exactly the
+    in-window observations (HistoryRecorder.quantile's interpolation,
+    reproduced here so post-mortems need no mxnet_tpu import)."""
+    bounds = first.get("buckets") or []
+    if not bounds or bounds != last.get("buckets"):
+        return None
+    dcounts = [b - a for a, b in zip(first["counts"], last["counts"])]
+    total = sum(dcounts)
+    if total <= 0:
+        return None
+    q = min(1.0, max(0.0, float(q)))
+    target = q * total
+    acc = 0.0
+    for i, c in enumerate(dcounts):
+        acc += c
+        if acc >= target and c > 0:
+            if i >= len(bounds):
+                return float(bounds[-1])
+            lo = bounds[i - 1] if i > 0 else 0.0
+            return lo + (bounds[i] - lo) * (target - (acc - c)) / c
+    return float(bounds[-1])
+
+
+def _key_matches(key, series, want):
+    """Does one exported series key (``name`` or ``name{k=v,..}``)
+    match the queried family + label SUBSET?  Mirrors the live
+    endpoint's subset-match semantics (recorder._matches) so offline
+    post-mortems answer label-subset queries identically."""
+    name, _, rest = key.partition("{")
+    if name != series:
+        return False
+    have = {}
+    if rest:
+        for part in rest.rstrip("}").split(","):
+            k, _, v = part.partition("=")
+            have[k] = v
+    return all(have.get(k) == v for k, v in want.items())
+
+
+def _history_from_bundle(doc, series, labels_str, window_s, q=None):
+    """Re-derive a /history-shaped answer from the exported history a
+    flight bundle embeds (recorder.export): offline post-mortems get
+    the same delta/rate (and windowed-quantile) numbers the live
+    endpoint would serve — including subset-matched label sets SUMMED
+    per sample, exactly like HistoryRecorder.points()."""
+    hist = doc.get("history") or {}
+    samples = hist.get("samples") or []
+    want = {}
+    if labels_str:
+        want = {k.strip(): v.strip() for k, v in
+                (p.split("=", 1) for p in labels_str.split(","))}
+    pts, hpts = [], []
+    for s in samples:
+        vals = [v for k, v in (s.get("scalars") or {}).items()
+                if _key_matches(k, series, want)]
+        if not vals:
+            hs = [h for k, h in (s.get("hists") or {}).items()
+                  if _key_matches(k, series, want)]
+            if hs:
+                agg = dict(hs[0])
+                for h in hs[1:]:
+                    if h.get("buckets") == agg.get("buckets"):
+                        agg["counts"] = [a + b for a, b in
+                                         zip(agg["counts"], h["counts"])]
+                        agg["count"] += h["count"]
+                        agg["sum"] += h["sum"]
+                vals = [agg["count"]]
+                hpts.append((s["t"], agg))
+        if vals:
+            pts.append((s["t"], sum(vals)))
+    if window_s is not None and pts:
+        lo = pts[-1][0] - window_s
+        pts = [p for p in pts if p[0] >= lo]
+        hpts = [p for p in hpts if p[0] >= lo]
+    delta = pts[-1][1] - pts[0][1] if len(pts) >= 2 else None
+    dt = pts[-1][0] - pts[0][0] if len(pts) >= 2 else 0.0
+    out = {"series": series, "kind": (hist.get("kinds") or {}).get(series),
+           "labels": labels_str or None, "window_s": window_s,
+           "interval_s": hist.get("interval_s"),
+           "samples": [[t, v] for t, v in pts], "delta": delta,
+           "rate_per_s": delta / dt if delta is not None and dt > 0
+           else None}
+    if q is not None and len(hpts) >= 2:
+        out["quantile"] = {"q": float(q), "value": _bucket_quantile(
+            hpts[0][1], hpts[-1][1], q)}
+    return out
+
+
+def format_history(doc):
+    lines = ["%s (%s)%s  interval=%ss" % (
+        doc.get("series"), doc.get("kind") or "?",
+        "{%s}" % doc["labels"] if doc.get("labels") else "",
+        _num(doc.get("interval_s")))]
+    pts = doc.get("samples") or []
+    if not pts:
+        lines.append("(no samples in window — is the recorder running "
+                     "and the series live?)")
+        return "\n".join(lines)
+    t0 = pts[0][0]
+    for t, v in pts:
+        lines.append("  t+%8.3fs  %s" % (t - t0, _num(v)))
+    lines.append("delta=%s  rate=%s/s over %.3fs (%d samples)"
+                 % (_num(doc.get("delta")), _num(doc.get("rate_per_s")),
+                    pts[-1][0] - t0, len(pts)))
+    if doc.get("quantile"):
+        lines.append("windowed q%g = %s"
+                     % (doc["quantile"]["q"],
+                        _num(doc["quantile"].get("value"))))
+    return "\n".join(lines)
+
+
+def format_bundle(doc, stacks=True):
+    """Render one flight-recorder bundle as a post-mortem narrative."""
+    lines = ["flight bundle: %s" % doc.get("reason"),
+             "  pid %s, wall time %s" % (
+                 doc.get("pid"),
+                 doc.get("wall_time") and
+                 __import__("datetime").datetime.fromtimestamp(
+                     doc["wall_time"]).isoformat())]
+    firing = [a for a in doc.get("alerts", [])
+              if a.get("state") == "firing"]
+    lines.append("firing rules (%d):" % len(firing))
+    for a in firing:
+        ann = a.get("annotations") or {}
+        lines.append("  %-44s value=%s%s"
+                     % (a["name"], _num(a.get("value")),
+                        "  engine=%s" % ann["engine"]
+                        if ann.get("engine") is not None else ""))
+    hbs = doc.get("heartbeats") or {}
+    if hbs:
+        lines.append("heartbeats:")
+        for name, hb in sorted(hbs.items()):
+            lines.append(
+                "  %-20s age=%7.3fs busy=%-5s queued=%s"
+                % (name, hb.get("age_s", 0.0), hb.get("busy"),
+                   hb.get("queued", "-")))
+    engines = doc.get("engines") or {}
+    for name, st in sorted(engines.items()):
+        lines.append("engine %s: queue_depth=%s admitted=%s "
+                     "requests_served=%s"
+                     % (name, st.get("queue_depth"), st.get("admitted"),
+                        st.get("requests_served",
+                               st.get("decode", {}).get(
+                                   "requests_served", "-"))))
+    hist = doc.get("history") or {}
+    samples = hist.get("samples") or []
+    if samples:
+        lines.append("history window: %d samples over %.1fs "
+                     "(interval %ss)"
+                     % (len(samples),
+                        samples[-1]["t"] - samples[0]["t"],
+                        _num(hist.get("interval_s"))))
+    lines.append("retained traces: %d" % len(doc.get("traces") or {}))
+    if stacks and doc.get("thread_stacks"):
+        lines.append("thread stacks:")
+        lines.extend("  " + l for l in
+                     doc["thread_stacks"].splitlines())
+    return "\n".join(lines)
+
+
 def _resolve_source(args, what="snapshot file"):
     src = getattr(args, "url", None) or getattr(args, "file", None)
     if not src:
@@ -326,7 +539,92 @@ def main(argv=None):
     p_agg.add_argument("--json", action="store_true", dest="as_json",
                        help="print the merged document instead of text")
     p_agg.add_argument("--out", help="also write the merged document here")
+    p_agg.add_argument("--max-skew", type=float, default=60.0,
+                       help="warn when rank snapshots' wall-clock "
+                            "scrape_ts stamps spread wider than this "
+                            "many seconds (default 60)")
+    p_al = sub.add_parser(
+        "alerts", help="render the SLO alert rule table (live /alerts "
+                       "endpoint or a flight bundle)")
+    _add_source(p_al)
+    p_hist = sub.add_parser(
+        "history", help="windowed time-series samples + exact delta/"
+                        "rate (live /history endpoint or a flight "
+                        "bundle's embedded history)")
+    p_hist.add_argument("--series", required=True,
+                        help="metric family name")
+    p_hist.add_argument("--labels",
+                        help="label filter k=v[,k=v...] (subset match)")
+    p_hist.add_argument("--window", type=float,
+                        help="trailing window in seconds "
+                             "(default: the whole ring)")
+    p_hist.add_argument("--q", type=float,
+                        help="windowed quantile for histogram series")
+    _add_source(p_hist)
+    p_bun = sub.add_parser(
+        "bundle", help="read a black-box flight-recorder bundle "
+                       "(post-mortem narrative)")
+    p_bun.add_argument("file", help="flight_*.json bundle path")
+    p_bun.add_argument("--no-stacks", action="store_true",
+                       help="omit the all-thread stack dump")
     args = ap.parse_args(argv)
+
+    if args.cmd == "alerts":
+        src = _resolve_source(args, "bundle/snapshot file")
+        if src is None:
+            return 2
+        if src.startswith("http://") or src.startswith("https://"):
+            from urllib.parse import urlparse
+            if urlparse(src).path in ("", "/"):
+                src = src.rstrip("/") + "/alerts"
+        doc = load_doc(src)
+        if "text" in doc:
+            try:
+                doc = json.loads(doc["text"])
+            except ValueError:
+                print("alerts needs a JSON source", file=sys.stderr)
+                return 2
+        if "alerts" not in doc and "alerts" in doc.get("metrics", {}):
+            doc = doc["metrics"]     # load_doc normalized a bare /alerts doc
+        print(format_alerts(doc))
+        return 0
+
+    if args.cmd == "history":
+        src = _resolve_source(args, "bundle file")
+        if src is None:
+            return 2
+        if src.startswith("http://") or src.startswith("https://"):
+            from urllib.parse import urlparse, urlencode
+            if urlparse(src).path in ("", "/"):
+                q = {"series": args.series}
+                if args.labels:
+                    q["labels"] = args.labels
+                if args.window is not None:
+                    q["window"] = args.window
+                if args.q is not None:
+                    q["q"] = args.q
+                src = src.rstrip("/") + "/history?" + urlencode(q)
+            doc = load_doc(src)
+            if "series" not in doc and "series" in doc.get("metrics", {}):
+                doc = doc["metrics"]   # load_doc normalized a /history doc
+        else:
+            doc = _history_from_bundle(load_doc(src), args.series,
+                                       args.labels, args.window,
+                                       q=args.q)
+        if doc.get("error"):
+            print("history: %s" % doc["error"], file=sys.stderr)
+            return 1
+        print(format_history(doc))
+        return 0
+
+    if args.cmd == "bundle":
+        doc = load_doc(args.file)
+        if doc.get("format") != "mxnet_tpu.telemetry/flight-1":
+            print("%r is not a flight-recorder bundle (format=%r)"
+                  % (args.file, doc.get("format")), file=sys.stderr)
+            return 2
+        print(format_bundle(doc, stacks=not args.no_stacks))
+        return 0
 
     if args.cmd == "aggregate":
         used, entries = set(), []
@@ -340,6 +638,25 @@ def main(argv=None):
                 return 2
             entries.append((_doc_rank(doc, src, i, used), doc))
         merged = aggregate_docs(entries)
+        # rank snapshots are only comparable when they describe roughly
+        # the same moment: the wall-clock scrape_ts stamps (written by
+        # every render_json since the scrape-ordering fix) expose a
+        # straggling writer — a stale rank merged silently would turn
+        # the spread views into fiction
+        stamps = {r: doc.get("scrape_ts") for r, doc in entries
+                  if doc.get("scrape_ts") is not None}
+        if len(stamps) >= 2:
+            lo_r = min(stamps, key=stamps.get)
+            hi_r = max(stamps, key=stamps.get)
+            skew = stamps[hi_r] - stamps[lo_r]
+            merged["scrape_skew_s"] = round(skew, 3)
+            if skew > args.max_skew:
+                print("WARNING: rank snapshots are %.1fs apart "
+                      "(rank %s oldest, rank %s newest; --max-skew "
+                      "%.0fs) — a rank's snapshotter is stale or dead, "
+                      "aggregated values mix different moments"
+                      % (skew, lo_r, hi_r, args.max_skew),
+                      file=sys.stderr)
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(merged, f, indent=1, sort_keys=True)
