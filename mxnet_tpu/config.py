@@ -118,6 +118,19 @@ VARIABLES = {v.name: v for v in [
          "client (backpressure); 'shed-oldest' evicts the longest-"
          "queued request (its future fails with ServerOverloadError) "
          "to admit the new one — graceful degradation under overload."),
+    _Var("MXNET_SERVE_REPLICAS", int, 1,
+         "Data-parallel device replicas per serving engine "
+         "(serving/replica.py, ROADMAP 2a): both ServingEngine and "
+         "DecodeEngine own this many device replicas — each with its "
+         "own compiled-program cache and device-resident params — and "
+         "route work to the least-loaded one (one-shot: emptiest "
+         "in-flight queue; decode: most free slots, requests pinned to "
+         "their seated replica).  Needs that many addressable devices "
+         "(XLA_FLAGS=--xla_force_host_platform_device_count=N gives a "
+         "CPU host N); when the env asks for more replicas than "
+         "devices exist the engine clamps with a warning.  1 = the "
+         "single-device fast path, byte-for-byte the pre-replica "
+         "engine."),
     _Var("MXNET_SERVE_SEQ_BUCKETS", str, "",
          "Comma-separated sequence-length buckets (e.g. '32,64,128') "
          "for the serving engine.  When set, per-example axis 0 is "
@@ -247,6 +260,16 @@ VARIABLES = {v.name: v for v in [
          "and retrace-storm) and remove them at close(); rule states "
          "serve at GET /alerts, transitions stream over GET /events.  "
          "0 = rules are neither registered nor evaluated."),
+    _Var("MXNET_TELEMETRY_ALERT_RULES", str, "",
+         "Path to a declarative SLO alert-rules file: a JSON list (or "
+         "{'rules': [...]} document) of AlertRule.from_dict dicts "
+         "loaded into the default AlertManager when the history "
+         "recorder starts (telemetry/alerts.py load_rules_file) — "
+         "operators add burn-rate/threshold/absence/watchdog rules "
+         "without redeploying.  Rules whose names are already "
+         "registered are skipped (idempotent across engine-driven "
+         "recorder rebuilds); a malformed file warns and loads "
+         "nothing.  Empty = off."),
     _Var("MXNET_TELEMETRY_WATCHDOG_SECS", float, 30.0,
          "Zero-progress threshold for the engines' default watchdog "
          "alert rules: a worker heartbeat that is BUSY (work queued or "

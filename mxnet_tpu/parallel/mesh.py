@@ -12,7 +12,8 @@ import re
 
 from ..base import MXNetError
 
-__all__ = ["make_mesh", "ShardingPlan", "data_parallel_plan"]
+__all__ = ["make_mesh", "ShardingPlan", "data_parallel_plan",
+           "data_parallel_devices"]
 
 _AXIS_ORDER = ("dp", "pp", "tp", "sp", "ep")
 
@@ -118,3 +119,30 @@ def data_parallel_plan(mesh=None, devices=None):
     if mesh is None:
         mesh = make_mesh({"dp": -1}, devices)
     return ShardingPlan(mesh, batch_axis="dp")
+
+
+def data_parallel_devices(n=None, devices=None):
+    """The first ``n`` devices along a pure-dp mesh's data-parallel axis.
+
+    Serving replica routing (serving/replica.py) is data parallelism
+    applied to *served* traffic: each replica owns one dp-axis device
+    outright instead of sharding one batch across them, so the device
+    ORDER must be the same one a ``{"dp": n}`` mesh would use — a
+    serving tier and a training job co-scheduled on the same slice then
+    agree on which chip is dp rank i.  ``n=None`` takes every device;
+    asking for more devices than exist raises (the caller decides
+    whether to clamp)."""
+    import jax
+    devices = list(devices if devices is not None else jax.devices())
+    if n is None:
+        n = len(devices)
+    n = int(n)
+    if n < 1:
+        raise MXNetError("data_parallel_devices: need n >= 1, got %d" % n)
+    if n > len(devices):
+        raise MXNetError(
+            "data_parallel_devices: %d devices requested but only %d "
+            "present (XLA_FLAGS=--xla_force_host_platform_device_count=N "
+            "forces a CPU host to expose N)" % (n, len(devices)))
+    mesh = make_mesh({"dp": len(devices)}, devices)
+    return [d for d in mesh.devices.reshape(-1)][:n]

@@ -1,7 +1,7 @@
 """mxnet_tpu.serving — dynamic-batching inference runtime.
 
 A new layer on top of the executor stack (no reference analog: the
-reference stops at the single-client C predict API).  Four parts:
+reference stops at the single-client C predict API).  Five parts:
 
 - :mod:`.engine`    — request queue + dynamic batcher + worker thread
   (one-shot graphs: coalesce, pad, dispatch once, unpad);
@@ -11,7 +11,10 @@ reference stops at the single-client C predict API).  Four parts:
   zero retraces;
 - :mod:`.buckets`   — shape-bucket policy and the compile-once program
   cache (CachedOp-backed, with a compile counter);
-- :mod:`.admission` — bounded queue, deadlines, overload shedding.
+- :mod:`.admission` — bounded queue, deadlines, overload shedding;
+- :mod:`.replica`   — data-parallel device replicas for both engines:
+  least-loaded routing, decode pinning, replica failover
+  (``MXNET_SERVE_REPLICAS``).
 
 Quick start::
 
@@ -26,14 +29,18 @@ from .admission import (AdmissionController, Request, QueueFullError,
                         DeadlineExceededError, ServerOverloadError,
                         EngineClosedError)
 from .buckets import BucketPolicy, ProgramCache, pad_valid_lengths
+from .replica import (ServeReplica, DecodeReplica, replica_contexts)
 from .engine import ServingEngine
 from .decode import (DecodeEngine, DecodeResult, StepProgram,
-                     greedy_decode)
+                     greedy_decode, Sampler, GreedySampler,
+                     TemperatureSampler)
 
 __all__ = ["ServingEngine", "BucketPolicy", "ProgramCache",
            "pad_valid_lengths",
            "DecodeEngine", "DecodeResult", "StepProgram",
            "greedy_decode",
+           "Sampler", "GreedySampler", "TemperatureSampler",
+           "ServeReplica", "DecodeReplica", "replica_contexts",
            "AdmissionController", "Request", "QueueFullError",
            "DeadlineExceededError", "ServerOverloadError",
            "EngineClosedError"]

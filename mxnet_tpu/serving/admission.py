@@ -335,6 +335,23 @@ class AdmissionController(object):
             failures = self._sweep_locked()
         self._deliver(failures)
 
+    def expire_request(self, req, detail=""):
+        """Deliver deadline expiry to a request already POPPED from
+        this queue (the replica router's routed-but-unseated window):
+        the same partial-result contract (``on_expire``), trace abort,
+        and counter accounting as the queued sweep, so stats() and the
+        scraped expiry series stay one number however a deadline was
+        hit."""
+        exc = DeadlineExceededError(
+            "deadline exceeded after %.1f ms%s"
+            % ((time.monotonic() - req.t_enqueue) * 1e3,
+               " (%s)" % detail if detail else ""))
+        with self._cond:
+            self.expired += 1
+            if self._telemetry is not None:
+                self._telemetry.expired.inc()
+        self._deliver([(req, exc)])
+
     # ------------------------------------------------------------ lifecycle
     def close(self, drain=True):
         """Stop admitting.  With ``drain`` the worker keeps taking until

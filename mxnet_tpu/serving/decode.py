@@ -77,8 +77,87 @@ from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
 from .buckets import ProgramCache, _next_pow2
 from .engine import _ENGINE_SEQ, _percentile
+from .replica import DecodeReplica, replica_contexts
 
-__all__ = ["DecodeEngine", "DecodeResult", "StepProgram", "greedy_decode"]
+__all__ = ["DecodeEngine", "DecodeResult", "StepProgram", "greedy_decode",
+           "Sampler", "GreedySampler", "TemperatureSampler"]
+
+
+class Sampler(object):
+    """Pluggable token-selection head for the decode step (ROADMAP 1a).
+
+    The step program's contract is ``[logits] + next_states``; a
+    Sampler decides how the per-slot logits row becomes the sampled
+    token id.  ``greedy=True`` samplers keep the original in-graph
+    ``argmax`` head — bitwise-pinned against ``greedy_decode`` and the
+    batch-1 reference, zero behavior change.  Stochastic samplers run
+    inside the SAME compiled step kernel using the rng key the step
+    already carried dead: the kernel folds a per-step tick into the
+    engine's base key, so join/leave churn never retraces and a fixed
+    ``seed`` replays bitwise.
+
+    Note the reproducibility boundary: greedy output is independent of
+    slot-pool company (the row-local contract); a stochastic sampler's
+    draws additionally depend on WHICH step ticks and slot a request
+    rode through, so they replay only against the same engine history.
+    """
+    greedy = False
+
+    def sample(self, key, logits):
+        """jax-land: (slots, vocab) logits + folded PRNG key -> (slots,)
+        sampled ids (cast back to the logits dtype — the token vector
+        rides the same float pipeline the argmax head fed)."""
+        raise NotImplementedError
+
+    def describe(self):
+        return {"kind": type(self).__name__}
+
+
+class GreedySampler(Sampler):
+    """The default argmax head — spliced into the step GRAPH itself
+    (exactly the pre-sampler engine), so greedy decode stays bitwise-
+    identical to ``greedy_decode`` and compiles the identical program."""
+    greedy = True
+
+    def describe(self):
+        return {"kind": "greedy"}
+
+
+class TemperatureSampler(Sampler):
+    """Temperature (optionally top-k-truncated) categorical sampling.
+
+    ``logits / temperature`` -> optional top-k mask (everything below
+    the k-th logit pinned to -inf) -> one Gumbel-max categorical draw
+    per slot (``jax.random.categorical``).  ``top_k=1`` degenerates to
+    argmax whatever the key — the cheap sanity anchor tests pin.
+    ``seed`` fixes the engine's base key for reproducible replays;
+    None draws it from the process rng stream.
+    """
+
+    def __init__(self, temperature=1.0, top_k=None, seed=None):
+        if temperature <= 0:
+            raise MXNetError("TemperatureSampler: temperature must be "
+                             "> 0, got %r (top_k=1 IS argmax)"
+                             % (temperature,))
+        if top_k is not None and int(top_k) < 1:
+            raise MXNetError("TemperatureSampler: top_k must be >= 1")
+        self.temperature = float(temperature)
+        self.top_k = None if top_k is None else int(top_k)
+        self.seed = seed
+
+    def sample(self, key, logits):
+        import jax
+        import jax.numpy as jnp
+        z = logits / self.temperature
+        if self.top_k is not None and self.top_k < z.shape[-1]:
+            kth = jax.lax.top_k(z, self.top_k)[0][..., -1:]
+            z = jnp.where(z < kth, -jnp.inf, z)
+        return jax.random.categorical(key, z, axis=-1) \
+                  .astype(logits.dtype)
+
+    def describe(self):
+        return {"kind": "temperature", "temperature": self.temperature,
+                "top_k": self.top_k, "seed": self.seed}
 
 
 class DecodeResult(object):
@@ -88,8 +167,11 @@ class DecodeResult(object):
     ``finish_reason`` is one of ``"eos"`` (the eos id was sampled),
     ``"length"`` (max_new_tokens or the slot's max_len capacity),
     ``"deadline"`` (the request's deadline passed mid-flight — tokens
-    holds the PARTIAL generation), or ``"closed"`` (engine shut down
-    without drain).  ``expired`` mirrors the deadline case.
+    holds the PARTIAL generation), ``"closed"`` (engine shut down
+    without drain), or ``"error"`` (the request's device replica
+    failed mid-generation and was retired — tokens holds the PARTIAL
+    generation; co-resident replicas keep serving).  ``expired``
+    mirrors the deadline case.
     """
     __slots__ = ("tokens", "finish_reason", "n_steps", "prompt_len")
 
@@ -152,7 +234,8 @@ class StepProgram(object):
 
     def __init__(self, step_sym, arg_params, aux_params, state_info,
                  num_slots, token_name="token", pos_name="pos",
-                 valid_name="valid", ctx=None, dtype=np.float32):
+                 valid_name="valid", ctx=None, dtype=np.float32,
+                 sampler=None):
         import jax
         import jax.numpy as jnp
         from ..context import cpu
@@ -161,6 +244,7 @@ class StepProgram(object):
         self._ctx = ctx or cpu()
         self.num_slots = int(num_slots)
         self._dtype = np.dtype(dtype)
+        self.sampler = sampler if sampler is not None else GreedySampler()
         self.state_info = [dict(s) for s in state_info]
         self.state_names = [s["name"] for s in self.state_info]
         self.token_name = token_name
@@ -169,11 +253,18 @@ class StepProgram(object):
                 "decode step graph has %d outputs; expected 1 (logits) "
                 "+ %d next-state outputs (state_info order)"
                 % (len(step_sym), len(self.state_names)))
-        sampled = sym.argmax(step_sym[0], axis=1,
-                             name="__decode_sample__")
+        if self.sampler.greedy:
+            # greedy keeps the in-graph argmax head: bitwise-pinned
+            # against greedy_decode, identical compiled program
+            head = sym.argmax(step_sym[0], axis=1,
+                              name="__decode_sample__")
+        else:
+            # stochastic samplers take the raw logits into the kernel
+            # and sample there with the (formerly dead) rng key
+            head = step_sym[0]
         self._serve_sym = sym.Group(
-            [sampled] + [step_sym[i]
-                         for i in range(1, len(step_sym))])
+            [head] + [step_sym[i]
+                      for i in range(1, len(step_sym))])
         arg_names = self._serve_sym.list_arguments()
         aux_names = self._serve_sym.list_auxiliary_states()
         if token_name not in arg_names:
@@ -211,8 +302,9 @@ class StepProgram(object):
         self._trace_count = 0
         na = len(arg_names)
         state_pos = tuple(order.index(n) for n in self.state_names)
+        _sampler = self.sampler
 
-        def call(key, reset, *flat):
+        def call(key, tick, reset, *flat):
             self._trace_count += 1      # runs once per XLA trace
             _count_xla_trace()
             # a joining slot's state is zeroed HERE, fused into the
@@ -227,17 +319,32 @@ class StepProgram(object):
                 r = reset.reshape((-1,) + (1,) * (s.ndim - 1))
                 flat[i] = jnp.where(r > 0, jnp.zeros((), s.dtype), s)
             outs, _ = gf(flat[:na], flat[na:], key, False)
+            if not _sampler.greedy:
+                # fold the per-step tick into the (formerly dead) key
+                # INSIDE the jit: tick is a traced scalar, so churning
+                # values never retrace, and the sampler's draws are a
+                # pure function of (base key, tick, logits)
+                k = jax.random.fold_in(key, tick)
+                outs = [_sampler.sample(k, outs[0])] + list(outs[1:])
             return outs
 
         donate = ()
         if jax.default_backend() != "cpu":
             # in-place HBM update of the slot pool: the old state
             # buffers are donated to the dispatch (CPU jax cannot
-            # honor donation and would warn per compile)
-            donate = tuple(2 + order.index(n) for n in self.state_names)
+            # honor donation and would warn per compile).  Offsets
+            # skip the (key, tick, reset) leading args.
+            donate = tuple(3 + order.index(n) for n in self.state_names)
         self._kernel = jax.jit(call, donate_argnums=donate)
-        from .. import random as _random
-        self._key = _random.next_key()     # dead input: deterministic
+        self._tick = 0          # per-step sample counter (stochastic
+        #                         samplers fold it into the key; dead
+        #                         and DCE'd under the greedy head)
+        seed = getattr(self.sampler, "seed", None)
+        if seed is not None:
+            self._key = jax.random.PRNGKey(int(seed))
+        else:
+            from .. import random as _random
+            self._key = _random.next_key()  # greedy: dead input
 
         def set_row(buf, idx, row):
             self._trace_count += 1
@@ -254,12 +361,19 @@ class StepProgram(object):
         return self._trace_count
 
     def init_states(self):
-        """Fresh all-zero slot-pool state buffers (device)."""
+        """Fresh all-zero slot-pool state buffers, committed to this
+        program's device — with replica routing the pool must live on
+        ITS replica's device from the first step (an uncommitted buffer
+        would land on the default device and make the step a cross-
+        device computation)."""
+        import jax
+        dev = self._ctx.jax_device()
         out = {}
         for info in self.state_info:
             dt = np.dtype(info.get("dtype") or self._dtype)
-            out[info["name"]] = self._jnp.zeros(
-                (self.num_slots,) + tuple(info["shape"]), dtype=dt)
+            out[info["name"]] = jax.device_put(
+                self._jnp.zeros((self.num_slots,) + tuple(info["shape"]),
+                                dtype=dt), dev)
         return out
 
     def write_row(self, states, slot, rows):
@@ -302,10 +416,27 @@ class StepProgram(object):
             flat[self._feed_pos[self.valid_name]] = valid
         for name in self.state_names:
             flat[self._feed_pos[name]] = states[name]
-        outs = self._kernel(self._key, reset, *flat)
+        self._tick = (self._tick + 1) & 0x7fffffff
+        outs = self._kernel(self._key, np.int32(self._tick), reset,
+                            *flat)
         new_states = {name: outs[1 + i]
                       for i, name in enumerate(self.state_names)}
         return np.asarray(outs[0]), new_states
+
+    def sample_tokens(self, logits):
+        """Host-side sampling of a ``(rows, vocab)`` logits array with
+        this program's sampler — the bucketed-prefill path's first
+        token (the prefill program returns raw logits for non-greedy
+        samplers; each call burns one tick so prefill draws never
+        collide with step draws)."""
+        logits = np.asarray(logits)
+        if self.sampler.greedy:
+            return np.argmax(logits, axis=-1).astype(np.float32)
+        import jax
+        self._tick = (self._tick + 1) & 0x7fffffff
+        k = jax.random.fold_in(self._key, np.int32(self._tick))
+        return np.asarray(self.sampler.sample(k, self._jnp.asarray(
+            logits, dtype=self._jnp.float32)))
 
 
 def greedy_decode(program, prompt, max_new_tokens, eos_id=None,
@@ -406,7 +537,9 @@ class _DecodeTelemetry(object):
         self.step_ms = reg.histogram(
             "mxnet_serve_decode_step_ms",
             "wall time of one decode iteration (deadline sweep + step "
-            "dispatch + host bookkeeping)",
+            "dispatch + host bookkeeping), per engine and device "
+            "replica",
+            labelnames=("engine", "replica"),
             buckets=_telemetry.LATENCY_MS_BUCKETS)
         # per-request tail latency the tokens/s counter cannot see
         # (the 2603.09555 O(1)-per-token framing is throughput-only):
@@ -429,26 +562,41 @@ class _DecodeTelemetry(object):
             labelnames=("engine",),
             buckets=_telemetry.LATENCY_S_BUCKETS)
         self.tpot = tpot_fam.labels(engine=self.engine_label)
-        slots_fam = reg.gauge(
+        self.slots_fam = reg.gauge(
             "mxnet_serve_decode_slots",
-            "slot-pool capacity per decode engine",
-            labelnames=("engine",))
-        self.slots = slots_fam.labels(engine=self.engine_label)
-        occupied_fam = reg.gauge(
+            "slot-pool capacity per decode engine and device replica",
+            labelnames=("engine", "replica"))
+        self.occupied_fam = reg.gauge(
             "mxnet_serve_decode_slots_occupied",
-            "slots currently generating per decode engine — "
-            "occupied/capacity is decode's batch-occupancy analog",
-            labelnames=("engine",))
-        self.occupied = occupied_fam.labels(engine=self.engine_label)
+            "slots currently generating per decode engine and device "
+            "replica — occupied/capacity is decode's batch-occupancy "
+            "analog, and the router's most-free-slots signal",
+            labelnames=("engine", "replica"))
         compile_fam = reg.gauge(
             "mxnet_serve_compile_count",
             "CachedOp trace counter — programs compiled so far, per "
             "engine", labelnames=("engine",))
         self.compile_count = compile_fam.labels(
             engine=self.engine_label)
-        self._engine_gauge_fams = (queue_depth_fam, slots_fam,
-                                   occupied_fam, compile_fam,
-                                   ttft_fam, tpot_fam)
+        # replica plane: families defined ONCE in replica.py, shared
+        # with the one-shot engine (engine ordinals are process-unique)
+        # so /healthz renders one per-replica block over both kinds
+        from .replica import replica_metric_families
+        (replicas_fam, self.replica_healthy, self.replica_inflight,
+         self.replica_failures) = replica_metric_families(reg)
+        self.replicas_g = replicas_fam.labels(engine=self.engine_label)
+        self.replicas_g.set(len(engine._replicas))
+        for r in engine._replicas:
+            r.tm_step_ms = self.step_ms.labels(
+                engine=self.engine_label, replica=r.label)
+            r.tm_failures = self.replica_failures.labels(
+                engine=self.engine_label, replica=r.label)
+        self._engine_gauge_fams = (queue_depth_fam, compile_fam,
+                                   ttft_fam, tpot_fam, replicas_fam)
+        self._replica_fams = (self.slots_fam, self.occupied_fam,
+                              self.step_ms, self.replica_healthy,
+                              self.replica_inflight,
+                              self.replica_failures)
         self._engine = weakref.ref(engine)
         reg.register_callback(self._refresh)
 
@@ -465,6 +613,10 @@ class _DecodeTelemetry(object):
     def _remove_engine_series(self):
         for fam in self._engine_gauge_fams:
             fam.remove(engine=self.engine_label)
+        for fam in self._replica_fams:
+            for values, _inst in fam.series():
+                if values[0] == self.engine_label:
+                    fam.remove(*values)
 
     def _refresh(self, reg):
         eng = self._engine()
@@ -472,9 +624,18 @@ class _DecodeTelemetry(object):
             reg.unregister_callback(self._refresh)
             self._remove_engine_series()
             return
-        self.slots.set(eng.num_slots)
-        self.occupied.set(eng._occupied_count())
         self.compile_count.set(eng.compile_count)
+        el = self.engine_label
+        for r in eng._replicas:
+            self.slots_fam.labels(engine=el,
+                                  replica=r.label).set(eng.num_slots)
+            self.occupied_fam.labels(
+                engine=el, replica=r.label).set(r.occupied_count())
+            self.replica_healthy.labels(
+                engine=el, replica=r.label).set(1.0 if r.healthy
+                                                else 0.0)
+            self.replica_inflight.labels(
+                engine=el, replica=r.label).set(r.inflight())
 
 
 class DecodeEngine(object):
@@ -503,6 +664,15 @@ class DecodeEngine(object):
         bucket); its state rows are scattered into the free slot.
         Without it, prompts are teacher-forced token-by-token through
         the running step batch (no extra programs).
+    sampler : :class:`Sampler` hook for the token-selection head
+        (default :class:`GreedySampler` — bitwise-pinned argmax).
+        :class:`TemperatureSampler` runs temperature/top-k categorical
+        draws inside the same compiled step using the rng key the
+        step already carried dead.
+    replicas : data-parallel device replicas (default
+        ``MXNET_SERVE_REPLICAS``), each a full slot pool; requests land
+        on the freest replica and pin there.  ``ctx`` may be a LIST of
+        contexts naming the replica set verbatim.
     """
 
     def __init__(self, step_sym, arg_params, aux_params, state_info,
@@ -512,7 +682,7 @@ class DecodeEngine(object):
                  prefill_len_name="plen",
                  max_queue=None, default_deadline_ms=None,
                  overload_policy=None, ctx=None, dtype=np.float32,
-                 start=True):
+                 start=True, sampler=None, replicas=None):
         from .. import config
         if num_slots is None:
             num_slots = config.get("MXNET_DECODE_SLOTS")
@@ -532,66 +702,80 @@ class DecodeEngine(object):
         self.eos_id = eos_id
         self._dtype = np.dtype(dtype)
         self._default_deadline_s = float(default_deadline_ms) / 1e3
+        self._sampler = sampler if sampler is not None else GreedySampler()
         self.analysis_report = None
         self.step_verdict = None
         if config.get("MXNET_ANALYSIS_ON"):
             self._preflight(step_sym, state_info, token_name, pos_name,
                             valid_name, config.get("MXNET_ANALYSIS_STRICT"))
-        self._program = StepProgram(step_sym, arg_params, aux_params,
-                                    state_info, self.num_slots,
-                                    token_name=token_name,
-                                    pos_name=pos_name,
-                                    valid_name=valid_name,
-                                    ctx=ctx, dtype=dtype)
-        # prefill through the one-shot bucket path: one compiled
-        # program per pow2 prompt bucket, batch 1 (state rows scatter
-        # into exactly one free slot).  ``prefill_sym`` is either a
-        # length-polymorphic Symbol (one graph, ProgramCache's shape
-        # keys are the buckets) or — the BucketingModule idiom, since
-        # an unrolled graph bakes its length in — a callable
-        # ``T -> Symbol`` invoked once per bucket.
-        self._prefill_caches = {}
-        self._prefill_buckets = ()
         self._prefill_data_name = prefill_data_name
         self._prefill_len_name = prefill_len_name
+        # device replicas (serving/replica.py, ROADMAP 2a): each owns a
+        # FULL slot pool — persistent step program + device-resident
+        # state + prefill bucket caches, params uploaded once per
+        # replica.  New requests land on the replica with the most free
+        # slots and pin there for their whole generation (migrating a
+        # request would ship its KV cache across devices); replicas == 1
+        # is the pre-replica fast path, no router, no extra threads.
+        #
+        # Per-replica prefill goes through the one-shot bucket path:
+        # one compiled program per pow2 prompt bucket, batch 1 (state
+        # rows scatter into exactly one free slot).  ``prefill_sym`` is
+        # either a length-polymorphic Symbol (one graph, ProgramCache's
+        # shape keys are the buckets) or — the BucketingModule idiom,
+        # since an unrolled graph bakes its length in — a callable
+        # ``T -> Symbol`` invoked once per bucket.
+        prefill_buckets = ()
         if prefill_sym is not None:
             buckets, b = [], 1
             top = _next_pow2(self.max_len)
             while b <= top:
                 buckets.append(b)
                 b <<= 1
-            self._prefill_buckets = tuple(buckets)
-            from ..symbol import Symbol as _Symbol
-            # Symbol is itself callable (compose), so "callable" alone
-            # cannot distinguish the T -> Symbol builder idiom
-            if not isinstance(prefill_sym, _Symbol) \
-                    and callable(prefill_sym):
-                for b in self._prefill_buckets:
-                    self._prefill_caches[b] = self._build_prefill(
-                        prefill_sym(b), arg_params, aux_params, ctx,
-                        dtype)
-            else:
-                shared = self._build_prefill(prefill_sym, arg_params,
-                                             aux_params, ctx, dtype)
-                for b in self._prefill_buckets:
-                    self._prefill_caches[b] = shared
+            prefill_buckets = tuple(buckets)
+        from ..symbol import Symbol as _Symbol
+        self._replicas = []
+        for i, rctx in enumerate(replica_contexts(replicas, ctx)):
+            prog = StepProgram(step_sym, arg_params, aux_params,
+                               state_info, self.num_slots,
+                               token_name=token_name,
+                               pos_name=pos_name,
+                               valid_name=valid_name,
+                               ctx=rctx, dtype=dtype,
+                               sampler=self._sampler)
+            rep = DecodeReplica(i, rctx, prog)
+            if prefill_sym is not None:
+                rep.prefill_buckets = prefill_buckets
+                # Symbol is itself callable (compose), so "callable"
+                # alone cannot distinguish the T -> Symbol builder idiom
+                if not isinstance(prefill_sym, _Symbol) \
+                        and callable(prefill_sym):
+                    for b in prefill_buckets:
+                        rep.prefill_caches[b] = self._build_prefill(
+                            prefill_sym(b), arg_params, aux_params,
+                            rctx, dtype, prog)
+                else:
+                    shared = self._build_prefill(prefill_sym, arg_params,
+                                                 aux_params, rctx, dtype,
+                                                 prog)
+                    for b in prefill_buckets:
+                        rep.prefill_caches[b] = shared
+            self._replicas.append(rep)
+        self._multi = len(self._replicas) > 1
+        self._dr_lock = threading.Lock()
+        self._dr_cond = threading.Condition(self._dr_lock)
+        self._dr_stop = False
+        self._slot_free = threading.Event()
         self._tm = (_DecodeTelemetry(self)
                     if _telemetry.enabled() else None)
         self._trace_chain = (_telemetry.chain_from_config()
                              if self._tm is not None else None)
         self._owns_http_server = (_telemetry.server.engine_acquire()
                                   if self._tm is not None else False)
-        self._adm = AdmissionController(max_queue=max_queue,
-                                        overload_policy=overload_policy,
-                                        wake_hint=self.num_slots,
-                                        telemetry=self._tm)
-        n = self.num_slots
-        self._slots = [None] * n        # DecodeRequest or None
-        self._tokens_np = np.zeros((n,), np.float32)
-        self._pos_np = np.zeros((n,), np.float32)
-        self._valid_np = np.zeros((n,), np.float32)
-        self._reset_np = np.zeros((n,), np.float32)
-        self._states = self._program.init_states()
+        self._adm = AdmissionController(
+            max_queue=max_queue, overload_policy=overload_policy,
+            wake_hint=self.num_slots * len(self._replicas),
+            telemetry=self._tm)
         self._lock = threading.Lock()
         self._step_ms = collections.deque(maxlen=4096)
         self._lat_ms = collections.deque(maxlen=4096)
@@ -629,19 +813,47 @@ class DecodeEngine(object):
         if start:
             self.start()
 
-    def _build_prefill(self, psym, arg_params, aux_params, ctx, dtype):
-        """Wrap one prefill graph with the greedy head and compile-once
-        plumbing: outputs become [first sampled token id] + state rows."""
+    # single-replica aliases: replica 0 IS the engine on the fast path,
+    # and tests stage prefill failures by swapping these directly
+    @property
+    def _program(self):
+        return self._replicas[0].program
+
+    @property
+    def _prefill_caches(self):
+        return self._replicas[0].prefill_caches
+
+    @_prefill_caches.setter
+    def _prefill_caches(self, value):
+        self._replicas[0].prefill_caches = value
+
+    @property
+    def _prefill_buckets(self):
+        return self._replicas[0].prefill_buckets
+
+    @_prefill_buckets.setter
+    def _prefill_buckets(self, value):
+        self._replicas[0].prefill_buckets = tuple(value)
+
+    def _build_prefill(self, psym, arg_params, aux_params, ctx, dtype,
+                       program):
+        """Wrap one prefill graph with the sampling head and compile-
+        once plumbing: outputs become [first sampled token id] + state
+        rows under the greedy head, or [last-position logits] + state
+        rows for stochastic samplers (the host then draws through
+        ``StepProgram.sample_tokens`` so prefill uses the same sampler
+        and key stream as the step)."""
         from .. import symbol as sym
-        if len(psym) != 1 + len(self._program.state_names):
+        if len(psym) != 1 + len(program.state_names):
             raise MXNetError(
                 "prefill graph has %d outputs; expected 1 (logits at "
                 "the last valid position) + %d state rows"
-                % (len(psym), len(self._program.state_names)))
+                % (len(psym), len(program.state_names)))
+        head = (sym.argmax(psym[0], axis=1,
+                           name="__decode_prefill_sample__")
+                if self._sampler.greedy else psym[0])
         wrapped = sym.Group(
-            [sym.argmax(psym[0], axis=1,
-                        name="__decode_prefill_sample__")]
-            + [psym[i] for i in range(1, len(psym))])
+            [head] + [psym[i] for i in range(1, len(psym))])
         return ProgramCache(
             wrapped, arg_params, aux_params,
             data_names=[self._prefill_data_name, self._prefill_len_name],
@@ -700,7 +912,21 @@ class DecodeEngine(object):
                                             name="mxnet-decode-worker",
                                             daemon=True)
             self._worker.start()
+        self._ensure_replica_threads()
         return self
+
+    def _ensure_replica_threads(self):
+        """Spawn the per-replica scheduler threads (multi-replica only:
+        the single-replica worker steps its pool inline)."""
+        if not self._multi:
+            return
+        for rep in self._replicas:
+            if rep.thread is None:
+                rep.thread = threading.Thread(
+                    target=self._decode_replica_run, args=(rep,),
+                    name="mxnet-decode-replica-%d" % rep.index,
+                    daemon=True)
+                rep.thread.start()
 
     def close(self, drain=True):
         """Stop admitting.  With ``drain``, queued AND slot-resident
@@ -715,7 +941,21 @@ class DecodeEngine(object):
             if not self._worker.is_alive():
                 self._worker = None
         elif drain:
-            self._run()     # never started: drain on the caller's thread
+            # never started: route the backlog on the caller's thread
+            # (replica threads must exist to drain the routed half)
+            self._ensure_replica_threads()
+            self._run()
+        if self._multi:
+            # router is done; replica threads finish seated generations
+            # (drain) or abort with partial output, then exit
+            with self._dr_lock:
+                self._dr_stop = True
+                self._dr_cond.notify_all()
+            for rep in self._replicas:
+                if rep.thread is not None:
+                    rep.thread.join(timeout=None if drain else 60)
+                    if not rep.thread.is_alive():
+                        rep.thread = None
         if self._tm is not None:
             self._tm.close()
         if self._obs_name is not None:
@@ -799,76 +1039,314 @@ class DecodeEngine(object):
                            deadline_ms=deadline_ms).result(timeout=timeout)
 
     # ------------------------------------------------------------- worker
-    def _occupied(self):
-        return [i for i, s in enumerate(self._slots) if s is not None]
-
     def _occupied_count(self):
-        return sum(1 for s in self._slots if s is not None)
+        return sum(r.occupied_count() for r in self._replicas)
 
     def _heartbeat(self):
         """Watchdog probe: progress age of the scheduler loop, busy
         when any slot is generating or work is queued.  A step program
         wedged in dispatch (donated-buffer failure modes, a hung
         backend) shows up as busy + growing age — named by this
-        heartbeat, not inferred from throughput silence."""
+        heartbeat, not inferred from throughput silence.  Multi-replica
+        engines report the STALEST busy replica (one wedged pool must
+        trip the watchdog even while its siblings keep generating)
+        plus a per-replica breakdown the flight bundle captures."""
         now = time.monotonic()
         queued = len(self._adm)
         occupied = self._occupied_count()
-        return {"age_s": now - self._hb_t,
-                "busy": bool(self._hb_busy or queued or occupied),
-                "in_step": bool(self._hb_busy),
-                "queued": queued, "slots_occupied": occupied,
-                "kind": "decode",
-                "engine": (self._tm.engine_label
-                           if self._tm is not None else None)}
+        out = {"age_s": now - self._hb_t,
+               "busy": bool(self._hb_busy or queued or occupied),
+               "in_step": bool(self._hb_busy),
+               "queued": queued, "slots_occupied": occupied,
+               "kind": "decode",
+               "engine": (self._tm.engine_label
+                          if self._tm is not None else None)}
+        if self._multi:
+            ages = [now - self._hb_t] if (self._hb_busy or queued) else []
+            reps = []
+            for r in self._replicas:
+                age = now - r.hb_t
+                if r.healthy and (r.occupied_count() or r.pending):
+                    ages.append(age)
+                reps.append({"replica": r.label, "healthy": r.healthy,
+                             "slots_occupied": r.occupied_count(),
+                             "pending": len(r.pending),
+                             "age_s": round(age, 3)})
+            out["replicas"] = reps
+            out["busy"] = bool(ages)
+            out["age_s"] = max(ages) if ages else now - self._hb_t
+            out["in_step"] = any(r.in_step for r in self._replicas)
+        return out
 
     def _run(self):
+        if self._multi:
+            self._router_run()
+        else:
+            self._single_run(self._replicas[0])
+
+    def _single_run(self, rep):
+        """The single-replica fast path: one thread admits, seats, and
+        steps the one slot pool — exactly the pre-replica engine."""
         while True:
-            self._hb_t = time.monotonic()
+            self._hb_t = rep.hb_t = time.monotonic()
             self._hb_busy = False
             try:
                 if self._abort:
-                    for i in self._occupied():
-                        self._finish_slot(i, "closed")
+                    for i in rep.occupied():
+                        self._finish_slot(rep, i, "closed")
                     return
-                occ = self._occupied()
+                occ = rep.occupied()
                 free = self.num_slots - len(occ)
                 if not occ:
                     batch = self._adm.take(free, 0.0)
                     if batch is None:
                         return          # closed and drained
                     for r in batch:
-                        self._join(r)
+                        self._join(rep, r)
                     continue
                 # busy: admit opportunistically (never block a step),
                 # and keep queued deadlines honest even when no slot
                 # is free — expiry must not wait for a drain
                 if free:
                     for r in self._adm.poll(free):
-                        self._join(r)
+                        self._join(rep, r)
                 else:
                     self._adm.sweep()
                 self._hb_busy = True    # a wedged step must read busy
-                self._step_once()
+                self._step_once(rep)
             except Exception as e:      # fail the batch, keep serving
-                for i in self._occupied():
-                    req = self._slots[i]
-                    self._slots[i] = None
-                    self._valid_np[i] = 0.0
+                for i in rep.occupied():
+                    req = rep.slots[i]
+                    rep.slots[i] = None
+                    rep.valid_np[i] = 0.0
                     if not req.future.done():
                         _fail_future(req.future, e)
                     if req.trace is not None:
                         req.trace.abort(type(e).__name__)
                 # a failed step dispatch may have consumed the DONATED
-                # state buffers (non-CPU backends): self._states would
+                # state buffers (non-CPU backends): rep.states would
                 # point at deleted arrays and wedge every later step —
                 # the pool is empty now, so fresh zeros lose nothing
-                self._states = self._program.init_states()
-                self._tokens_np.fill(0.0)
-                self._pos_np.fill(0.0)
-                self._reset_np.fill(0.0)
+                rep.states = rep.program.init_states()
+                rep.tokens_np.fill(0.0)
+                rep.pos_np.fill(0.0)
+                rep.reset_np.fill(0.0)
 
-    def _join(self, req):
+    # ------------------------------------------------------------- router
+    def _router_run(self):
+        """Multi-replica scheduler front end: takes admitted requests
+        and routes each to the healthy replica with the most free
+        slots, where it PINS (per-slot state is device-resident).  The
+        router never promises more than the fleet's free capacity, so
+        backlog waits in admission where deadlines sweep and
+        backpressure applies."""
+        while True:
+            self._hb_t = time.monotonic()
+            self._hb_busy = False
+            try:
+                if self._abort:
+                    with self._dr_cond:
+                        self._dr_cond.notify_all()
+                    return
+                with self._dr_lock:
+                    live = [r for r in self._replicas if r.healthy]
+                    free_total = sum(max(0, r.assignable())
+                                     for r in live)
+                if not live:
+                    # dead fleet: fail incoming work fast instead of
+                    # wedging the queue (the flight recorder already
+                    # dumped on each replica's retirement)
+                    batch = self._adm.take(self.num_slots, 0.0)
+                    if batch is None:
+                        return
+                    err = MXNetError(
+                        "all %d decode replicas are unhealthy (step "
+                        "failures drained them); build a new engine"
+                        % len(self._replicas))
+                    for req in batch:
+                        _fail_future(req.future, err)
+                        if req.trace is not None:
+                            req.trace.abort("MXNetError")
+                    continue
+                if free_total <= 0:
+                    # pool full: keep queued deadlines honest while
+                    # waiting for a leave to free capacity
+                    self._adm.sweep()
+                    if self._adm.closed and not len(self._adm):
+                        return
+                    self._slot_free.wait(0.05)
+                    self._slot_free.clear()
+                    continue
+                batch = self._adm.take(free_total, 0.0)
+                if batch is None:
+                    return              # closed and drained
+                self._hb_busy = True
+                for req in batch:
+                    # per-request isolation: a failing assign (or its
+                    # telemetry) must fail THAT request's future, not
+                    # silently drop the rest of the popped batch
+                    try:
+                        self._assign(req)
+                    except Exception as e:
+                        if not req.future.done():
+                            _fail_future(req.future, e)
+                            if req.trace is not None:
+                                req.trace.abort(type(e).__name__)
+            except Exception:           # defense: never lose the router
+                continue
+
+    def _assign(self, req):
+        """Route one admitted request to the freest healthy replica.
+        The append happens under the same lock the replica threads'
+        exit checks hold, and only onto an ``accepting`` replica — a
+        request must never land on a queue no thread will drain."""
+        with self._dr_lock:
+            live = [r for r in self._replicas
+                    if r.healthy and r.accepting]
+            if live:
+                r = max(live, key=lambda x: (x.assignable(), -x.index))
+                r.pending.append(req)
+                self._dr_cond.notify_all()
+                return
+            unhealthy = any(not r.healthy for r in self._replicas)
+        err = (MXNetError("all %d decode replicas are unhealthy"
+                          % len(self._replicas)) if unhealthy
+               else EngineClosedError("engine closed before seating"))
+        _fail_future(req.future, err)
+        if req.trace is not None:
+            req.trace.abort(type(err).__name__)
+
+    def _decode_replica_run(self, rep):
+        """One replica's scheduler loop: seat routed requests, step the
+        pool, deliver leaves.  A step dispatch that raises retires the
+        replica — seated requests are evicted with their PARTIAL output
+        (finish_reason "error"), routed-but-unseated ones re-route, and
+        co-resident replicas keep generating untouched."""
+        while True:
+            rep.hb_t = time.monotonic()
+            if self._abort:
+                with self._dr_lock:
+                    rep.accepting = False
+                    pend = list(rep.pending)
+                    rep.pending.clear()
+                e = EngineClosedError("engine closed before seating")
+                for req in pend:
+                    if not req.future.done():
+                        _fail_future(req.future, e)
+                        if req.trace is not None:
+                            req.trace.abort(type(e).__name__)
+                for i in rep.occupied():
+                    self._finish_slot(rep, i, "closed")
+                return
+            self._sweep_pending(rep, time.monotonic())
+            seats = []
+            with self._dr_lock:
+                n_free = rep.free_slots()
+                while rep.pending and len(seats) < n_free:
+                    seats.append(rep.pending.popleft())
+            for req in seats:
+                self._seat(rep, req)
+            if not rep.occupied_count():
+                with self._dr_cond:
+                    if rep.pending:
+                        continue
+                    if self._dr_stop or not rep.healthy:
+                        # refuse further routing ATOMICALLY with the
+                        # exit decision — the router must never hand
+                        # a request to a dead scheduler thread
+                        rep.accepting = False
+                        return
+                    self._dr_cond.wait(0.05)
+                continue
+            rep.in_step = True
+            try:
+                self._step_once(rep)
+            except Exception as e:
+                rep.in_step = False
+                self._decode_replica_failed(rep, e)
+                return
+            rep.in_step = False
+            rep.hb_t = time.monotonic()
+            if rep.free_slots():
+                self._slot_free.set()
+
+    def _seat(self, rep, req):
+        """Seat one routed request, honoring a deadline that expired in
+        the routed-but-unseated window exactly like the admission sweep
+        would have (``AdmissionController.expire_request``): the
+        request completes with its (empty) partial output, never
+        occupies a slot."""
+        if req.expired():
+            self._adm.expire_request(req, "expired before seating")
+            return
+        self._join(rep, req)
+
+    def _sweep_pending(self, rep, now):
+        """Per-iteration deadline sweep over this replica's routed-but-
+        unseated queue — the one waiting room the admission sweep can
+        no longer see.  Matters after a sibling replica's failure
+        re-routes more requests than this replica has free slots: the
+        overflow must not wait a whole generation to expire."""
+        if not rep.pending:
+            return
+        expired = []
+        with self._dr_lock:
+            if any(r.deadline is not None and now >= r.deadline
+                   for r in rep.pending):
+                keep = collections.deque()
+                for r in rep.pending:
+                    if r.deadline is not None and now >= r.deadline:
+                        expired.append(r)
+                    else:
+                        keep.append(r)
+                rep.pending = keep
+        for r in expired:
+            self._adm.expire_request(r, "expired before seating")
+
+    def _decode_replica_failed(self, rep, exc):
+        """Retire one replica after a failed step dispatch: seated
+        requests are evicted with their PARTIAL tokens (finish_reason
+        "error" — the donated state buffers may be consumed, so the
+        pool cannot step again), routed requests re-route, and the
+        flight recorder dumps while the evidence is fresh."""
+        with self._dr_lock:
+            rep.healthy = False
+            rep.accepting = False
+            orphans = list(rep.pending)
+            rep.pending.clear()
+            stopping = self._dr_stop
+            self._dr_cond.notify_all()
+        warnings.warn(
+            "decode replica %d (%s) retired after a step failure (%r): "
+            "%d seated request(s) evicted with partial output, traffic "
+            "re-routed to %d sibling(s)"
+            % (rep.index, rep.ctx if rep.ctx is not None else "cpu(0)",
+               exc, rep.occupied_count(),
+               sum(1 for x in self._replicas if x.healthy)))
+        for i in rep.occupied():
+            self._finish_slot(rep, i, "error")
+        if rep.tm_failures is not None:
+            rep.tm_failures.inc()
+        fr = _telemetry.recorder.flight_recorder()
+        if fr is not None:
+            fr.dump("replica_failed:%s:%s"
+                    % (self._obs_name or "decode", rep.label),
+                    detail={"replica": rep.describe(),
+                            "error": repr(exc)})
+        for req in orphans:
+            if stopping:
+                # sibling scheduler threads may already have drained
+                # and exited — a re-assigned request would never seat
+                # and its future would hang forever; fail it instead
+                if not req.future.done():
+                    _fail_future(req.future, exc)
+                    if req.trace is not None:
+                        req.trace.abort(type(exc).__name__)
+            else:
+                self._assign(req)
+        self._slot_free.set()
+
+    def _join(self, rep, req):
         """Seat one admitted request in a free slot BETWEEN steps: zero
         (or prefill-fill) the slot's state rows, stage its first token,
         mark the slot valid.  No shape changes anywhere — the next step
@@ -881,24 +1359,24 @@ class DecodeEngine(object):
             if self._tm is not None:  # must carry the same numbers
                 self._tm.leave("cancelled")
             return
-        slot = self._slots.index(None)
+        slot = rep.slots.index(None)
         req.slot = slot
         req.t_join = time.perf_counter()
-        self._slots[slot] = req
-        self._valid_np[slot] = 1.0
+        rep.slots[slot] = req
+        rep.valid_np[slot] = 1.0
         with self._lock:
             self._joins += 1
         if self._tm is not None:
             self._tm.joins.inc()
-        if self._prefill_caches:
+        if rep.prefill_caches:
             # a broken prefill dispatch is THIS request's failure, not
             # the batch's: co-resident mid-generation requests share no
             # state with it and must keep their partial generations
             try:
-                self._prefill(req, slot)
+                self._prefill(rep, req, slot)
             except Exception as e:
-                self._slots[slot] = None
-                self._valid_np[slot] = 0.0
+                rep.slots[slot] = None
+                rep.valid_np[slot] = 0.0
                 with self._lock:
                     self._leaves += 1
                 if self._tm is not None:
@@ -911,78 +1389,93 @@ class DecodeEngine(object):
             # the previous occupant's state rows are cleared IN the
             # next step dispatch (StepProgram reset mask) — a join
             # costs zero device traffic of its own
-            self._reset_np[slot] = 1.0
-            self._tokens_np[slot] = req.prompt[0]
-            self._pos_np[slot] = 0.0
+            rep.reset_np[slot] = 1.0
+            rep.tokens_np[slot] = req.prompt[0]
+            rep.pos_np[slot] = 0.0
             req.prompt_i = 1
-        self._check_finish(slot)
+        self._check_finish(rep, slot)
 
-    def _prefill(self, req, slot):
+    def _prefill(self, rep, req, slot):
         """One bucketed dispatch consumes the whole prompt: pad onto
-        the pow2 bucket grid, run the prefill program (batch 1), argmax
+        the pow2 bucket grid, run the prefill program (batch 1), sample
         the last-valid-position logits into the first generated token,
         scatter the output state rows into the free slot."""
         plen = len(req.prompt)
-        bucket = next(b for b in self._prefill_buckets if b >= plen)
+        bucket = next(b for b in rep.prefill_buckets if b >= plen)
         arr = np.zeros((1, bucket), np.float32)
         arr[0, :plen] = req.prompt
         feeds = {self._prefill_data_name: arr,
                  self._prefill_len_name: np.asarray([plen], np.float32)}
-        outs = self._prefill_caches[bucket].run(feeds)
-        first = outs[0][0]
+        outs = rep.prefill_caches[bucket].run(feeds)
+        if self._sampler.greedy:
+            first = outs[0][0]
+        else:
+            first = rep.program.sample_tokens(outs[0])[0]
         rows = {name: outs[1 + i][0]
-                for i, name in enumerate(self._program.state_names)}
-        self._states = self._program.write_row(self._states, slot, rows)
-        self._reset_np[slot] = 0.0      # prefill rows are live data
+                for i, name in enumerate(rep.program.state_names)}
+        rep.states = rep.program.write_row(rep.states, slot, rows)
+        rep.reset_np[slot] = 0.0        # prefill rows are live data
         req.prompt_i = plen
         req.tokens.append(int(first))
         now = time.monotonic()
         req.t_first_tok = req.t_last_tok = now
-        self._tokens_np[slot] = first
-        self._pos_np[slot] = float(plen)
+        rep.tokens_np[slot] = first
+        rep.pos_np[slot] = float(plen)
         with self._lock:
             self._tokens_out += 1
         if self._tm is not None:
             self._tm.tokens.inc()
             self._tm.ttft.observe(now - req.t_enqueue)
 
-    def _step_once(self):
+    def _step_once(self, rep):
         t0 = time.perf_counter()
         now = time.monotonic()
-        # per-iteration deadline check: an expired slot-resident
-        # request completes with its partial tokens and frees the slot
-        # for queued work — mid-generation eviction, not failure
-        for i in self._occupied():
-            if self._slots[i].expired(now):
-                self._finish_slot(i, "deadline")
-        occ = self._occupied()
+        # per-iteration deadline check folded into ONE slot scan: an
+        # expired slot-resident request completes with its partial
+        # tokens and frees the slot for queued work — mid-generation
+        # eviction, not failure
+        occ = []
+        for i, req in enumerate(rep.slots):
+            if req is None:
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                self._finish_slot(rep, i, "deadline")
+            else:
+                occ.append(i)
         if not occ:
             return
-        sampled, self._states = self._program.step(
-            self._tokens_np, self._pos_np, self._valid_np, self._states,
-            reset=self._reset_np)
-        self._reset_np.fill(0.0)        # consumed: rows are zeroed now
+        sampled, rep.states = rep.program.step(
+            rep.tokens_np, rep.pos_np, rep.valid_np, rep.states,
+            reset=rep.reset_np)
+        rep.reset_np.fill(0.0)          # consumed: rows are zeroed now
+        # one C-level conversion instead of num_slots ndarray-scalar
+        # __getitem__ calls: the slot loop below is the scheduler's
+        # per-step GIL cost, and with replica routing two of these
+        # loops interleave on the host — every microsecond here is
+        # paid per step per replica
+        sampled_l = sampled.tolist()
         new_tokens = 0
         t_tok = time.monotonic()        # one stamp serves every slot
         for i in occ:
-            req = self._slots[i]
+            req = rep.slots[i]
             req.n_steps += 1
-            self._pos_np[i] += 1.0
+            rep.pos_np[i] += 1.0
             if req.prompt_i < len(req.prompt):
                 # teacher forcing: the sample is discarded, the next
                 # prompt token rides the next step
-                self._tokens_np[i] = req.prompt[req.prompt_i]
+                rep.tokens_np[i] = req.prompt[req.prompt_i]
                 req.prompt_i += 1
             else:
-                req.tokens.append(int(sampled[i]))
-                self._tokens_np[i] = sampled[i]
+                tok = sampled_l[i]
+                req.tokens.append(int(tok))
+                rep.tokens_np[i] = tok
                 new_tokens += 1
                 if req.t_first_tok is None:
                     req.t_first_tok = t_tok
                     if self._tm is not None:
                         self._tm.ttft.observe(t_tok - req.t_enqueue)
                 req.t_last_tok = t_tok
-            self._check_finish(i)
+            self._check_finish(rep, i)
         dt_ms = (time.perf_counter() - t0) * 1e3
         with self._lock:
             self._steps += 1
@@ -992,31 +1485,31 @@ class DecodeEngine(object):
             self._tm.steps.inc()
             if new_tokens:
                 self._tm.tokens.inc(new_tokens)
-            self._tm.step_ms.observe(dt_ms)
+            rep.tm_step_ms.observe(dt_ms)
 
-    def _check_finish(self, slot):
-        req = self._slots[slot]
+    def _check_finish(self, rep, slot):
+        req = rep.slots[slot]
         if req is None or not req.tokens:
             return
         if self.eos_id is not None and req.tokens[-1] == self.eos_id:
-            self._finish_slot(slot, "eos")
+            self._finish_slot(rep, slot, "eos")
         elif len(req.tokens) >= req.max_new:
-            self._finish_slot(slot, "length")
-        elif self._pos_np[slot] >= self.max_len:
+            self._finish_slot(rep, slot, "length")
+        elif rep.pos_np[slot] >= self.max_len:
             # no position left to consume the staged token at: the
             # fixed O(1) cache layout is full
-            self._finish_slot(slot, "length")
+            self._finish_slot(rep, slot, "length")
 
-    def _finish_slot(self, slot, reason):
+    def _finish_slot(self, rep, slot, reason):
         """Leave the batch between steps: deliver the result, mark the
         slot dead (valid=0) — its state rows stay as stale garbage,
         which the row-local step verdict proves harmless, and the next
         join rewrites them."""
-        req = self._slots[slot]
-        self._slots[slot] = None
-        self._valid_np[slot] = 0.0
-        self._tokens_np[slot] = 0.0
-        self._pos_np[slot] = 0.0
+        req = rep.slots[slot]
+        rep.slots[slot] = None
+        rep.valid_np[slot] = 0.0
+        rep.tokens_np[slot] = 0.0
+        rep.pos_np[slot] = 0.0
         now = time.monotonic()
         t1 = time.perf_counter()
         res = DecodeResult(req.tokens, reason, n_steps=req.n_steps,
@@ -1074,33 +1567,37 @@ class DecodeEngine(object):
         trace counter cannot even see.  The row-write kernel likewise
         warms against both a fresh buffer and a stepped one (the two
         shardings a prefill scatter can meet)."""
-        states = self._program.init_states()
-        states = self._program.zero_row(states, 0)
         n = self.num_slots
         z = np.zeros((n,), np.float32)
-        _, states = self._program.step(z, z, z, states)
-        _, states = self._program.step(z, z, z, states)
-        rows = {}
-        for info in self._program.state_info:
-            dt = np.dtype(info.get("dtype") or self._program._dtype)
-            rows[info["name"]] = np.zeros(tuple(info["shape"]), dt)
-        self._program.write_row(states, 0, rows)
-        for b in self._prefill_buckets:
-            feeds = {self._prefill_data_name:
-                     np.zeros((1, b), np.float32),
-                     self._prefill_len_name:
-                     np.zeros((1,), np.float32)}
-            self._prefill_caches[b].run(feeds)
+        for rep in self._replicas:
+            prog = rep.program
+            states = prog.init_states()
+            states = prog.zero_row(states, 0)
+            _, states = prog.step(z, z, z, states)
+            _, states = prog.step(z, z, z, states)
+            rows = {}
+            for info in prog.state_info:
+                dt = np.dtype(info.get("dtype") or prog._dtype)
+                rows[info["name"]] = np.zeros(tuple(info["shape"]), dt)
+            prog.write_row(states, 0, rows)
+            for b in rep.prefill_buckets:
+                feeds = {self._prefill_data_name:
+                         np.zeros((1, b), np.float32),
+                         self._prefill_len_name:
+                         np.zeros((1,), np.float32)}
+                rep.prefill_caches[b].run(feeds)
         return self.compile_count
 
     @property
     def compile_count(self):
-        c = self._program.trace_count
+        c = 0
         seen = set()
-        for cache in self._prefill_caches.values():
-            if id(cache) not in seen:       # shared length-poly cache
-                seen.add(id(cache))
-                c += cache.compile_count
+        for rep in self._replicas:
+            c += rep.program.trace_count
+            for cache in rep.prefill_caches.values():
+                if id(cache) not in seen:   # shared length-poly cache
+                    seen.add(id(cache))
+                    c += cache.compile_count
         return c
 
     def stats(self):
@@ -1113,7 +1610,8 @@ class DecodeEngine(object):
             step = sorted(self._step_ms)
             lat = sorted(self._lat_ms)
             snap["decode"] = {
-                "slots": self.num_slots,
+                "slots": self.num_slots * len(self._replicas),
+                "slots_per_replica": self.num_slots,
                 "slots_occupied": self._occupied_count(),
                 "max_len": self.max_len,
                 "steps": self._steps,
@@ -1123,6 +1621,8 @@ class DecodeEngine(object):
                 "evictions": self._evictions,
                 "requests_served": self._requests_served,
                 "compile_count": self.compile_count,
+                "sampler": self._sampler.describe(),
+                "replicas": [r.describe() for r in self._replicas],
                 "prefill": ("bucket" if self._prefill_caches
                             else "step"),
                 "prefill_buckets": list(self._prefill_buckets),

@@ -32,11 +32,18 @@ keep, with ``MXNET_TELEMETRY_TRACE_SAMPLE`` as the periodic floor;
 the live HTTP endpoint (``MXNET_TELEMETRY_PORT``: /metrics, /traces,
 /healthz; released by ``close()``).
 
+Multi-device: with ``replicas=N`` (or ``MXNET_SERVE_REPLICAS``) the
+engine owns N data-parallel device replicas (serving/replica.py) —
+each with its own program cache and device-resident params — and the
+coalescer routes every formed batch to the least-loaded one; a replica
+whose dispatch raises is drained, marked unhealthy, and its traffic
+re-routed while siblings keep serving.
+
 Env knobs (config.py): ``MXNET_SERVE_MAX_BATCH``,
 ``MXNET_SERVE_MAX_QUEUE``, ``MXNET_SERVE_BATCH_TIMEOUT_MS``,
 ``MXNET_SERVE_DEFAULT_DEADLINE_MS``, ``MXNET_SERVE_OVERLOAD_POLICY``,
 ``MXNET_SERVE_SEQ_BUCKETS``, ``MXNET_SERVE_REPAIR``,
-``MXNET_SERVE_OPTIMIZE``.
+``MXNET_SERVE_OPTIMIZE``, ``MXNET_SERVE_REPLICAS``.
 """
 from __future__ import annotations
 
@@ -57,6 +64,7 @@ from .. import telemetry as _telemetry
 from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
 from .buckets import BucketPolicy, ProgramCache, pad_valid_lengths
+from .replica import ServeReplica, replica_contexts
 
 __all__ = ["ServingEngine"]
 
@@ -118,11 +126,16 @@ class _EngineTelemetry(object):
             "mxnet_serve_batches_total", "batches dispatched")
         self.occupancy = reg.histogram(
             "mxnet_serve_batch_occupancy",
-            "live requests / bucket size per dispatched batch",
+            "live requests / bucket size per dispatched batch, per "
+            "engine and device replica",
+            labelnames=("engine", "replica"),
             buckets=_telemetry.RATIO_BUCKETS)
         self.dispatch_ms = reg.histogram(
             "mxnet_serve_dispatch_ms",
-            "compiled-program dispatch wall time per batch",
+            "compiled-program dispatch wall time per batch, per engine "
+            "and device replica — a replica whose dispatch tail "
+            "diverges from its siblings is the straggling device",
+            labelnames=("engine", "replica"),
             buckets=_telemetry.LATENCY_MS_BUCKETS)
         self.pad_waste = reg.histogram(
             "mxnet_serve_padding_waste_ratio",
@@ -144,12 +157,13 @@ class _EngineTelemetry(object):
         self.retraces = reg.counter(
             "mxnet_serve_retraces_total",
             "post-warmup XLA traces on serving dispatches — the "
-            "compile-once contract demands this stays 0; the hazards "
-            "label carries the retrace-linter fingerprints of the "
-            "graph's statically known hazards, per engine, so "
+            "compile-once contract demands this stays 0 per device "
+            "replica (each replica owns its own program cache); the "
+            "hazards label carries the retrace-linter fingerprints of "
+            "the graph's statically known hazards, per engine, so "
             "tools/hazard_rank.py can credit each fingerprint with "
             "its own engine's traffic exposure",
-            labelnames=("engine", "hazards"))
+            labelnames=("engine", "replica", "hazards"))
         self.shape_seen = reg.counter(
             "mxnet_serve_shape_signature_total",
             "requests per observed (bucket-padded) input-shape "
@@ -224,16 +238,48 @@ class _EngineTelemetry(object):
             "(the engine serves the unoptimized graph), per pass that "
             "planned them",
             labelnames=("engine", "pass"))
+        # replica plane (serving/replica.py): configured replica count,
+        # per-replica health/load gauges the router's decisions read
+        # back out of, and the failure counter the failover contract
+        # is monitored by — families defined ONCE in replica.py and
+        # shared with DecodeEngine (engine labels are process-unique
+        # ordinals, so both kinds aggregate into one fleet view)
+        from .replica import replica_metric_families
+        (replicas_fam, self.replica_healthy, self.replica_inflight,
+         self.replica_failures) = replica_metric_families(reg)
+        self.replicas_g = replicas_fam.labels(engine=self.engine_label)
+        self.replica_batches = reg.counter(
+            "mxnet_serve_replica_batches_total",
+            "batches dispatched per device replica — uniform counts "
+            "mean the least-loaded router is actually balancing",
+            labelnames=("engine", "replica"))
         self._engine_gauge_fams = (queue_depth_fam, cache_hits_fam,
                                    cache_misses_fam, compile_count_fam,
-                                   entropy_fam)
-        # pre-touch the retrace series under this graph's hazard label
-        # so a healthy engine scrapes an explicit 0 (absence of the
-        # series would be indistinguishable from "not instrumented" —
-        # and the zero-count series is how the offline ranker knows a
-        # lint fingerprint is DEPLOYED)
-        self.retraces.labels(engine=self.engine_label,
-                             hazards=engine._hazard_label)
+                                   entropy_fam, replicas_fam)
+        self._replica_fams = (self.replica_healthy, self.replica_inflight,
+                              self.replica_failures, self.replica_batches,
+                              self.dispatch_ms, self.occupancy,
+                              self.retraces)
+        self.replicas_g.set(len(engine._replicas))
+        # bind per-replica children once — the dispatch hot path never
+        # pays a labels() registry probe — and pre-touch the retrace
+        # series under this graph's hazard label so a healthy replica
+        # scrapes an explicit 0 (absence of the series would be
+        # indistinguishable from "not instrumented" — and the
+        # zero-count series is how the offline ranker knows a lint
+        # fingerprint is DEPLOYED)
+        for r in engine._replicas:
+            r.tm_dispatch = self.dispatch_ms.labels(
+                engine=self.engine_label, replica=r.label)
+            r.tm_occupancy = self.occupancy.labels(
+                engine=self.engine_label, replica=r.label)
+            r.tm_retraces = self.retraces.labels(
+                engine=self.engine_label, replica=r.label,
+                hazards=engine._hazard_label)
+            r.tm_batches = self.replica_batches.labels(
+                engine=self.engine_label, replica=r.label)
+            r.tm_failures = self.replica_failures.labels(
+                engine=self.engine_label, replica=r.label)
         self._engine = weakref.ref(engine)
         reg.register_callback(self._refresh)
 
@@ -249,9 +295,10 @@ class _EngineTelemetry(object):
     def _remove_engine_series(self):
         for fam in self._engine_gauge_fams:
             fam.remove(engine=self.engine_label)
-        for fam in (self.shape_seen, self.retraces,
+        for fam in (self.shape_seen,
                     self.repairs_applied, self.repairs_rejected,
-                    self.opt_removed, self.opt_rejected):
+                    self.opt_removed, self.opt_rejected) \
+                + self._replica_fams:
             for values, _inst in fam.series():
                 if values[0] == self.engine_label:
                     fam.remove(*values)
@@ -265,9 +312,18 @@ class _EngineTelemetry(object):
             reg.unregister_callback(self._refresh)
             self._remove_engine_series()
             return
-        self.cache_hits.set(eng._cache.plan_hits)
-        self.cache_misses.set(eng._cache.plan_misses)
+        self.cache_hits.set(sum(r.cache.plan_hits
+                                for r in eng._replicas))
+        self.cache_misses.set(sum(r.cache.plan_misses
+                                  for r in eng._replicas))
         self.compile_count.set(eng.compile_count)
+        for r in eng._replicas:
+            self.replica_healthy.labels(
+                engine=self.engine_label,
+                replica=r.label).set(1.0 if r.healthy else 0.0)
+            self.replica_inflight.labels(
+                engine=self.engine_label,
+                replica=r.label).set(r.inflight())
         # entropy over THIS engine's series only (sig children carry
         # the engine label) — a co-resident engine's traffic must not
         # contaminate the estimate
@@ -293,12 +349,18 @@ class ServingEngine(object):
     policy : BucketPolicy, default built from the MXNET_SERVE_* env tier.
     start : spawn the worker thread immediately (tests pass False to
         stage requests against a stopped engine).
+    replicas : data-parallel device replicas (default
+        ``MXNET_SERVE_REPLICAS``).  ``ctx`` may also be a LIST of
+        contexts, which is then the replica set verbatim (two replicas
+        on one device is legal and how tests exercise routing without
+        forcing a host device count).
     """
 
     def __init__(self, symbol, arg_params, aux_params, data_shapes,
                  ctx=None, policy=None, max_queue=None,
                  batch_timeout_ms=None, default_deadline_ms=None,
-                 overload_policy=None, dtype=np.float32, start=True):
+                 overload_policy=None, dtype=np.float32, start=True,
+                 replicas=None):
         from .. import config
         self._policy = policy or BucketPolicy.from_config()
         if max_queue is None:
@@ -349,6 +411,23 @@ class ServingEngine(object):
         # drop it so the full per-node shape/dtype environment is not
         # held resident for the engine's serving lifetime
         self._preflight_pre = None
+        # device replicas (serving/replica.py, ROADMAP 2a): each owns
+        # its own compile-once ProgramCache with params uploaded to its
+        # device once.  replicas == 1 is the pre-replica fast path —
+        # the worker dispatches inline, no router, no extra threads.
+        data_names = list(self._data_shapes)
+        if self._valid_name is not None:
+            data_names.append(self._valid_name)
+        self._replicas = []
+        for i, rctx in enumerate(replica_contexts(replicas, ctx)):
+            cache = ProgramCache(self._serve_sym, arg_params, aux_params,
+                                 data_names, ctx=rctx, dtype=dtype)
+            self._replicas.append(ServeReplica(i, rctx, cache))
+        self._cache = self._replicas[0].cache   # single-replica alias
+        self._multi = len(self._replicas) > 1
+        self._route_lock = threading.Lock()
+        self._route_cond = threading.Condition(self._route_lock)
+        self._replicas_stop = False
         # telemetry bundle: None when disabled — every instrumented
         # branch below gates on that, keeping the disabled hot path at
         # zero registry calls per request
@@ -371,17 +450,11 @@ class ServingEngine(object):
         self._sig_labels = {}        # group key -> shape-sig counter child
         self._sig_other = None       # shared catch-all child past the cap
         self._sig_lock = threading.Lock()   # guards creation + the cap
-        self._dispatched_keys = set()
         self._retraces = 0
         self._adm = AdmissionController(max_queue=max_queue,
                                         overload_policy=overload_policy,
                                         wake_hint=self._policy.max_batch,
                                         telemetry=self._tm)
-        data_names = list(self._data_shapes)
-        if self._valid_name is not None:
-            data_names.append(self._valid_name)
-        self._cache = ProgramCache(self._serve_sym, arg_params, aux_params,
-                                   data_names, ctx=ctx, dtype=dtype)
         self._lock = threading.Lock()
         self._group_cache = {}   # exact input shapes -> validated group
         self._lat_ms = collections.deque(maxlen=4096)
@@ -660,7 +733,20 @@ class ServingEngine(object):
                                             name="mxnet-serve-worker",
                                             daemon=True)
             self._worker.start()
+        self._ensure_replica_threads()
         return self
+
+    def _ensure_replica_threads(self):
+        """Spawn the per-replica dispatch threads (multi-replica only:
+        the single-replica worker dispatches inline)."""
+        if not self._multi:
+            return
+        for r in self._replicas:
+            if r.thread is None:
+                r.thread = threading.Thread(
+                    target=self._replica_run, args=(r,),
+                    name="mxnet-serve-replica-%d" % r.index, daemon=True)
+                r.thread.start()
 
     def close(self, drain=True):
         """Stop admitting; with ``drain`` finish queued work first.
@@ -675,7 +761,34 @@ class ServingEngine(object):
             if not self._worker.is_alive():
                 self._worker = None
         elif drain:
-            self._run()    # never started: drain on the caller's thread
+            # never started: route/dispatch the backlog on the caller's
+            # thread (replica threads must exist for the routed half)
+            self._ensure_replica_threads()
+            self._run()
+        if self._multi:
+            # coalescer is done routing; replica threads drain their
+            # queues (or fail them, no-drain) and exit
+            with self._route_lock:
+                self._replicas_stop = True
+                if not drain:
+                    orphans = []
+                    for r in self._replicas:
+                        orphans.extend(r.pending)
+                        r.pending.clear()
+                self._route_cond.notify_all()
+            if not drain:
+                for reqs, _t in orphans:
+                    e = EngineClosedError("engine closed before dispatch")
+                    for req in reqs:
+                        if not req.future.done():
+                            _fail_future(req.future, e)
+                            if req.trace is not None:
+                                req.trace.abort(type(e).__name__)
+            for r in self._replicas:
+                if r.thread is not None:
+                    r.thread.join(timeout=None if drain else 60)
+                    if not r.thread.is_alive():
+                        r.thread = None
         if self._tm is not None:
             self._tm.close()
         if self._obs_name is not None:
@@ -868,15 +981,36 @@ class ServingEngine(object):
         loop last made progress, and whether it HAS work — ``busy`` is
         the false-positive guard: an idle engine blocked on an empty
         queue is healthy however stale its stamp, while a worker that
-        is mid-dispatch (or has work queued) and stale is wedged."""
+        is mid-dispatch (or has work queued) and stale is wedged.
+        Multi-replica engines report the STALEST busy component (a
+        replica wedged in dispatch must trip the watchdog even while
+        the coalescer keeps routing around it), plus a per-replica
+        breakdown the flight bundle captures."""
         now = time.monotonic()
         queued = len(self._adm)
-        return {"age_s": now - self._hb_t,
-                "busy": bool(self._hb_busy or queued),
-                "in_dispatch": bool(self._hb_busy),
-                "queued": queued, "kind": "serve",
-                "engine": (self._tm.engine_label
-                           if self._tm is not None else None)}
+        out = {"age_s": now - self._hb_t,
+               "busy": bool(self._hb_busy or queued),
+               "in_dispatch": bool(self._hb_busy),
+               "queued": queued, "kind": "serve",
+               "engine": (self._tm.engine_label
+                          if self._tm is not None else None)}
+        if self._multi:
+            ages = [now - self._hb_t] if out["busy"] else []
+            reps = []
+            for r in self._replicas:
+                infl = r.inflight()
+                age = now - r.hb_t
+                if infl and r.healthy:
+                    ages.append(age)
+                reps.append({"replica": r.label, "healthy": r.healthy,
+                             "inflight": infl,
+                             "age_s": round(age, 3)})
+            out["replicas"] = reps
+            out["busy"] = bool(ages)
+            out["age_s"] = max(ages) if ages else now - self._hb_t
+            out["in_dispatch"] = any(r.in_dispatch
+                                     for r in self._replicas)
+        return out
 
     # -------------------------------------------------------------- worker
     def _run(self):
@@ -911,20 +1045,145 @@ class ServingEngine(object):
                                  (time.monotonic()
                                   - reqs[0].t_enqueue) * 1e3)
             try:
-                self._dispatch(reqs, t_pop)
+                if self._multi:
+                    self._route(reqs, t_pop)
+                else:
+                    self._dispatch(reqs, t_pop)
             except Exception as e:         # fail the batch, keep serving
-                for r in reqs:
-                    if not r.future.done():
-                        _fail_future(r.future, e)
-                        if r.trace is not None:
-                            r.trace.abort(type(e).__name__)
-                    elif r.trace is not None:
-                        # delivered before the batch blew up mid-
-                        # scatter: close the trace as-is, NOT 'failed'
-                        r.trace.finish()
+                self._fail_batch(reqs, e)
 
-    def _dispatch(self, reqs, t_pop=None):
+    @staticmethod
+    def _fail_batch(reqs, e):
+        for r in reqs:
+            if not r.future.done():
+                _fail_future(r.future, e)
+                if r.trace is not None:
+                    r.trace.abort(type(e).__name__)
+            elif r.trace is not None:
+                # delivered before the batch blew up mid-
+                # scatter: close the trace as-is, NOT 'failed'
+                r.trace.finish()
+
+    # ------------------------------------------------------------- replicas
+
+    # batches a replica may hold past admission (1 dispatching + 1
+    # staged): the router BLOCKS beyond this, so under overload the
+    # backlog stays in the admission queue where max_queue
+    # backpressure, shed-oldest, and the deadline sweep all still
+    # apply — an unbounded pending queue would silently disable all
+    # three (the single-replica worker holds exactly one popped batch,
+    # and this keeps the multi-replica pop-to-dispatch window the same
+    # order of magnitude)
+    _MAX_REPLICA_INFLIGHT = 2
+
+    def _route(self, reqs, t_pop):
+        """Hand one formed batch to the least-loaded healthy replica
+        (emptiest in-flight queue; index breaks ties so an idle fleet
+        fills deterministically), blocking while every healthy replica
+        is at its in-flight cap.  Raises when every replica is
+        unhealthy (the caller fails the batch and the coalescer keeps
+        serving — a dead fleet fails fast instead of wedging the
+        queue) or when the engine is stopping (replica threads may
+        already have drained and exited; an appended batch would
+        strand its futures)."""
+        with self._route_lock:
+            while True:
+                live = [r for r in self._replicas
+                        if r.healthy and r.accepting]
+                if not live:
+                    if any(not r.healthy for r in self._replicas):
+                        raise MXNetError(
+                            "all %d serving replicas are unhealthy "
+                            "(dispatch failures drained them); build "
+                            "a new engine" % len(self._replicas))
+                    raise EngineClosedError(
+                        "engine closed before dispatch")
+                r = min(live, key=lambda r: (r.inflight(), r.index))
+                if r.inflight() < self._MAX_REPLICA_INFLIGHT:
+                    break
+                self._route_cond.wait(0.05)
+            # appended under the same lock the replica thread's exit
+            # check holds: an accepting replica is guaranteed to drain
+            # this batch before it exits
+            r.pending.append((reqs, t_pop))
+            self._route_cond.notify_all()
+
+    def _replica_run(self, r):
+        """One replica's dispatch loop: drain routed batches against
+        this replica's device-resident program cache.  A dispatch that
+        raises fails ITS batch and retires the replica (unhealthy +
+        drained, queued batches re-routed) — co-resident replicas keep
+        serving."""
+        while True:
+            with self._route_lock:
+                while not r.pending and not self._replicas_stop \
+                        and r.healthy:
+                    self._route_cond.wait(0.05)
+                if r.pending:
+                    reqs, t_pop = r.pending.popleft()
+                    r.in_dispatch = True
+                else:
+                    # stopped or retired, drained: refuse further
+                    # routing ATOMICALLY with the exit decision — the
+                    # router must never hand work to a dead thread
+                    r.accepting = False
+                    return
+            r.hb_t = time.monotonic()
+            try:
+                self._dispatch(reqs, t_pop, r)
+            except Exception as e:
+                self._fail_batch(reqs, e)
+                self._replica_failed(r, e)
+            finally:
+                with self._route_lock:
+                    r.in_dispatch = False
+                    # a capped router may be waiting for this slot
+                    self._route_cond.notify_all()
+                r.hb_t = time.monotonic()
+
+    def _replica_failed(self, r, exc):
+        """Retire one replica after a failed dispatch: mark unhealthy,
+        drain its queue back through the router, dump a flight bundle
+        while the evidence is fresh.  The failed batch itself was
+        already failed by the caller — one-shot requests have no
+        partial output to salvage."""
+        with self._route_lock:
+            first = r.healthy
+            r.healthy = False
+            r.failures += 1
+            orphans = list(r.pending)
+            r.pending.clear()
+            stopping = self._replicas_stop
+            self._route_cond.notify_all()
+        if first:
+            warnings.warn(
+                "serving replica %d (%s) retired after a dispatch "
+                "failure (%r); traffic re-routed to %d sibling(s)"
+                % (r.index, r.ctx if r.ctx is not None else "cpu(0)",
+                   exc, sum(1 for x in self._replicas if x.healthy)))
+            if r.tm_failures is not None:
+                r.tm_failures.inc()
+            fr = _telemetry.recorder.flight_recorder()
+            if fr is not None:
+                fr.dump("replica_failed:%s:%s"
+                        % (self._obs_name or "serve", r.label),
+                        detail={"replica": r.describe(),
+                                "error": repr(exc)})
+        for reqs, t_pop in orphans:
+            if stopping:
+                # sibling dispatch threads may already have drained and
+                # exited — a re-routed batch would strand its futures
+                # forever; fail it with the original error instead
+                self._fail_batch(reqs, exc)
+                continue
+            try:
+                self._route(reqs, t_pop)
+            except Exception as e2:
+                self._fail_batch(reqs, e2)
+
+    def _dispatch(self, reqs, t_pop=None, replica=None):
         tm = self._tm
+        rep = replica if replica is not None else self._replicas[0]
         t_pop = time.perf_counter() if t_pop is None else t_pop
         # claim every future up front: a claimed (RUNNING) future can no
         # longer be cancel()ed out from under the scatter, and requests
@@ -960,16 +1219,18 @@ class ServingEngine(object):
             # 2049), and the spliced variable declares float32
             feeds[self._valid_name] = pad_valid_lengths(
                 [self._live_length(r) for r in reqs], b)
-        c0 = self._cache.compile_count
+        c0 = rep.cache.compile_count
         t_disp0 = time.perf_counter()
-        with profiler.record_span("serve.dispatch[b=%d,n=%d]" % (b, n),
-                                  "serve"):
+        with profiler.record_span(
+                "serve.dispatch[b=%d,n=%d,r=%d]" % (b, n, rep.index)
+                if self._multi else
+                "serve.dispatch[b=%d,n=%d]" % (b, n), "serve"):
             if self._pad_check:
-                outs = self._pad_probe(feeds, reqs)
+                outs = self._pad_probe(feeds, reqs, rep)
             else:
-                outs = self._cache.run(feeds)
+                outs = rep.cache.run(feeds)
         t_disp1 = time.perf_counter()
-        compiled = self._count_compiles(c0, feeds)
+        compiled = self._count_compiles(c0, feeds, rep)
         now = time.monotonic()
         # scatter first: unblock the waiting clients before doing any
         # stats bookkeeping (closed-loop clients resubmit ~0.1 ms
@@ -991,10 +1252,12 @@ class ServingEngine(object):
             self._occupancy_sum += n / float(b)
             for r in reqs:
                 self._lat_ms.append((now - r.t_enqueue) * 1e3)
+        rep.batches += 1
         if tm is not None:
             tm.batches.inc()
-            tm.occupancy.observe(n / float(b))
-            tm.dispatch_ms.observe((t_disp1 - t_disp0) * 1e3)
+            rep.tm_batches.inc()
+            rep.tm_occupancy.observe(n / float(b))
+            rep.tm_dispatch.observe((t_disp1 - t_disp0) * 1e3)
             for r in reqs:
                 tm.latency.observe((now - r.t_enqueue) * 1e3)
             bucket = str(b)
@@ -1006,17 +1269,20 @@ class ServingEngine(object):
         if profiler.is_running():
             profiler.counter("serve.batch_occupancy", n / float(b))
 
-    def _count_compiles(self, c0, feeds):
+    def _count_compiles(self, c0, feeds, rep):
         """Attribute XLA traces observed during one dispatch: every
-        trace counts as a compile; a trace on an already-dispatched
-        bucket signature (or any trace once warmup ran) is a RETRACE —
-        the compile-once contract broken at runtime — and is counted
-        under the engine's static hazard fingerprints.  The engine-side
-        bookkeeping (``stats()['retraces']``) always runs — a compile
-        storm must be visible even with the registry disabled; only
-        the instrument writes gate on the bundle."""
+        trace counts as a compile; a trace on a bucket signature THIS
+        REPLICA already dispatched (or any trace once warmup ran) is a
+        RETRACE — the compile-once contract broken at runtime — and is
+        counted under the engine's static hazard fingerprints, per
+        replica (each replica owns its own program cache, so a
+        signature warm on replica 0 is a legitimate cold compile on
+        replica 1).  The engine-side bookkeeping
+        (``stats()['retraces']``) always runs — a compile storm must
+        be visible even with the registry disabled; only the
+        instrument writes gate on the bundle."""
         tm = self._tm
-        compiled = self._cache.compile_count - c0
+        compiled = rep.cache.compile_count - c0
         key = tuple(sorted((k, v.shape) for k, v in feeds.items()))
         if compiled:
             if tm is not None:
@@ -1026,13 +1292,11 @@ class ServingEngine(object):
             # legitimate cold compile even post-warmup: exact-length
             # seq mode (cross-position graphs degrade to one program
             # per length) compiles new lengths by design.
-            if key in self._dispatched_keys:
+            if key in rep.dispatched_keys:
                 self._retraces += compiled
                 if tm is not None:
-                    tm.retraces.labels(
-                        engine=tm.engine_label,
-                        hazards=self._hazard_label).inc(compiled)
-        self._dispatched_keys.add(key)
+                    rep.tm_retraces.inc(compiled)
+        rep.dispatched_keys.add(key)
         return compiled
 
     def _finish_trace(self, r, t_pop, t_pad0, t_disp0, t_disp1, t_u0,
@@ -1079,11 +1343,13 @@ class ServingEngine(object):
                    for n, ax in sorted(self._length_sources.items())})
         return lengths.pop()
 
-    def _pad_probe(self, feeds, reqs):
+    def _pad_probe(self, feeds, reqs, rep=None):
         """MXNET_SERVE_PAD_CHECK: dispatch twice via the ProgramCache
         probe hook and require bitwise-equal live regions (see
         buckets.ProgramCache.run_pad_probe).  Debug knob — doubles
         dispatch cost, compiles nothing extra."""
+        cache = (rep.cache if rep is not None
+                 else self._replicas[0].cache)
         live_masks = {}
         for name, arr in feeds.items():
             mask = np.zeros(arr.shape, dtype=bool)
@@ -1097,7 +1363,7 @@ class ServingEngine(object):
                     x = r.inputs[name]
                     mask[(i,) + tuple(slice(0, d) for d in x.shape)] = True
             live_masks[name] = mask
-        base, probed = self._cache.run_pad_probe(feeds, live_masks)
+        base, probed = cache.run_pad_probe(feeds, live_masks)
         for j, (o0, o1) in enumerate(zip(base, probed)):
             for i, r in enumerate(reqs):
                 a = self._unpad(o0[i], r, j)
@@ -1148,20 +1414,26 @@ class ServingEngine(object):
                     # all-pad lengths: the compiled program is the
                     # same; the outputs are discarded
                     feeds[self._valid_name] = pad_valid_lengths([], bb)
-                with profiler.record_span(
-                        "serve.warmup[b=%d]" % bb, "serve"):
-                    self._cache.run(feeds)
-                self._dispatched_keys.add(tuple(sorted(
-                    (k, v.shape) for k, v in feeds.items())))
-                with self._lock:
-                    self._warmup_batches += 1
+                key = tuple(sorted(
+                    (k, v.shape) for k, v in feeds.items()))
+                # every replica compiles its own program per bucket —
+                # live traffic must never pay a trace whichever
+                # replica the router picks
+                for rep in self._replicas:
+                    with profiler.record_span(
+                            "serve.warmup[b=%d]" % bb, "serve"):
+                        rep.cache.run(feeds)
+                    rep.dispatched_keys.add(key)
+                    with self._lock:
+                        self._warmup_batches += 1
         if self._tm is not None:
             self._tm.compiles.inc(self.compile_count - c0)
         return self.compile_count
 
     @property
     def compile_count(self):
-        return self._cache.compile_count
+        """XLA traces across every replica's program cache."""
+        return sum(r.cache.compile_count for r in self._replicas)
 
     def stats(self):
         """Point-in-time snapshot of engine health: admission counters
@@ -1188,10 +1460,14 @@ class ServingEngine(object):
                                     if self._batches else 0.0),
                 "compile_count": self.compile_count,
                 "retraces": self._retraces,
-                "program_cache": {"hits": self._cache.plan_hits,
-                                  "misses": self._cache.plan_misses},
+                "program_cache": {
+                    "hits": sum(r.cache.plan_hits
+                                for r in self._replicas),
+                    "misses": sum(r.cache.plan_misses
+                                  for r in self._replicas)},
                 "bucket_keys": len(self._cache.bucket_keys),
                 "max_batch": self._policy.max_batch,
+                "replicas": [r.describe() for r in self._replicas],
                 "repairs": {
                     "applied": (len(self.repair_plan.actions)
                                 if self.repair_plan is not None else 0),

@@ -62,7 +62,7 @@ from .recorder import (HistoryRecorder, FlightRecorder, start_recorder,
                        stop_recorder, get_recorder, register_heartbeat,
                        unregister_heartbeat, heartbeats, flight_recorder)
 from .alerts import (AlertRule, AlertManager, default_manager,
-                     register_engine_default_rules)
+                     register_engine_default_rules, load_rules_file)
 from .step import (StepTimer, PHASES, STEP_SECONDS_BUCKETS,
                    PEAKS_TFLOPS, peak_flops_for)
 
@@ -84,7 +84,7 @@ __all__ = [
     "stop_recorder", "get_recorder", "register_heartbeat",
     "unregister_heartbeat", "heartbeats", "flight_recorder",
     "AlertRule", "AlertManager", "default_manager",
-    "register_engine_default_rules",
+    "register_engine_default_rules", "load_rules_file",
     "StepTimer", "PHASES", "STEP_SECONDS_BUCKETS", "PEAKS_TFLOPS",
     "peak_flops_for",
     "enabled", "set_enabled", "registry", "counter", "gauge",

@@ -38,13 +38,15 @@ metric series.
 """
 from __future__ import annotations
 
+import json
 import threading
 import time
+import warnings
 
 from ..base import MXNetError
 
 __all__ = ["AlertRule", "AlertManager", "default_manager",
-           "register_engine_default_rules"]
+           "register_engine_default_rules", "load_rules_file"]
 
 _KINDS = ("threshold", "burn_rate", "absence", "watchdog")
 _OPS = {
@@ -476,6 +478,58 @@ def default_manager():
     """The process-wide manager engines register their default rules
     against and the recorder singleton evaluates."""
     return _DEFAULT
+
+
+def load_rules_file(path=None, manager=None):
+    """Load declarative AlertRules from a JSON file into ``manager``
+    (default: the process manager) — the operator's no-redeploy SLO
+    surface (``MXNET_TELEMETRY_ALERT_RULES``).
+
+    The file is either a bare JSON list of :meth:`AlertRule.from_dict`
+    dicts or a ``{"rules": [...]}`` document.  Loading is defensive by
+    design — a typo'd rules file must never take down the serving
+    process it monitors: a missing/malformed file warns and loads
+    nothing, an invalid rule dict warns and skips that rule, and a
+    rule whose name is already registered is skipped silently (the
+    loader runs on every engine-driven recorder rebuild, so it must be
+    idempotent).  Each loaded rule is stamped with a ``source``
+    annotation naming the file, so ``GET /alerts`` and flight bundles
+    show where an SLO came from.  Returns the rules actually added.
+    """
+    from .. import config
+    if path is None:
+        path = config.get("MXNET_TELEMETRY_ALERT_RULES")
+    if not path:
+        return []
+    mgr = manager if manager is not None else default_manager()
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except Exception as e:
+        warnings.warn("MXNET_TELEMETRY_ALERT_RULES: cannot load %r "
+                      "(%s); no operator rules registered" % (path, e))
+        return []
+    rows = doc.get("rules") if isinstance(doc, dict) else doc
+    if not isinstance(rows, list):
+        warnings.warn("MXNET_TELEMETRY_ALERT_RULES: %r must be a JSON "
+                      "list of rule dicts (or {'rules': [...]}); got "
+                      "%s" % (path, type(rows).__name__))
+        return []
+    added = []
+    for i, row in enumerate(rows):
+        try:
+            rule = AlertRule.from_dict(row)
+        except Exception as e:
+            warnings.warn("MXNET_TELEMETRY_ALERT_RULES: rule %d in %r "
+                          "is invalid (%s); skipped" % (i, path, e))
+            continue
+        rule.annotations.setdefault("source", path)
+        try:
+            mgr.add_rule(rule, owner="rules-file")
+        except MXNetError:
+            continue        # already registered: idempotent reload
+        added.append(rule)
+    return added
 
 
 def register_engine_default_rules(kind, engine_label, watchdog_s=None):

@@ -582,8 +582,16 @@ def _build_from_config(interval_s=None, window=None):
         window = config.get("MXNET_TELEMETRY_HISTORY_WINDOW")
     alerts = None
     if config.get("MXNET_TELEMETRY_ALERTS"):
-        from .alerts import default_manager
+        from .alerts import default_manager, load_rules_file
         alerts = default_manager()
+        # operator SLOs from the declarative rules file join the
+        # manager the moment something starts evaluating it — a rules
+        # file nobody evaluates would be a silently dead SLO surface.
+        # Idempotent: already-registered names are skipped.
+        try:
+            load_rules_file(manager=alerts)
+        except Exception:
+            pass                # defensive: never block the recorder
     return HistoryRecorder(float(interval_s), int(window), alerts=alerts)
 
 

@@ -440,6 +440,46 @@ def _healthz(server):
             "leaves": _total("mxnet_serve_decode_leaves_total"),
             "evictions": _total("mxnet_serve_decode_evictions_total"),
         }
+    # replica plane (serving/replica.py): one block per engine with a
+    # row per device replica — health, in-flight load, traffic, and
+    # failure counts joined across the mxnet_serve_replica_* families
+    # (present only when a replica-aware engine is live)
+    rep_health = doc.get("mxnet_serve_replica_healthy", {}) \
+                    .get("series", [])
+    if rep_health:
+        def _by_replica(name):
+            out_map = {}
+            for s in doc.get(name, {}).get("series", []):
+                lab = s.get("labels") or {}
+                out_map[(lab.get("engine"), lab.get("replica"))] = \
+                    s.get("value")
+            return out_map
+        inflight = _by_replica("mxnet_serve_replica_inflight")
+        failures = _by_replica("mxnet_serve_replica_failures_total")
+        batches = _by_replica("mxnet_serve_replica_batches_total")
+        occupied = _by_replica("mxnet_serve_decode_slots_occupied")
+        blocks, unhealthy = {}, 0
+        for s in rep_health:
+            lab = s.get("labels") or {}
+            eng, rep = lab.get("engine"), lab.get("replica")
+            healthy = bool(s.get("value"))
+            if not healthy:
+                unhealthy += 1
+            row = {"replica": rep, "healthy": healthy,
+                   "inflight": inflight.get((eng, rep), 0) or 0,
+                   "failures": failures.get((eng, rep), 0) or 0}
+            if (eng, rep) in batches:
+                row["batches"] = batches[(eng, rep)]
+            if (eng, rep) in occupied:
+                row["slots_occupied"] = occupied[(eng, rep)]
+            blocks.setdefault(eng, []).append(row)
+        for rows in blocks.values():
+            rows.sort(key=lambda r: str(r["replica"]))
+        out["replicas"] = {
+            "engines": blocks,
+            "total": len(rep_health),
+            "unhealthy": unhealthy,
+        }
     # training processes: step count + live MFU per instrumented loop
     steps = doc.get("mxnet_train_steps_total", {}).get("series", [])
     if steps:

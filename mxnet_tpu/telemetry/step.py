@@ -103,6 +103,66 @@ def peak_flops_for(device):
 
 _ACTIVE = contextvars.ContextVar("mxnet_tpu_step_timer", default=None)
 
+# -- per-loop heartbeat aggregation -----------------------------------------
+#
+# One ``train.<loop>`` heartbeat per loop label, aggregating every live
+# StepTimer on that label: concurrent fits sharing a label must not
+# clobber each other's registration (a wedged fit would become
+# invisible the moment a healthy one registered over it).  ``busy`` is
+# true while ANY timer has a step open; ``age_s`` is the STALEST busy
+# timer's progress age (the one the watchdog should page about).
+# WeakSet membership: a timer GC'd without close() drops out on its
+# own instead of being kept alive by its diagnostics.
+
+import threading as _threading
+import weakref as _weakref
+
+_HB_LOCK = _threading.Lock()
+_HB_LOOPS = {}      # loop label -> WeakSet[StepTimer]
+
+
+def _loop_heartbeat(loop):
+    with _HB_LOCK:
+        timers = list(_HB_LOOPS.get(loop, ()))
+    now = time.monotonic()
+    busy = [t for t in timers if t._t0 is not None]
+    if busy:
+        age = max(now - t._hb_stamp for t in busy)
+    elif timers:
+        age = min(now - t._hb_stamp for t in timers)
+    else:
+        age = 0.0
+    return {"age_s": age, "busy": bool(busy), "in_step": bool(busy),
+            "kind": "train", "loop": loop, "timers": len(timers),
+            "steps": sum(t.steps for t in timers)}
+
+
+def _loop_hb_add(loop, timer):
+    # register/unregister run INSIDE _HB_LOCK so a close() racing a
+    # same-label construction cannot unregister the heartbeat the new
+    # timer just registered (lock order step._HB_LOCK -> recorder's
+    # heartbeat lock; nothing takes them in reverse)
+    from .recorder import register_heartbeat
+    with _HB_LOCK:
+        group = _HB_LOOPS.get(loop)
+        if group is None:
+            group = _HB_LOOPS[loop] = _weakref.WeakSet()
+            register_heartbeat("train.%s" % loop,
+                               lambda loop=loop: _loop_heartbeat(loop))
+        group.add(timer)
+
+
+def _loop_hb_discard(loop, timer):
+    from .recorder import unregister_heartbeat
+    with _HB_LOCK:
+        group = _HB_LOOPS.get(loop)
+        if group is None:
+            return
+        group.discard(timer)
+        if len(group) == 0:
+            del _HB_LOOPS[loop]
+            unregister_heartbeat("train.%s" % loop)
+
 _PHASE_DOC = ("training-step wall time attributed per phase (self-time: "
               "nested phases subtract, so phases sum to <= step wall and "
               "the residual is honest)")
@@ -203,6 +263,41 @@ class StepTimer(object):
         else:
             from .sampling import chain_from_config
             self._retention = chain_from_config()
+        # zero-progress watchdog coverage for training loops (PR 9
+        # covered only engine workers): the timer stamps a heartbeat
+        # at step and phase boundaries, and registers the same
+        # watchdog rule shape the engines use — a fit() wedged
+        # mid-step (hung input pipeline, stuck collective, wedged
+        # dispatch) is NAMED on /alerts instead of dying silently.
+        # Shared+refcounted per loop label: concurrent fits on one
+        # label hold one rule, and the ONE ``train.<loop>`` heartbeat
+        # aggregates every live timer on the label (a wedged fit must
+        # stay visible even while a concurrent healthy fit on the same
+        # label stamps progress).  Caveat the engines share: a cold
+        # XLA compile inside a step looks identical to a hang, which
+        # is what the 30 s production default is sized to absorb.
+        self._hb_stamp = time.monotonic()
+        self._hb_name = "train.%s" % self.loop
+        self._watchdog_owner = None
+        _loop_hb_add(self.loop, self)
+        from .. import config
+        if config.get("MXNET_TELEMETRY_ALERTS"):
+            from .alerts import AlertRule, default_manager
+            # owner token unique PER TIMER: remove_owner drops exactly
+            # this timer's reference, so co-resident timers on one loop
+            # label refcount the shared rule correctly
+            owner = "train:%s:%d" % (self.loop, id(self))
+            default_manager().add_rule(AlertRule(
+                "train_%s_stalled" % self.loop, "watchdog",
+                heartbeat=self._hb_name,
+                threshold=config.get("MXNET_TELEMETRY_WATCHDOG_SECS"),
+                annotations={"loop": self.loop, "kind": "train",
+                             "summary": "training step open with zero "
+                                        "progress — wedged dispatch, "
+                                        "hung input pipeline, or stuck "
+                                        "collective"}),
+                owner=owner, shared=True)
+            self._watchdog_owner = owner
 
     def _trace_count(self):
         if self._trace_counter is not None:
@@ -226,6 +321,7 @@ class StepTimer(object):
     def begin_step(self, t0=None):
         if not self._on:
             return
+        self._hb_stamp = time.monotonic()
         self._t0 = time.perf_counter() if t0 is None else t0
         self._stack = []
         self._phase_self = {}
@@ -241,6 +337,7 @@ class StepTimer(object):
     def end_step(self, t1=None):
         if not self._on or self._t0 is None:
             return
+        self._hb_stamp = time.monotonic()
         t1 = time.perf_counter() if t1 is None else t1
         t0, self._t0 = self._t0, None
         wall = max(t1 - t0, 0.0)
@@ -294,6 +391,9 @@ class StepTimer(object):
         self._record(name, t0, t1, t1 - t0)
 
     def _record(self, name, t0, t1, self_s):
+        # phase completion IS progress: a slow-but-moving step keeps
+        # the watchdog quiet, a step stuck inside one phase does not
+        self._hb_stamp = time.monotonic()
         self._phase_self[name] = (self._phase_self.get(name, 0.0)
                                   + max(self_s, 0.0))
         self._spans.append((name, t0, t1))
@@ -344,6 +444,11 @@ class StepTimer(object):
         never closed; tests and ad-hoc timers use this."""
         if not self._on:
             return
+        _loop_hb_discard(self.loop, self)
+        if self._watchdog_owner is not None:
+            from .alerts import default_manager
+            default_manager().remove_owner(self._watchdog_owner)
+            self._watchdog_owner = None
         from . import registry
         reg = registry()
         for name in ("mxnet_train_step_seconds", "mxnet_train_steps_total",

@@ -35,6 +35,13 @@ both sides (retraces == 0 after warmup).
       # (off-on-off centered-median + same-session A/A noise floor,
       # the serve_bench/step_bench protocol; --record writes
       # BENCH_decode_telemetry.json)
+  XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+  python perf/decode_bench.py --replicas 2 --hidden 128 --layers 12 \
+      --slots 32 --fixed-len 24 --check-speedup 1.7 \
+      --record BENCH_replica.json
+      # replica-routed decode sweep (serving/replica.py): same
+      # centered-median protocol, bitwise + zero-retrace gates;
+      # writes the "decode" section of BENCH_replica.json
 
 A fast smoke variant runs in the tier-1 suite
 (tests/test_decode.py::test_decode_bench_smoke; the >=2x acceptance
@@ -51,35 +58,48 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_model(vocab=32, embed=16, hidden=32, seed=0):
-    """One LSTM decode step: token + (h, c) -> [logits, h', c']."""
+def build_model(vocab=32, embed=16, hidden=32, seed=0, layers=1):
+    """A ``layers``-deep stacked-LSTM decode step:
+    token + per-layer (h, c) -> [logits] + per-layer (h', c').
+
+    Depth is the replica sweep's compute knob (the serve_bench
+    argument): XLA CPU multi-threads one LARGE h2h matmul across every
+    core — a single replica's step then already eats the host, and
+    forced host devices fight instead of scaling — while a stack of
+    narrow cells keeps each op single-threaded, so per-step compute
+    grows with depth and the forced devices stay independent (what a
+    real one-chip-per-replica fleet looks like)."""
     import mxnet_tpu as mx
     from mxnet_tpu.rnn.rnn_cell import LSTMCell
     tok = mx.sym.Variable("token")
-    emb = mx.sym.Embedding(tok, input_dim=vocab, output_dim=embed,
+    out = mx.sym.Embedding(tok, input_dim=vocab, output_dim=embed,
                            name="emb")
-    cell = LSTMCell(hidden, prefix="lstm_")
-    out, (h2, c2) = cell(emb, [mx.sym.Variable("h"),
-                               mx.sym.Variable("c")])
-    logits = mx.sym.FullyConnected(out, num_hidden=vocab, name="out_fc")
-    step = mx.sym.Group([logits, h2, c2])
     rng = np.random.default_rng(seed)
 
     def w(*shape, scale=1.0):
         return mx.nd.array(
             rng.standard_normal(shape).astype(np.float32) * scale)
 
-    params = {
-        "emb_weight": w(vocab, embed),
-        "lstm_i2h_weight": w(4 * hidden, embed, scale=0.5),
-        "lstm_i2h_bias": mx.nd.zeros((4 * hidden,)),
-        "lstm_h2h_weight": w(4 * hidden, hidden, scale=0.5),
-        "lstm_h2h_bias": mx.nd.zeros((4 * hidden,)),
-        "out_fc_weight": w(vocab, hidden),
-        "out_fc_bias": mx.nd.zeros((vocab,)),
-    }
-    state_info = [{"name": "h", "shape": (hidden,)},
-                  {"name": "c", "shape": (hidden,)}]
+    params = {"emb_weight": w(vocab, embed)}
+    states_out, state_info = [], []
+    width = embed
+    for i in range(layers):
+        prefix = "lstm%d_" % i
+        cell = LSTMCell(hidden, prefix=prefix)
+        out, (h2, c2) = cell(out, [mx.sym.Variable(prefix + "h"),
+                                   mx.sym.Variable(prefix + "c")])
+        states_out += [h2, c2]
+        state_info += [{"name": prefix + "h", "shape": (hidden,)},
+                       {"name": prefix + "c", "shape": (hidden,)}]
+        params[prefix + "i2h_weight"] = w(4 * hidden, width, scale=0.5)
+        params[prefix + "i2h_bias"] = mx.nd.zeros((4 * hidden,))
+        params[prefix + "h2h_weight"] = w(4 * hidden, hidden, scale=0.5)
+        params[prefix + "h2h_bias"] = mx.nd.zeros((4 * hidden,))
+        width = hidden
+    logits = mx.sym.FullyConnected(out, num_hidden=vocab, name="out_fc")
+    params["out_fc_weight"] = w(vocab, hidden)
+    params["out_fc_bias"] = mx.nd.zeros((vocab,))
+    step = mx.sym.Group([logits] + states_out)
     return step, params, state_info
 
 
@@ -337,6 +357,132 @@ def run_telemetry_overhead(requests=64, slots=8, max_len=128,
     }
 
 
+def _merge_record(path, key, row):
+    """Update one section of the shared BENCH_replica.json document —
+    one implementation, owned by serve_bench (both benches write
+    sections of the same file and must never drift on its format)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from serve_bench import _merge_record as _shared
+    return _shared(path, key, row)
+
+
+def run_replica_sweep(requests=64, slots=8, max_len=128, mean_new=16,
+                      vocab=32, embed=16, hidden=128, seed=0, repeats=5,
+                      replica_counts=(1, 2), layers=1, fixed_len=None):
+    """Replica-routed decode sweep (serving/replica.py): one
+    DecodeEngine per replica count — each replica a full slot pool on
+    its own device — drained over the SAME job list, interleaved
+    best-of tokens/s per count.
+
+    Greedy decode is routing-invariant (each replica runs the same
+    program over the same params), so the sweep also asserts
+    bitwise-identical per-request tokens against the single-replica
+    engine and the per-replica zero-retrace contract.  Run under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving.decode import DecodeEngine
+
+    replica_counts = sorted(set(int(k) for k in replica_counts))
+    n_dev = jax.device_count()
+    if n_dev < max(replica_counts):
+        raise RuntimeError(
+            "replica sweep needs %d devices but only %d exist — run "
+            "under XLA_FLAGS=--xla_force_host_platform_device_count=%d"
+            % (max(replica_counts), n_dev, max(replica_counts)))
+    step, params, state_info = build_model(vocab, embed, hidden, seed,
+                                           layers=layers)
+    if fixed_len:
+        # uniform output lengths: when requests divide slots x replicas
+        # evenly, every pool refills in exact waves and BOTH engines run
+        # at full occupancy start to finish — the sweep then measures
+        # pure device scaling, not tail-occupancy effects (which the
+        # continuous-vs-static sweep's geometric mix exists to show)
+        rng = np.random.default_rng(seed + 1)
+        jobs = [([int(rng.integers(vocab))], int(fixed_len))
+                for _ in range(requests)]
+    else:
+        jobs = make_jobs(requests, mean_new, max_len, vocab, seed + 1)
+    want = sum(m for _, m in jobs)
+
+    engines, warm = {}, {}
+    for k in replica_counts:
+        eng = DecodeEngine(step, params, {}, state_info,
+                           num_slots=slots, max_len=max_len,
+                           max_queue=requests + slots * k,
+                           default_deadline_ms=0,
+                           ctx=[mx.cpu(i) for i in range(k)])
+        eng.warmup()
+        engines[k] = eng
+        warm[k] = eng.compile_count
+
+    # bitwise identity: greedy tokens must not depend on which replica
+    # a request seated on
+    base_eng = engines[replica_counts[0]]
+    base = [list(f.result(timeout=600).tokens) for f in
+            [base_eng.submit(p, max_new_tokens=m) for p, m in jobs]]
+    bitwise = True
+    for k in replica_counts[1:]:
+        futs = [engines[k].submit(p, max_new_tokens=m)
+                for p, m in jobs]
+        got = [list(f.result(timeout=600).tokens) for f in futs]
+        if got != base:
+            bitwise = False
+
+    # Estimator: the shared base-K-base centered-triple protocol
+    # (serve_bench.centered_sweep — one implementation, so the two
+    # BENCH_replica.json sections stay comparable).
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from serve_bench import centered_sweep
+
+    def timed(k):
+        tokens, dt = continuous_round(engines[k], jobs)
+        if tokens != want:
+            raise RuntimeError("token accounting mismatch at "
+                               "%d replicas: want %d got %d"
+                               % (k, want, tokens))
+        return tokens / dt
+
+    best, speedups = centered_sweep(replica_counts, timed, repeats)
+
+    rows, retraces_total = [], 0
+    for k in replica_counts:
+        eng = engines[k]
+        retraces = eng.compile_count - warm[k]
+        retraces_total += retraces
+        st = eng.stats()["decode"]
+        row = {
+            "replicas": k,
+            "tokens_per_s": round(best[k], 1),
+            "retraces": retraces,
+            "steps": st["steps"],
+            "step_p50_ms": st["step_ms"]["p50"],
+        }
+        if k != replica_counts[0]:
+            row["speedup_vs_1"] = round(speedups[k], 2)
+            row["speedup_best_of"] = round(
+                best[k] / best[replica_counts[0]], 2)
+        rows.append(row)
+        eng.close()
+    return {
+        "requests": requests,
+        "slots_per_replica": slots,
+        "hidden": hidden, "layers": layers,
+        "mean_new": mean_new, "fixed_len": fixed_len,
+        "tokens": want,
+        "rounds": max(1, repeats),
+        "estimator": "centered-median (base-K-base triples)",
+        "device_count": n_dev,
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "bitwise_identical": bitwise,
+        "retraces": retraces_total,
+        "speedup": rows[-1].get("speedup_vs_1", 1.0),
+        "rows": rows,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="continuous-batching decode throughput bench")
@@ -347,6 +493,15 @@ def main(argv=None):
                     help="mean of the geometric output-length draw")
     ap.add_argument("--vocab", type=int, default=32)
     ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--fixed-len", type=int, default=None,
+                    help="replica sweep: uniform output length instead "
+                         "of the geometric draw (exact refill waves — "
+                         "measures device scaling, not tail effects)")
+    ap.add_argument("--layers", type=int, default=1,
+                    help="stacked LSTM depth (replica sweep: depth "
+                         "raises per-step compute without widening any "
+                         "single op past XLA CPU's intra-op "
+                         "parallelization threshold)")
     ap.add_argument("--repeat", type=int, default=4,
                     help="interleaved best-of-N rounds (scheduling is "
                          "deterministic; repeats absorb host noise)")
@@ -365,10 +520,47 @@ def main(argv=None):
     ap.add_argument("--no-http", action="store_true",
                     help="telemetry gate without the HTTP server + "
                          "scraper (registry-only overhead)")
+    ap.add_argument("--replicas", metavar="N[,M...]",
+                    help="run the replica-routed decode sweep instead: "
+                         "one engine per replica count (needs that "
+                         "many devices; XLA_FLAGS=--xla_force_host_"
+                         "platform_device_count=N), interleaved "
+                         "best-of tokens/s, records the decode section "
+                         "of BENCH_replica.json via --record")
     ap.add_argument("--record", metavar="PATH",
                     help="append the result row to this JSON file "
                          "(BENCH_*.json bookkeeping)")
     args = ap.parse_args(argv)
+
+    if args.replicas:
+        counts = sorted({1} | {int(t) for t in args.replicas.split(",")
+                               if t.strip()})
+        row = run_replica_sweep(
+            requests=args.requests, slots=args.slots,
+            max_len=args.max_len, mean_new=args.mean_new,
+            vocab=args.vocab, hidden=args.hidden,
+            repeats=args.repeat, replica_counts=counts,
+            layers=args.layers, fixed_len=args.fixed_len)
+        print(json.dumps(row))
+        if args.record:
+            _merge_record(args.record, "decode", row)
+        if row["retraces"]:
+            print("FAIL: %d post-warmup retraces (compile-once "
+                  "contract, per replica)" % row["retraces"])
+            return 1
+        if not row["bitwise_identical"]:
+            print("FAIL: multi-replica decode diverged from the "
+                  "single-replica engine")
+            return 1
+        if args.check_speedup is not None:
+            if row["speedup"] < args.check_speedup:
+                print("FAIL: %d-replica speedup %.2fx < required %.2fx"
+                      % (counts[-1], row["speedup"],
+                         args.check_speedup))
+                return 1
+            print("OK: %d-replica speedup %.2fx >= %.2fx"
+                  % (counts[-1], row["speedup"], args.check_speedup))
+        return 0
 
     if args.telemetry:
         row = run_telemetry_overhead(

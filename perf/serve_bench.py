@@ -21,6 +21,12 @@ contract demands retraces == 0).
                                                  # speedup < 3x
   python perf/serve_bench.py --telemetry         # exit 1 if telemetry
                                                  # costs >= 2% rps
+  XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+  python perf/serve_bench.py --replicas 2 --hidden 512 --layers 8 \
+      --check-speedup 1.7 --record BENCH_replica.json
+      # data-parallel replica sweep (serving/replica.py): drain rounds,
+      # centered-median base-K-base triples, bitwise + zero-retrace
+      # gates; writes the "serve" section of BENCH_replica.json
 
 A fast smoke variant runs in the tier-1 suite
 (tests/test_serving.py::test_serve_bench_smoke; the telemetry-overhead
@@ -38,22 +44,34 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_model(feature=512, hidden=1024, classes=10, seed=0):
+def build_model(feature=512, hidden=1024, classes=10, seed=0, layers=1):
+    """An MLP with ``layers`` hidden layers.  Depth is the replica
+    sweep's compute knob: XLA CPU multi-threads one LARGE matmul
+    across the host's cores (so a single dispatch already eats the
+    machine and forced host devices share it), but a stack of
+    medium matmuls runs each op near-single-threaded — per-request
+    compute scales with depth while the forced devices stay
+    independent, which is what a real one-chip-per-replica fleet
+    looks like."""
     import mxnet_tpu as mx
-    net = mx.sym.FullyConnected(mx.sym.Variable("data"),
-                                num_hidden=hidden, name="fc1")
-    net = mx.sym.Activation(net, act_type="relu", name="relu1")
-    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
-    net = mx.sym.SoftmaxOutput(net, name="softmax")
     rng = np.random.default_rng(seed)
-    params = {
-        "fc1_weight": mx.nd.array(
-            rng.standard_normal((hidden, feature)).astype(np.float32)),
-        "fc1_bias": mx.nd.zeros((hidden,)),
-        "fc2_weight": mx.nd.array(
-            rng.standard_normal((classes, hidden)).astype(np.float32)),
-        "fc2_bias": mx.nd.zeros((classes,)),
-    }
+    params = {}
+    net = mx.sym.Variable("data")
+    width = feature
+    for i in range(layers):
+        name = "fc%d" % (i + 1)
+        net = mx.sym.FullyConnected(net, num_hidden=hidden, name=name)
+        net = mx.sym.Activation(net, act_type="relu",
+                                name="relu%d" % (i + 1))
+        params[name + "_weight"] = mx.nd.array(
+            rng.standard_normal((hidden, width)).astype(np.float32))
+        params[name + "_bias"] = mx.nd.zeros((hidden,))
+        width = hidden
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc_out")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    params["fc_out_weight"] = mx.nd.array(
+        rng.standard_normal((classes, width)).astype(np.float32))
+    params["fc_out_bias"] = mx.nd.zeros((classes,))
     return net, params
 
 
@@ -265,6 +283,178 @@ def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
     }
 
 
+def centered_sweep(counts, run_one, repeats):
+    """The replica-sweep estimator, shared by serve_bench and
+    decode_bench (one implementation so BENCH_replica.json's two
+    sections stay comparable): each repeat times a base-K-base
+    centered TRIPLE — the telemetry-gate protocol, reused because it
+    is the only estimator this shared host supports.  The
+    multi-replica round is sandwiched between two base rounds and its
+    ratio taken against their mean; centering cancels linear host
+    drift inside the triple and the median across repeats discards
+    bursty outliers (a best-of-each-side comparison would hand the
+    gate to whichever side caught the quietest host window).
+
+    ``run_one(k)`` returns a throughput-like scalar (HIGHER is
+    better).  Returns ``(best, speedups)``: the best observed
+    throughput per count, and the median centered ratio per non-base
+    count.
+    """
+    import statistics
+    counts = list(counts)
+    base_k = counts[0]
+    best = {k: 0.0 for k in counts}
+    ratios = {k: [] for k in counts[1:]}
+    for _ in range(max(1, int(repeats))):
+        base_a = run_one(base_k)
+        mids = {k: run_one(k) for k in counts[1:]}
+        base_b = run_one(base_k)
+        best[base_k] = max(best[base_k], base_a, base_b)
+        for k, v in mids.items():
+            best[k] = max(best[k], v)
+            ratios[k].append(v / ((base_a + base_b) / 2.0))
+    return best, {k: statistics.median(v) for k, v in ratios.items()}
+
+
+def _merge_record(path, key, row):
+    """Update one section of a shared BENCH_*.json document (the
+    replica sweep writes serve and decode sections from two benches)."""
+    doc = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except Exception:
+            doc = {}
+    if not isinstance(doc, dict):
+        doc = {}
+    doc[key] = row
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def run_replica_sweep(requests=512, offered_batch=8, feature=512,
+                      hidden=1024, classes=10, batch_timeout_ms=2.0,
+                      repeats=5, replica_counts=(1, 2), layers=1):
+    """Data-parallel replica routing sweep (serving/replica.py): one
+    engine per replica count over the same frozen model and request
+    stream, offered the same closed-loop load.
+
+    Run under ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+    on a CPU host (each replica needs its own device; on a CPU host
+    also pass ``--xla_cpu_multi_thread_eigen=false`` so each forced
+    "device" computes on one thread — without it a single dispatch
+    multi-threads across every core and the forced devices are not
+    independent hardware, which is the thing being simulated).  Rounds
+    are deep-backlog DRAIN rounds (submit everything, wait for all
+    futures — the regime replica routing exists for), INTERLEAVED
+    across replica counts with each count reporting its best round —
+    the serve_bench idiom: noisy-neighbor minutes hit every count
+    instead of deciding the scaling gate.  The row also records
+    bitwise identity of multi-replica responses against the
+    single-replica engine (same params, same program, whichever
+    replica dispatched) and the per-replica zero-retrace contract.
+    ``offered_batch`` is kept for the row's metadata only.
+    """
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    replica_counts = sorted(set(int(k) for k in replica_counts))
+    n_dev = jax.device_count()
+    if n_dev < max(replica_counts):
+        raise RuntimeError(
+            "replica sweep needs %d devices but only %d exist — run "
+            "under XLA_FLAGS=--xla_force_host_platform_device_count=%d"
+            % (max(replica_counts), n_dev, max(replica_counts)))
+    net, params = build_model(feature, hidden, classes, layers=layers)
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((requests, feature)).astype(np.float32)
+
+    engines = {}
+    for k in replica_counts:
+        eng = serving.ServingEngine(
+            net, params, {}, {"data": (feature,)},
+            ctx=[mx.cpu(i) for i in range(k)],
+            max_queue=2 * requests + 16,
+            batch_timeout_ms=batch_timeout_ms)
+        engines[k] = [eng, eng.warmup()]
+
+    # bitwise identity: every replica serves the same program over the
+    # same params, so responses must not depend on the routing
+    # decision.  Submits go in groups of exactly max_batch so every
+    # engine coalesces identical bucket-8 batches — bucket COMPOSITION
+    # is the one legitimate source of float divergence (a bucket-4
+    # program is a different XLA program), and it must not differ
+    # between the engines under comparison.
+    group = 8
+    def _grouped(eng, n):
+        out = []
+        for lo in range(0, n, group):
+            futs = [eng.submit(X[i])
+                    for i in range(lo, min(lo + group, n))]
+            out.extend(f.result(timeout=120) for f in futs)
+        return out
+    n_check = min(64, requests)
+    base = _grouped(engines[replica_counts[0]][0], n_check)
+    bitwise = True
+    for k in replica_counts[1:]:
+        got = _grouped(engines[k][0], n_check)
+        if not all(np.array_equal(b, g) for b, g in zip(base, got)):
+            bitwise = False
+
+    def drain_round(eng):
+        """Deep backlog: submit every request up front, drain all
+        futures.  One submitting thread — measured throughput is the
+        engine+device pipeline's, not 32 client threads' GIL churn."""
+        t0 = time.perf_counter()
+        futs = [eng.submit(X[i]) for i in range(requests)]
+        for f in futs:
+            f.result(timeout=600)
+        return time.perf_counter() - t0
+
+    best, speedups = centered_sweep(
+        replica_counts,
+        lambda k: requests / drain_round(engines[k][0]), repeats)
+
+    base_k = replica_counts[0]
+    rows, retraces_total = [], 0
+    for k in replica_counts:
+        eng, warm = engines[k]
+        st = eng.stats()
+        retraces = eng.compile_count - warm
+        retraces_total += retraces
+        row = {
+            "replicas": k,
+            "rps": round(best[k], 1),
+            "warmup_compiles": warm,
+            "retraces": retraces,
+            "batch_occupancy": round(st["batch_occupancy"], 3),
+            "batches_per_replica": [r["batches"]
+                                    for r in st["replicas"]],
+            "p99_ms": round(st["latency_ms"]["p99"], 2),
+        }
+        if k != base_k:
+            row["speedup_vs_1"] = round(speedups[k], 2)
+            row["speedup_best_of"] = round(best[k] / best[base_k], 2)
+        rows.append(row)
+        eng.close()
+    return {
+        "requests": requests,
+        "offered_batch": offered_batch,
+        "feature": feature, "hidden": hidden, "layers": layers,
+        "rounds": repeats,
+        "estimator": "centered-median (base-K-base triples)",
+        "device_count": n_dev,
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "bitwise_identical": bitwise,
+        "retraces": retraces_total,
+        "speedup": rows[-1].get("speedup_vs_1", 1.0),
+        "rows": rows,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=512)
@@ -274,6 +464,11 @@ def main():
     ap.add_argument("--feature", type=int, default=512)
     ap.add_argument("--hidden", type=int, default=1024)
     ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--layers", type=int, default=1,
+                    help="hidden MLP layers (replica sweep: depth "
+                         "raises per-request compute without widening "
+                         "any single op past XLA CPU's intra-op "
+                         "parallelization threshold)")
     ap.add_argument("--window-ms", type=float, default=2.0)
     ap.add_argument("--repeats", type=int, default=3,
                     help="time each path this many times, best wins")
@@ -292,10 +487,48 @@ def main():
     ap.add_argument("--no-http", action="store_true",
                     help="telemetry gate without the HTTP server + "
                          "scraper (registry-only overhead)")
+    ap.add_argument("--replicas", metavar="N[,M...]",
+                    help="run the data-parallel replica sweep instead "
+                         "of the serial-vs-engine sweep: one engine "
+                         "per replica count (needs that many devices; "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=N), interleaved best-of rounds, "
+                         "records the serve section of "
+                         "BENCH_replica.json via --record")
     ap.add_argument("--record", metavar="PATH",
                     help="append/write the telemetry-gate result row "
                          "to this JSON file (BENCH_*.json bookkeeping)")
     args = ap.parse_args()
+
+    if args.replicas:
+        counts = sorted({1} | {int(t) for t in args.replicas.split(",")
+                               if t.strip()})
+        row = run_replica_sweep(
+            requests=args.requests,
+            offered_batch=(args.offered or [8])[-1],
+            feature=args.feature, hidden=args.hidden,
+            classes=args.classes, batch_timeout_ms=args.window_ms,
+            repeats=args.repeats, replica_counts=counts,
+            layers=args.layers)
+        print(json.dumps(row))
+        if args.record:
+            _merge_record(args.record, "serve", row)
+        if row["retraces"]:
+            print("FAIL: %d post-warmup retraces (compile-once "
+                  "contract, per replica)" % row["retraces"])
+            sys.exit(1)
+        if not row["bitwise_identical"]:
+            print("FAIL: multi-replica responses diverged from the "
+                  "single-replica engine")
+            sys.exit(1)
+        if args.check_speedup is not None:
+            if row["speedup"] < args.check_speedup:
+                print("FAIL: %d-replica speedup %.2fx < required %.2fx"
+                      % (counts[-1], row["speedup"], args.check_speedup))
+                sys.exit(1)
+            print("OK: %d-replica speedup %.2fx >= %.2fx"
+                  % (counts[-1], row["speedup"], args.check_speedup))
+        return
 
     if args.telemetry:
         row = run_telemetry_overhead(

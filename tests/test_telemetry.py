@@ -268,7 +268,7 @@ def test_serving_telemetry_acceptance(monkeypatch, tmp_path, capsys):
     assert vals["mxnet_serve_admitted_total"] == st["admitted"] == len(X)
     assert vals["mxnet_serve_requests_total"] == len(X)
     assert vals["mxnet_serve_batches_total"] == st["batches"]
-    assert vals['mxnet_serve_retraces_total{engine="%s",hazards="none"}'
+    assert vals['mxnet_serve_retraces_total{engine="%s",replica="0",hazards="none"}'
                 % el] == st["retraces"] == 0
     assert vals['mxnet_serve_program_cache_hits{engine="%s"}' % el] \
         == st["program_cache"]["hits"]
@@ -333,7 +333,7 @@ def test_runtime_retrace_counted_under_hazard_label(monkeypatch):
     el = eng._tm.engine_label
     eng.close()
     assert st["retraces"] == 1
-    assert vals['mxnet_serve_retraces_total{engine="%s",hazards="none"}'
+    assert vals['mxnet_serve_retraces_total{engine="%s",replica="0",hazards="none"}'
                 % el] == 1
     assert vals["mxnet_serve_compiles_total"] == st["compile_count"]
     vals2 = _prom_values(telemetry.render_prometheus())

@@ -18,6 +18,7 @@ alias), which scrapes ``/metrics.json`` off a running
   python tools/telemetry_dump.py alerts --url http://host:9100
   python tools/telemetry_dump.py history --series mxnet_serve_requests_total \
       --window 60 --url http://host:9100
+  python tools/telemetry_dump.py healthz --url http://host:9100
   python tools/telemetry_dump.py bundle /var/flight/flight_*.json
 
 ``snapshot`` prints one line per series with histogram count/mean/max
@@ -450,6 +451,53 @@ def format_history(doc):
     return "\n".join(lines)
 
 
+def format_healthz(doc):
+    """Render one ``GET /healthz`` document: the liveness scalars, the
+    decode and alert blocks when present, and the per-replica block
+    (serving/replica.py) as a table — health, in-flight load, traffic,
+    and failure counts per device replica of every engine."""
+    lines = ["status=%s  uptime=%.1fs  engines=%s  queue_depth=%s  "
+             "batch_occupancy=%s"
+             % (doc.get("status"), doc.get("uptime_s", 0.0),
+                doc.get("engines"), doc.get("queue_depth"),
+                _num(doc.get("batch_occupancy")))]
+    dec = doc.get("decode")
+    if dec:
+        lines.append(
+            "decode: engines=%s slots=%s occupied=%s tokens=%s "
+            "steps=%s evictions=%s"
+            % (dec.get("engines"), dec.get("slots"),
+               dec.get("slots_occupied"), dec.get("tokens"),
+               dec.get("steps"), dec.get("evictions")))
+    reps = doc.get("replicas")
+    if reps:
+        lines.append("replicas: %d total, %d unhealthy"
+                     % (reps.get("total", 0), reps.get("unhealthy", 0)))
+        lines.append("  %-8s %-8s %-9s %9s %9s %9s %9s"
+                     % ("engine", "replica", "healthy", "inflight",
+                        "batches", "occupied", "failures"))
+        for eng in sorted(reps.get("engines", {})):
+            for row in reps["engines"][eng]:
+                lines.append(
+                    "  %-8s %-8s %-9s %9s %9s %9s %9s"
+                    % (eng, row.get("replica"),
+                       "ok" if row.get("healthy") else "UNHEALTHY",
+                       row.get("inflight", "-"),
+                       row.get("batches", "-"),
+                       row.get("slots_occupied", "-"),
+                       row.get("failures", "-")))
+    al = doc.get("alerts")
+    if al:
+        lines.append("alerts: %s rule(s), %s firing%s"
+                     % (al.get("rules"), al.get("firing"),
+                        "" if al.get("evaluating") else
+                        "  [WARNING: nothing evaluating]"))
+    if doc.get("train_steps") is not None:
+        lines.append("train_steps=%s  mfu=%s"
+                     % (doc.get("train_steps"), doc.get("train_mfu")))
+    return "\n".join(lines)
+
+
 def format_bundle(doc, stacks=True):
     """Render one flight-recorder bundle as a post-mortem narrative."""
     lines = ["flight bundle: %s" % doc.get("reason"),
@@ -561,6 +609,10 @@ def main(argv=None):
     p_hist.add_argument("--q", type=float,
                         help="windowed quantile for histogram series")
     _add_source(p_hist)
+    p_hz = sub.add_parser(
+        "healthz", help="render a /healthz document (liveness, decode "
+                        "block, per-replica health table)")
+    _add_source(p_hz)
     p_bun = sub.add_parser(
         "bundle", help="read a black-box flight-recorder bundle "
                        "(post-mortem narrative)")
@@ -615,6 +667,23 @@ def main(argv=None):
             print("history: %s" % doc["error"], file=sys.stderr)
             return 1
         print(format_history(doc))
+        return 0
+
+    if args.cmd == "healthz":
+        src = _resolve_source(args, "healthz snapshot file")
+        if src is None:
+            return 2
+        if src.startswith("http://") or src.startswith("https://"):
+            from urllib.parse import urlparse
+            if urlparse(src).path in ("", "/"):
+                src = src.rstrip("/") + "/healthz"
+        doc = load_doc(src)
+        if "text" in doc:
+            print("healthz needs a JSON source", file=sys.stderr)
+            return 2
+        if "status" not in doc and "status" in doc.get("metrics", {}):
+            doc = doc["metrics"]    # load_doc normalized a bare healthz doc
+        print(format_healthz(doc))
         return 0
 
     if args.cmd == "bundle":
