@@ -276,6 +276,35 @@ VARIABLES = {v.name: v for v in [
          "a dispatch in flight) yet stamped no progress for this many "
          "seconds fires <kind>_engine<N>_stalled — a wedged dispatch "
          "or starved queue, named, not inferred."),
+    _Var("MXNET_AOT_CACHE_DIR", str, "",
+         "Persistent AOT program-cache directory (serving/aot_cache.py)."
+         "  When set, every serving program — one-shot bucket programs, "
+         "decode step programs, prefill buckets, slot-row scatter "
+         "kernels — is serialized (jax.export) to a content-addressed "
+         "entry under this directory at first compile, and a restarted "
+         "engine (or replica N+1 joining under load) loads the entry "
+         "instead of retracing: warm restarts perform ZERO traces for "
+         "previously-served buckets.  Entries are keyed by graph "
+         "canonical form x input shapes/dtypes x policy x sharding x "
+         "backend platform; corruption or fingerprint drift (jax/"
+         "library version, analysis-verdict digest) REJECTS the entry "
+         "and falls back to a fresh compile — never a stale program.  "
+         "Empty = off (process-lifetime compilation, exactly the "
+         "pre-cache behavior).  Manage with tools/aot_cache.py "
+         "(list/verify/prune)."),
+    _Var("MXNET_AOT_CACHE", bool, True,
+         "Master switch for the persistent AOT program cache: 0 "
+         "disables it even when MXNET_AOT_CACHE_DIR is set (kill "
+         "switch for a corrupt or slow shared cache volume)."),
+    _Var("MXNET_AOT_XLA_CACHE", bool, False,
+         "Also point jax's persistent compilation cache at "
+         "MXNET_AOT_CACHE_DIR/xla (first engine wins; process-global)."
+         "  The AOT entries skip Python tracing; this knob "
+         "additionally skips XLA's compile of the deserialized "
+         "module, so a warm restart loads executables instead of "
+         "building them.  Off by default: it flips process-wide jax "
+         "config (cache thresholds included), which a library should "
+         "only do when asked."),
     _Var("MXNET_FLIGHT_RECORDER_DIR", str, "",
          "Black-box post-mortem directory.  When set, any alert "
          "transition to firing (watchdog trips included) atomically "

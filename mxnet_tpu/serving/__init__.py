@@ -13,8 +13,11 @@ reference stops at the single-client C predict API).  Five parts:
   cache (CachedOp-backed, with a compile counter);
 - :mod:`.admission` — bounded queue, deadlines, overload shedding;
 - :mod:`.replica`   — data-parallel device replicas for both engines:
-  least-loaded routing, decode pinning, replica failover
-  (``MXNET_SERVE_REPLICAS``).
+  least-loaded routing, decode pinning, replica failover and
+  probation re-warm (``MXNET_SERVE_REPLICAS``);
+- :mod:`.aot_cache` — persistent content-addressed AOT program cache
+  (``MXNET_AOT_CACHE_DIR``): restarts and replica scale-ups load
+  compiled programs from disk instead of retracing.
 
 Quick start::
 
@@ -29,6 +32,7 @@ from .admission import (AdmissionController, Request, QueueFullError,
                         DeadlineExceededError, ServerOverloadError,
                         EngineClosedError)
 from .buckets import BucketPolicy, ProgramCache, pad_valid_lengths
+from .aot_cache import AOTCache
 from .replica import (ServeReplica, DecodeReplica, replica_contexts)
 from .engine import ServingEngine
 from .decode import (DecodeEngine, DecodeResult, StepProgram,
@@ -36,7 +40,7 @@ from .decode import (DecodeEngine, DecodeResult, StepProgram,
                      TemperatureSampler)
 
 __all__ = ["ServingEngine", "BucketPolicy", "ProgramCache",
-           "pad_valid_lengths",
+           "AOTCache", "pad_valid_lengths",
            "DecodeEngine", "DecodeResult", "StepProgram",
            "greedy_decode",
            "Sampler", "GreedySampler", "TemperatureSampler",

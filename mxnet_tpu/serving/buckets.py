@@ -140,9 +140,21 @@ class ProgramCache(object):
     """
 
     def __init__(self, symbol, arg_params, aux_params, data_names,
-                 ctx=None, dtype=np.float32):
+                 ctx=None, dtype=np.float32, aot=None, aot_kind="serve"):
         from ..context import cpu
         self._ctx = ctx or cpu()
+        # persistent AOT program cache (serving/aot_cache.py): when the
+        # engine hands one in, every bucket program resolves through it
+        # — a warm entry loads with ZERO traces, a cold one compiles
+        # through jax.export and is persisted for the next process (or
+        # the next replica).  The graph digest is computed once here;
+        # per-signature keys fold in the flat argument signature.
+        self._aot = aot if (aot is not None and aot.enabled) else None
+        self._aot_kind = aot_kind
+        self._graph_digest = None
+        if self._aot is not None:
+            from .aot_cache import graph_digest
+            self._graph_digest = graph_digest(symbol)
         self._sym = symbol
         self._dtype = np.dtype(dtype)
         self.data_names = list(data_names)
@@ -185,6 +197,7 @@ class ProgramCache(object):
         self._plans = {}         # full data-shape key -> prefilled flat
         self._keys = set()       # bucket signatures dispatched so far
         self._lock = threading.Lock()
+        self._build_lock = threading.Lock()   # plan construction only
         # plan-cache traffic counters: plain ints (only the single
         # worker + pre-start warmup touch them), mirrored into the
         # telemetry registry by the engine's collect callback and
@@ -204,36 +217,64 @@ class ProgramCache(object):
         with self._lock:
             return sorted(self._keys)
 
-    def _plan_for(self, shape_key, data_shapes):
+    def _plan_for(self, shape_key, data_specs):
         """Prefilled flat-input list + kernel + rng key for one bucket
         signature: everything per-dispatch work can reuse verbatim.
         Built once per signature under the lock; dispatches only copy
-        the list and fill the data slots."""
-        plan = self._plans.get(shape_key)
-        if plan is None:
-            with self._lock:
-                plan = self._plans.get(shape_key)
-                if plan is None:
-                    flat = list(self._template)
-                    if self._label_names:
-                        import jax.numpy as jnp
-                        shapes = _infer_label_shapes(
-                            self._sym, data_shapes, self._label_names)
-                        for n, pos in self._label_pos.items():
-                            flat[pos] = jnp.zeros(shapes[n], jnp.float32)
-                    # deterministic graphs can freeze the (dead) rng key
-                    # into the plan; stochastic ones must fold a fresh
-                    # key per dispatch or every batch on this bucket
-                    # replays identical draws
-                    key = (None if self._op._graph_fn.stochastic
-                           else self._op._key())
-                    plan = (flat, self._op._get_jit(False), key,
-                            sorted(self._data_pos.items()))
+        the list and fill the data slots.  ``data_specs`` maps data
+        name -> (shape, dtype) — the dtype half keys the AOT cache."""
+        # builds serialize on their own lock so the (possibly
+        # multi-second, on cold AOT misses: jax.export trace + fsync'd
+        # store) kernel resolution never holds self._lock — a stats()
+        # scrape or flight-recorder dump reading bucket_keys must not
+        # block behind a compile
+        with self._build_lock:
+            plan = self._plans.get(shape_key)
+            if plan is None:
+                flat = list(self._template)
+                if self._label_names:
+                    import jax.numpy as jnp
+                    shapes = _infer_label_shapes(
+                        self._sym,
+                        {k: s for k, (s, _d) in data_specs.items()},
+                        self._label_names)
+                    for n, pos in self._label_pos.items():
+                        flat[pos] = jnp.zeros(shapes[n], jnp.float32)
+                # deterministic graphs can freeze the (dead) rng key
+                # into the plan; stochastic ones must fold a fresh
+                # key per dispatch or every batch on this bucket
+                # replays identical draws
+                key = (None if self._op._graph_fn.stochastic
+                       else self._op._key())
+                kernel = self._resolve_kernel(data_specs, flat)
+                plan = (flat, kernel, key,
+                        sorted(self._data_pos.items()))
+                with self._lock:
                     self._plans[shape_key] = plan
                     self._keys.add(shape_key)
         return plan
 
-    def run(self, feeds, _record=True):
+    def _resolve_kernel(self, data_specs, flat):
+        """The dispatch kernel for one bucket signature: the CachedOp's
+        jit program, resolved through the persistent AOT cache when the
+        engine configured one — a warm entry deserializes with zero
+        traces (``compile_count`` is pinned across a restart), a cold
+        one compiles through jax.export and persists for the next
+        process or replica."""
+        jit_fn = self._op._get_jit(False)
+        if self._aot is None:
+            return jit_fn
+        import jax
+        from .aot_cache import resolve_kernel
+        args = [jax.random.PRNGKey(0)] + list(flat)
+        for n, pos in self._data_pos.items():
+            shape, dt = data_specs[n]
+            args[1 + pos] = jax.ShapeDtypeStruct(shape, np.dtype(dt))
+        kernel, _src = resolve_kernel(
+            self._aot, jit_fn, self._aot_kind, self._graph_digest, args)
+        return kernel
+
+    def run(self, feeds, _record=True, _fixed_key=None):
         """Dispatch one padded batch: ``feeds`` maps data name -> host
         ndarray WITH batch dim, already padded to bucket shapes.
         Returns the outputs as host ndarrays (still batch-padded).
@@ -246,18 +287,24 @@ class ProgramCache(object):
 
         ``_record=False`` skips the hit/miss counters — the pad probe's
         second dispatch of the SAME logical batch must not make the
-        accounting read two dispatches."""
+        accounting read two dispatches.  ``_fixed_key`` overrides the
+        rng key (replica probation: two caches' probe dispatches must
+        draw identically even for stochastic graphs, whose per-cache
+        key streams would otherwise never agree bitwise)."""
         shape_key = tuple(sorted((k, v.shape) for k, v in feeds.items()))
         plan = self._plans.get(shape_key)
         if plan is None:
             if _record:
                 self.plan_misses += 1
             plan = self._plan_for(
-                shape_key, {k: tuple(v.shape) for k, v in feeds.items()})
+                shape_key, {k: (tuple(v.shape), v.dtype)
+                            for k, v in feeds.items()})
         elif _record:
             self.plan_hits += 1
         template, kernel, key, data_pos = plan
-        if key is None:
+        if _fixed_key is not None:
+            key = _fixed_key
+        elif key is None:
             key = self._op._key()       # stochastic graph: fresh draws
         flat = list(template)
         for n, pos in data_pos:

@@ -76,7 +76,7 @@ from .. import telemetry as _telemetry
 from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
 from .buckets import ProgramCache, _next_pow2
-from .engine import _ENGINE_SEQ, _percentile
+from .engine import _ENGINE_SEQ, _percentile, aot_metric_families
 from .replica import DecodeReplica, replica_contexts
 
 __all__ = ["DecodeEngine", "DecodeResult", "StepProgram", "greedy_decode",
@@ -235,13 +235,14 @@ class StepProgram(object):
     def __init__(self, step_sym, arg_params, aux_params, state_info,
                  num_slots, token_name="token", pos_name="pos",
                  valid_name="valid", ctx=None, dtype=np.float32,
-                 sampler=None):
+                 sampler=None, aot=None):
         import jax
         import jax.numpy as jnp
         from ..context import cpu
         from ..executor import build_graph_fn, _count_xla_trace
         from .. import symbol as sym
         self._ctx = ctx or cpu()
+        self._aot = aot if (aot is not None and aot.enabled) else None
         self.num_slots = int(num_slots)
         self._dtype = np.dtype(dtype)
         self.sampler = sampler if sampler is not None else GreedySampler()
@@ -335,7 +336,29 @@ class StepProgram(object):
             # honor donation and would warn per compile).  Offsets
             # skip the (key, tick, reset) leading args.
             donate = tuple(3 + order.index(n) for n in self.state_names)
-        self._kernel = jax.jit(call, donate_argnums=donate)
+        # the persistent step kernel resolves lazily at the first step
+        # when an AOT cache is configured (serving/aot_cache.py): a
+        # warm entry deserializes with zero traces — the compiled
+        # decode step of arxiv 2603.09555 is never compiled twice for
+        # the same (graph, pool geometry, sampler policy, backend) —
+        # while a cold one compiles through jax.export (the one trace
+        # that would have happened anyway) and persists.  Donation
+        # does NOT survive the round trip on its own, so the donate
+        # spec is re-applied on the jit wrapper around the exported
+        # program (resolve_kernel donate_argnums) — the in-place HBM
+        # slot-pool update must hold whether the program was traced
+        # fresh or loaded from disk.
+        self._jit_kernel = jax.jit(call, donate_argnums=donate)
+        self._donate = donate
+        self._kernel = None if self._aot is not None else self._jit_kernel
+        # the lazy resolution can be reached from two threads at once
+        # (the replica scheduler's first step racing a rehab probe on
+        # this program): serialize it so exactly one trace happens
+        self._kernel_lock = threading.Lock()
+        self._graph_digest = None
+        if self._aot is not None:
+            from .aot_cache import graph_digest
+            self._graph_digest = graph_digest(self._serve_sym)
         self._tick = 0          # per-step sample counter (stochastic
         #                         samplers fold it into the key; dead
         #                         and DCE'd under the greedy head)
@@ -352,8 +375,13 @@ class StepProgram(object):
             return buf.at[idx].set(row)
 
         # one trace per distinct state shape; the slot index is a
-        # traced scalar so churn across slots never retraces
-        self._set_row = jax.jit(set_row)
+        # traced scalar so churn across slots never retraces.  With an
+        # AOT cache the per-shape kernels resolve through it too —
+        # warmup()'s row-write traces must also pin to zero on a warm
+        # restart, or the "0 compiles for previously-served buckets"
+        # contract would leak through the scatter path.
+        self._set_row_jit = jax.jit(set_row)
+        self._row_kernels = {}
         self._jnp = jnp
 
     @property
@@ -376,6 +404,46 @@ class StepProgram(object):
                                 dtype=dt), dev)
         return out
 
+    def _row_kernel(self, buf, idx, row):
+        """The row-scatter kernel for one (buffer, row) signature,
+        resolved through the AOT cache when one is configured.  The
+        graph component is a fixed tag — ``buf.at[idx].set(row)`` is
+        the same program whatever engine asks — so entries are shared
+        across engines and model architectures."""
+        if self._aot is None:
+            return self._set_row_jit
+        sig = (tuple(buf.shape), str(np.dtype(buf.dtype)),
+               tuple(np.shape(row)),
+               str(np.dtype(getattr(row, "dtype", None)
+                            or np.asarray(row).dtype)))
+        kernel = self._row_kernels.get(sig)
+        if kernel is None:
+            from .aot_cache import resolve_kernel
+            kernel, _src = resolve_kernel(
+                self._aot, self._set_row_jit, "decode_set_row",
+                "jnp_at_set_v1", [buf, idx, row], universal=True)
+            self._row_kernels[sig] = kernel
+        return kernel
+
+    def _ensure_kernel(self, reset, flat):
+        """Resolve the persistent step kernel at the first dispatch
+        (the argument avals are only concrete here): AOT-cache hit
+        loads the serialized program with zero traces; a miss compiles
+        once through jax.export and persists it.  Double-checked under
+        a lock: the scheduler's first step and a rehab probe may race
+        here, and exactly one resolution must win."""
+        if self._kernel is None:
+            with self._kernel_lock:
+                if self._kernel is None:
+                    from .aot_cache import resolve_kernel
+                    kernel, _src = resolve_kernel(
+                        self._aot, self._jit_kernel, "decode_step",
+                        self._graph_digest,
+                        [self._key, np.int32(0), reset] + list(flat),
+                        donate_argnums=self._donate)
+                    self._kernel = kernel
+        return self._kernel
+
     def write_row(self, states, slot, rows):
         """Scatter per-slot state rows (host or device arrays) into
         ``slot`` of every buffer named in ``rows``; returns the updated
@@ -384,7 +452,8 @@ class StepProgram(object):
         idx = self._jnp.asarray(slot, self._jnp.int32)
         out = dict(states)
         for name, row in rows.items():
-            out[name] = self._set_row(out[name], idx, row)
+            out[name] = self._row_kernel(out[name], idx, row)(
+                out[name], idx, row)
         return out
 
     def zero_row(self, states, slot):
@@ -416,12 +485,37 @@ class StepProgram(object):
             flat[self._feed_pos[self.valid_name]] = valid
         for name in self.state_names:
             flat[self._feed_pos[name]] = states[name]
+        kernel = self._ensure_kernel(reset, flat)
         self._tick = (self._tick + 1) & 0x7fffffff
-        outs = self._kernel(self._key, np.int32(self._tick), reset,
-                            *flat)
+        outs = kernel(self._key, np.int32(self._tick), reset, *flat)
         new_states = {name: outs[1 + i]
                       for i, name in enumerate(self.state_names)}
         return np.asarray(outs[0]), new_states
+
+    def probe_step(self):
+        """One fixed-key, fixed-tick dispatch over an all-zero scratch
+        pool — the bitwise probe replica probation rides on: two
+        programs built from the same graph (traced fresh OR loaded
+        from the AOT cache) must return exactly equal outputs here
+        before a rehabilitated replica may take traffic.  Uses a
+        constant PRNGKey and tick so stochastic samplers compare
+        deterministically, touches neither ``self._tick`` nor any live
+        slot state, and compiles nothing a warmed program has not
+        already compiled."""
+        import jax
+        z = np.zeros((self.num_slots,), np.float32)
+        states = self.init_states()
+        flat = list(self._template)
+        flat[self._feed_pos[self.token_name]] = z
+        if self.pos_name is not None:
+            flat[self._feed_pos[self.pos_name]] = z
+        if self.valid_name is not None:
+            flat[self._feed_pos[self.valid_name]] = z
+        for name in self.state_names:
+            flat[self._feed_pos[name]] = states[name]
+        kernel = self._ensure_kernel(z, flat)
+        outs = kernel(jax.random.PRNGKey(0), np.int32(0), z, *flat)
+        return [np.asarray(o) for o in outs]
 
     def sample_tokens(self, logits):
         """Host-side sampling of a ``(rows, vocab)`` logits array with
@@ -591,12 +685,16 @@ class _DecodeTelemetry(object):
                 engine=self.engine_label, replica=r.label)
             r.tm_failures = self.replica_failures.labels(
                 engine=self.engine_label, replica=r.label)
+        # persistent-AOT-cache traffic: same families the one-shot
+        # bundle registers (engine ordinals are process-unique, so the
+        # shared families aggregate into one fleet view)
+        self.aot_fams = aot_metric_families(reg)
         self._engine_gauge_fams = (queue_depth_fam, compile_fam,
                                    ttft_fam, tpot_fam, replicas_fam)
         self._replica_fams = (self.slots_fam, self.occupied_fam,
                               self.step_ms, self.replica_healthy,
                               self.replica_inflight,
-                              self.replica_failures)
+                              self.replica_failures) + self.aot_fams
         self._engine = weakref.ref(engine)
         reg.register_callback(self._refresh)
 
@@ -733,34 +831,35 @@ class DecodeEngine(object):
                 buckets.append(b)
                 b <<= 1
             prefill_buckets = tuple(buckets)
-        from ..symbol import Symbol as _Symbol
+        # persistent AOT program cache (serving/aot_cache.py,
+        # MXNET_AOT_CACHE_DIR): one per engine, shared by every
+        # replica's step program, prefill buckets, and row-scatter
+        # kernels — a restarted engine (or a rehabilitated replica)
+        # loads warm instead of retracing.  The step verdict rides the
+        # validity fingerprint (re-validated on load: drift rejects the
+        # entry); the sampler policy — which shapes the compiled head —
+        # rides the key, minus the runtime-only seed.
+        from .aot_cache import AOTCache
+        sampler_fp = {k: v for k, v in self._sampler.describe().items()
+                      if k != "seed"}
+        self._aot = AOTCache.from_config(
+            artifact={"kind": "decode",
+                      "step_verdict": self.step_verdict},
+            key_extra={"engine_kind": "decode", "sampler": sampler_fp})
+        # everything _new_replica needs, kept for probation re-warm
+        # (rehabilitate): the param handles are the same NDArrays the
+        # program caches already hold device copies of — no extra
+        # host memory of consequence
+        self._ctor = {"step_sym": step_sym, "arg_params": arg_params,
+                      "aux_params": aux_params,
+                      "state_info": state_info,
+                      "token_name": token_name, "pos_name": pos_name,
+                      "valid_name": valid_name, "dtype": dtype,
+                      "prefill_sym": prefill_sym,
+                      "prefill_buckets": prefill_buckets}
         self._replicas = []
         for i, rctx in enumerate(replica_contexts(replicas, ctx)):
-            prog = StepProgram(step_sym, arg_params, aux_params,
-                               state_info, self.num_slots,
-                               token_name=token_name,
-                               pos_name=pos_name,
-                               valid_name=valid_name,
-                               ctx=rctx, dtype=dtype,
-                               sampler=self._sampler)
-            rep = DecodeReplica(i, rctx, prog)
-            if prefill_sym is not None:
-                rep.prefill_buckets = prefill_buckets
-                # Symbol is itself callable (compose), so "callable"
-                # alone cannot distinguish the T -> Symbol builder idiom
-                if not isinstance(prefill_sym, _Symbol) \
-                        and callable(prefill_sym):
-                    for b in prefill_buckets:
-                        rep.prefill_caches[b] = self._build_prefill(
-                            prefill_sym(b), arg_params, aux_params,
-                            rctx, dtype, prog)
-                else:
-                    shared = self._build_prefill(prefill_sym, arg_params,
-                                                 aux_params, rctx, dtype,
-                                                 prog)
-                    for b in prefill_buckets:
-                        rep.prefill_caches[b] = shared
-            self._replicas.append(rep)
+            self._replicas.append(self._new_replica(i, rctx))
         self._multi = len(self._replicas) > 1
         self._dr_lock = threading.Lock()
         self._dr_cond = threading.Condition(self._dr_lock)
@@ -768,6 +867,10 @@ class DecodeEngine(object):
         self._slot_free = threading.Event()
         self._tm = (_DecodeTelemetry(self)
                     if _telemetry.enabled() else None)
+        if self._tm is not None and self._aot is not None:
+            self._aot.bind_telemetry(*(
+                fam.labels(engine=self._tm.engine_label)
+                for fam in self._tm.aot_fams))
         self._trace_chain = (_telemetry.chain_from_config()
                              if self._tm is not None else None)
         self._owns_http_server = (_telemetry.server.engine_acquire()
@@ -808,7 +911,8 @@ class DecodeEngine(object):
             if config.get("MXNET_TELEMETRY_ALERTS"):
                 self._alert_owner = \
                     _telemetry.register_engine_default_rules(
-                        "decode", self._tm.engine_label)
+                        "decode", self._tm.engine_label,
+                        aot=self._aot is not None)
         self._worker = None
         if start:
             self.start()
@@ -835,6 +939,43 @@ class DecodeEngine(object):
     def _prefill_buckets(self, value):
         self._replicas[0].prefill_buckets = tuple(value)
 
+    def _new_replica(self, index, rctx):
+        """Build one fully-formed DecodeReplica (step program + prefill
+        caches, params uploaded to its device) from the construction
+        state — used at engine construction AND by ``rehabilitate()``,
+        which must rebuild a retired replica's programs from scratch
+        (its donated state buffers may be consumed) but draws every
+        compile from the AOT cache when one is configured."""
+        from ..symbol import Symbol as _Symbol
+        c = self._ctor
+        prog = StepProgram(c["step_sym"], c["arg_params"],
+                           c["aux_params"], c["state_info"],
+                           self.num_slots,
+                           token_name=c["token_name"],
+                           pos_name=c["pos_name"],
+                           valid_name=c["valid_name"],
+                           ctx=rctx, dtype=c["dtype"],
+                           sampler=self._sampler, aot=self._aot)
+        rep = DecodeReplica(index, rctx, prog)
+        prefill_sym = c["prefill_sym"]
+        if prefill_sym is not None:
+            rep.prefill_buckets = c["prefill_buckets"]
+            # Symbol is itself callable (compose), so "callable" alone
+            # cannot distinguish the T -> Symbol builder idiom
+            if not isinstance(prefill_sym, _Symbol) \
+                    and callable(prefill_sym):
+                for b in rep.prefill_buckets:
+                    rep.prefill_caches[b] = self._build_prefill(
+                        prefill_sym(b), c["arg_params"],
+                        c["aux_params"], rctx, c["dtype"], prog)
+            else:
+                shared = self._build_prefill(
+                    prefill_sym, c["arg_params"], c["aux_params"],
+                    rctx, c["dtype"], prog)
+                for b in rep.prefill_buckets:
+                    rep.prefill_caches[b] = shared
+        return rep
+
     def _build_prefill(self, psym, arg_params, aux_params, ctx, dtype,
                        program):
         """Wrap one prefill graph with the sampling head and compile-
@@ -857,7 +998,7 @@ class DecodeEngine(object):
         return ProgramCache(
             wrapped, arg_params, aux_params,
             data_names=[self._prefill_data_name, self._prefill_len_name],
-            ctx=ctx, dtype=dtype)
+            ctx=ctx, dtype=dtype, aot=self._aot, aot_kind="prefill")
 
     # ---------------------------------------------------------- preflight
     def _preflight(self, step_sym, state_info, token_name, pos_name,
@@ -1346,6 +1487,82 @@ class DecodeEngine(object):
                 self._assign(req)
         self._slot_free.set()
 
+    def rehabilitate(self):
+        """Replica probation/re-warm (ROADMAP follow-up a2): rebuild
+        every retired replica's programs from scratch (its donated
+        state buffers may be consumed), re-warm them — drawn from the
+        persistent AOT cache when one is configured, so re-entry
+        compiles nothing — and admit the replica back only after ONE
+        probe step matches a healthy sibling's output bitwise
+        (``StepProgram.probe_step``: fixed key, fixed tick, zero
+        scratch state — deterministic for stochastic samplers too).
+        A replica that fails any stage stays retired.
+
+        Returns one outcome dict per previously-unhealthy replica:
+        ``{"replica", "ok", "reason"}``.
+        """
+        if self._adm.closed:
+            raise EngineClosedError("decode engine is closed")
+        return [self._rehabilitate_one(r) for r in self._replicas
+                if not r.healthy]
+
+    def _rehabilitate_one(self, rep):
+        out = {"replica": rep.label, "ok": False, "reason": None}
+        with self._dr_lock:
+            sib = next((x for x in self._replicas
+                        if x.healthy and x is not rep), None)
+        if sib is None:
+            out["reason"] = ("no healthy sibling to probe against; "
+                             "build a new engine")
+            return out
+        try:
+            fresh = self._new_replica(rep.index, rep.ctx)
+            # probation warmup: exactly engine.warmup's per-replica
+            # sequence (step twice for committed-sharding parity,
+            # row-write kernels, prefill buckets) — with an AOT cache
+            # every one of these loads instead of tracing
+            self._warm_replica(fresh)
+            # the probation gate: one probe step, bitwise against the
+            # live sibling's program, before any traffic
+            want = sib.program.probe_step()
+            got = fresh.program.probe_step()
+            if not (len(want) == len(got)
+                    and all(np.array_equal(a, b, equal_nan=True)
+                            for a, b in zip(want, got))):
+                out["reason"] = ("probe step diverged bitwise from "
+                                 "healthy replica %s" % sib.label)
+                return out
+        except Exception as e:
+            out["reason"] = repr(e)
+            return out
+        with self._dr_lock:
+            rep.program = fresh.program
+            rep.prefill_caches = fresh.prefill_caches
+            rep.prefill_buckets = fresh.prefill_buckets
+            rep.slots = list(fresh.slots)
+            rep.tokens_np = fresh.tokens_np
+            rep.pos_np = fresh.pos_np
+            rep.valid_np = fresh.valid_np
+            rep.reset_np = fresh.reset_np
+            rep.states = fresh.states
+            rep.pending.clear()
+            rep.in_step = False
+            rep.healthy = True
+            rep.accepting = True
+            rep.thread = None
+            rep.probations += 1
+            rep.hb_t = time.monotonic()
+            self._dr_cond.notify_all()
+        self._ensure_replica_threads()
+        self._slot_free.set()
+        warnings.warn(
+            "decode replica %d (%s) rehabilitated after probation: "
+            "probe step bitwise-equal to replica %s"
+            % (rep.index, rep.ctx if rep.ctx is not None else "cpu(0)",
+               sib.label))
+        out["ok"] = True
+        return out
+
     def _join(self, rep, req):
         """Seat one admitted request in a free slot BETWEEN steps: zero
         (or prefill-fill) the slot's state rows, stage its first token,
@@ -1567,26 +1784,30 @@ class DecodeEngine(object):
         trace counter cannot even see.  The row-write kernel likewise
         warms against both a fresh buffer and a stepped one (the two
         shardings a prefill scatter can meet)."""
-        n = self.num_slots
-        z = np.zeros((n,), np.float32)
         for rep in self._replicas:
-            prog = rep.program
-            states = prog.init_states()
-            states = prog.zero_row(states, 0)
-            _, states = prog.step(z, z, z, states)
-            _, states = prog.step(z, z, z, states)
-            rows = {}
-            for info in prog.state_info:
-                dt = np.dtype(info.get("dtype") or prog._dtype)
-                rows[info["name"]] = np.zeros(tuple(info["shape"]), dt)
-            prog.write_row(states, 0, rows)
-            for b in rep.prefill_buckets:
-                feeds = {self._prefill_data_name:
-                         np.zeros((1, b), np.float32),
-                         self._prefill_len_name:
-                         np.zeros((1,), np.float32)}
-                rep.prefill_caches[b].run(feeds)
+            self._warm_replica(rep)
         return self.compile_count
+
+    def _warm_replica(self, rep):
+        """One replica's warm sequence — the docstring above is the
+        contract; shared with ``rehabilitate()`` so a rehabilitated
+        replica warms (and commits state shardings) exactly like a
+        fresh one."""
+        z = np.zeros((self.num_slots,), np.float32)
+        prog = rep.program
+        states = prog.init_states()
+        states = prog.zero_row(states, 0)
+        _, states = prog.step(z, z, z, states)
+        _, states = prog.step(z, z, z, states)
+        rows = {}
+        for info in prog.state_info:
+            dt = np.dtype(info.get("dtype") or prog._dtype)
+            rows[info["name"]] = np.zeros(tuple(info["shape"]), dt)
+        prog.write_row(states, 0, rows)
+        for b in rep.prefill_buckets:
+            rep.prefill_caches[b].run({
+                self._prefill_data_name: np.zeros((1, b), np.float32),
+                self._prefill_len_name: np.zeros((1,), np.float32)})
 
     @property
     def compile_count(self):
@@ -1622,6 +1843,8 @@ class DecodeEngine(object):
                 "requests_served": self._requests_served,
                 "compile_count": self.compile_count,
                 "sampler": self._sampler.describe(),
+                "aot": (self._aot.stats() if self._aot is not None
+                        else {"enabled": False}),
                 "replicas": [r.describe() for r in self._replicas],
                 "prefill": ("bucket" if self._prefill_caches
                             else "step"),

@@ -39,11 +39,19 @@ coalescer routes every formed batch to the least-loaded one; a replica
 whose dispatch raises is drained, marked unhealthy, and its traffic
 re-routed while siblings keep serving.
 
+Persistence: with ``MXNET_AOT_CACHE_DIR`` set every bucket program is
+serialized (jax.export) to a content-addressed on-disk cache at first
+compile, and a restarted engine — or replica N+1 joining under load,
+or a replica re-entering service through ``rehabilitate()`` — loads
+warm with ZERO traces, serving bitwise-identically
+(serving/aot_cache.py).
+
 Env knobs (config.py): ``MXNET_SERVE_MAX_BATCH``,
 ``MXNET_SERVE_MAX_QUEUE``, ``MXNET_SERVE_BATCH_TIMEOUT_MS``,
 ``MXNET_SERVE_DEFAULT_DEADLINE_MS``, ``MXNET_SERVE_OVERLOAD_POLICY``,
 ``MXNET_SERVE_SEQ_BUCKETS``, ``MXNET_SERVE_REPAIR``,
-``MXNET_SERVE_OPTIMIZE``, ``MXNET_SERVE_REPLICAS``.
+``MXNET_SERVE_OPTIMIZE``, ``MXNET_SERVE_REPLICAS``,
+``MXNET_AOT_CACHE_DIR`` / ``MXNET_AOT_CACHE``.
 """
 from __future__ import annotations
 
@@ -93,6 +101,30 @@ _ENGINE_SEQ = itertools.count()
 # unregistered sink for the submit-vs-close race: a counter nothing
 # scrapes, so a racing submit cannot resurrect removed series
 _NULL_COUNTER = _telemetry.Counter()
+
+
+def aot_metric_families(reg):
+    """Register (idempotently) the persistent-AOT-cache traffic
+    families both engine kinds share — ``mxnet_serve_aot_{hits,misses,
+    writes,rejects}_total``, per engine.  Hits are programs loaded
+    from disk with zero traces; misses compiled fresh and persisted;
+    writes are entries committed; rejects are present-but-unusable
+    entries (corruption / fingerprint drift) — the "cold start that
+    should have been warm" signal the default alert rule fires on."""
+    return tuple(reg.counter(
+        "mxnet_serve_aot_%s_total" % what, doc, labelnames=("engine",))
+        for what, doc in (
+            ("hits", "AOT-cache entries loaded warm (a compiled "
+                     "program this process never traced)"),
+            ("misses", "AOT-cache misses: programs compiled fresh "
+                       "(and persisted) because no entry existed"),
+            ("writes", "AOT-cache entries committed to disk "
+                       "(atomic tmp+rename)"),
+            ("rejects", "AOT-cache entries present but unusable — "
+                        "corrupt payload or fingerprint drift — "
+                        "forcing a cold compile that should have "
+                        "been warm (alertable; the engine's stats() "
+                        "names the offending key)")))
 
 
 class _EngineTelemetry(object):
@@ -253,6 +285,11 @@ class _EngineTelemetry(object):
             "batches dispatched per device replica — uniform counts "
             "mean the least-loaded router is actually balancing",
             labelnames=("engine", "replica"))
+        # persistent-AOT-cache traffic (serving/aot_cache.py): families
+        # defined ONCE here and shared with the decode bundle via
+        # aot_metric_families — per-engine children bound by the engine
+        # right after the bundle exists, reclaimed at close
+        self.aot_fams = aot_metric_families(reg)
         self._engine_gauge_fams = (queue_depth_fam, cache_hits_fam,
                                    cache_misses_fam, compile_count_fam,
                                    entropy_fam, replicas_fam)
@@ -298,7 +335,7 @@ class _EngineTelemetry(object):
         for fam in (self.shape_seen,
                     self.repairs_applied, self.repairs_rejected,
                     self.opt_removed, self.opt_rejected) \
-                + self._replica_fams:
+                + self.aot_fams + self._replica_fams:
             for values, _inst in fam.series():
                 if values[0] == self.engine_label:
                     fam.remove(*values)
@@ -393,6 +430,7 @@ class ServingEngine(object):
         self._length_sources = {}        # input name -> per-example axis
         self._hazard_label = "none"
         self.hazard_fingerprints = {}
+        self._verdicts = None            # padded-axis verdicts, if analyzed
         self._pad_check = config.get("MXNET_SERVE_PAD_CHECK")
         self._preflight_pre = None       # (report, ctx) over the original
         self._policy0 = self._policy     # policy before any degrade
@@ -418,10 +456,48 @@ class ServingEngine(object):
         data_names = list(self._data_shapes)
         if self._valid_name is not None:
             data_names.append(self._valid_name)
+        # persistent AOT program cache (serving/aot_cache.py,
+        # MXNET_AOT_CACHE_DIR): shared by every replica's ProgramCache
+        # — a restarted engine loads every previously-served bucket
+        # program warm (zero traces), and replica N+1 joining under
+        # load draws replica 0's compiles from disk.  The analysis
+        # verdicts + repair/optimizer outcome ride every entry's
+        # validity fingerprint and are re-validated on load (drift =
+        # reject + fresh compile, never a stale program); the bucket
+        # policy rides the key.
+        from .aot_cache import AOTCache
+        self._aot = AOTCache.from_config(
+            artifact={
+                "kind": "serve",
+                "verdicts": self._verdicts,
+                "repair": {
+                    "applied": (len(self.repair_plan.actions)
+                                if self.repair_plan is not None else 0),
+                    "valid_length_input": self._valid_name,
+                    "rejected": bool(self._repair_rejected)},
+                "optimizer": {
+                    "accepted": (bool(self.opt_plan.accepted)
+                                 if self.opt_plan is not None else None),
+                    "nodes_before": (self.opt_plan.nodes_before
+                                     if self.opt_plan is not None
+                                     else None),
+                    "nodes_after": (self.opt_plan.nodes_after
+                                    if self.opt_plan is not None
+                                    else None)}},
+            key_extra={"engine_kind": "serve",
+                       "max_batch": self._policy.max_batch,
+                       "seq_axis": self._policy.seq_axis,
+                       "seq_buckets": list(self._policy.seq_buckets)})
+        # construction state rehabilitate() rebuilds retired replicas
+        # from (the param handles are the same NDArrays the program
+        # caches already hold device copies of)
+        self._ctor = {"arg_params": arg_params, "aux_params": aux_params,
+                      "data_names": data_names}
         self._replicas = []
         for i, rctx in enumerate(replica_contexts(replicas, ctx)):
             cache = ProgramCache(self._serve_sym, arg_params, aux_params,
-                                 data_names, ctx=rctx, dtype=dtype)
+                                 data_names, ctx=rctx, dtype=dtype,
+                                 aot=self._aot)
             self._replicas.append(ServeReplica(i, rctx, cache))
         self._cache = self._replicas[0].cache   # single-replica alias
         self._multi = len(self._replicas) > 1
@@ -435,6 +511,10 @@ class ServingEngine(object):
         if self._tm is not None:
             self._record_repair_telemetry()
             self._record_opt_telemetry()
+            if self._aot is not None:
+                self._aot.bind_telemetry(*(
+                    fam.labels(engine=self._tm.engine_label)
+                    for fam in self._tm.aot_fams))
         # trace-retention chain (telemetry/sampling.py): every request
         # is traced cheaply and kept/dropped at finish() — tail-biased
         # (top-K slowest + moving p99) with error keep and the
@@ -484,7 +564,8 @@ class ServingEngine(object):
             if config.get("MXNET_TELEMETRY_ALERTS"):
                 self._alert_owner = \
                     _telemetry.register_engine_default_rules(
-                        "serve", self._tm.engine_label)
+                        "serve", self._tm.engine_label,
+                        aot=self._aot is not None)
         self._worker = None
         if start:
             self.start()
@@ -514,6 +595,7 @@ class ServingEngine(object):
         verdicts, report, ctx = check_serving_graph(
             symbol, self._data_shapes, self._policy, with_ctx=True)
         self.analysis_report = report
+        self._verdicts = dict(verdicts)
         self._preflight_pre = (report, ctx)
         # fingerprint the retrace-linter's hazard findings: runtime
         # retrace events are counted under these labels, tying an
@@ -1181,6 +1263,141 @@ class ServingEngine(object):
             except Exception as e2:
                 self._fail_batch(reqs, e2)
 
+    def rehabilitate(self):
+        """Replica probation/re-warm (ROADMAP follow-up a2): give every
+        retired replica a path back into service instead of permanent
+        retirement.  Each unhealthy replica gets a FRESH program cache
+        (its old one may hold poisoned state), a probation warmup over
+        every bucket signature the fleet has served — drawn from the
+        persistent AOT cache when one is configured, so re-entry costs
+        zero traces — and ONE probe batch that must match a healthy
+        sibling's output bitwise before the replica takes traffic
+        again.  A replica that fails any stage stays retired.
+
+        Returns one outcome dict per previously-unhealthy replica:
+        ``{"replica", "ok", "reason", "warmed"}``.
+        """
+        if self._adm.closed:
+            raise EngineClosedError("serving engine is closed")
+        return [self._rehabilitate_one(r) for r in self._replicas
+                if not r.healthy]
+
+    def _rehabilitate_one(self, r):
+        out = {"replica": r.label, "ok": False, "reason": None,
+               "warmed": 0}
+        with self._route_lock:
+            sib = next((x for x in self._replicas
+                        if x.healthy and x is not r), None)
+            keys = set()
+            for x in self._replicas:
+                keys |= x.dispatched_keys
+            sib_keys = set(sib.dispatched_keys) if sib is not None \
+                else set()
+        if sib is None:
+            out["reason"] = ("no healthy sibling to probe against; "
+                             "build a new engine")
+            return out
+        c = self._ctor
+        try:
+            cache = ProgramCache(self._serve_sym, c["arg_params"],
+                                 c["aux_params"], c["data_names"],
+                                 ctx=r.ctx, dtype=self._dtype,
+                                 aot=self._aot)
+            probe_key = None
+            for key in sorted(keys):
+                feeds = {name: np.zeros(shape,
+                                        np.float32 if name ==
+                                        self._valid_name
+                                        else self._dtype)
+                         for name, shape in key}
+                cache.run(feeds)
+                out["warmed"] += 1
+                # probe on a key the SIBLING has already dispatched:
+                # the reference dispatch below must never inject a
+                # synchronous compile into a live serving replica
+                if probe_key is None and (key in sib_keys
+                                          or not sib_keys):
+                    probe_key = key
+            if probe_key is None:
+                # fleet never dispatched: probe the smallest bucket
+                # (the one-off compile lands on an idle engine)
+                probe_key = tuple(sorted(
+                    (name, (1,) + ex)
+                    for name, ex in self._data_shapes.items()))
+                if self._valid_name is not None:
+                    probe_key += ((self._valid_name, (1,)),)
+            # the probation gate: same compiled-program contract the
+            # replica fleet already serves under — one probe batch,
+            # bitwise against a live sibling, or no traffic.  The rng
+            # key is pinned so stochastic graphs probe
+            # deterministically (two caches' own key streams never
+            # agree; see StepProgram.probe_step for the decode analog)
+            import jax
+            pk = jax.random.PRNGKey(0)
+            probe_feeds = self._probe_feeds(probe_key)
+            want = sib.cache.run(probe_feeds, _record=False,
+                                 _fixed_key=pk)
+            got = cache.run(probe_feeds, _record=False, _fixed_key=pk)
+            if not (len(want) == len(got)
+                    and all(np.array_equal(a, b, equal_nan=True)
+                            for a, b in zip(want, got))):
+                out["reason"] = ("probe batch diverged bitwise from "
+                                 "healthy replica %s" % sib.label)
+                return out
+        except Exception as e:
+            out["reason"] = repr(e)
+            return out
+        with self._route_lock:
+            r.cache = cache
+            if r is self._replicas[0]:
+                # keep the single-replica alias honest: stats()'s
+                # bucket_keys reads through it, and holding the old
+                # poisoned cache alive would also pin its device
+                # buffers
+                self._cache = cache
+            r.dispatched_keys = set(keys)
+            r.pending.clear()
+            r.in_dispatch = False
+            r.healthy = True
+            r.accepting = True
+            r.thread = None
+            r.probations += 1
+            self._route_cond.notify_all()
+        self._ensure_replica_threads()
+        warnings.warn(
+            "serving replica %d (%s) rehabilitated after probation: "
+            "%d bucket program(s) re-warmed, probe batch bitwise-equal "
+            "to replica %s" % (r.index,
+                               r.ctx if r.ctx is not None else "cpu(0)",
+                               out["warmed"], sib.label))
+        out["ok"] = True
+        return out
+
+    def _probe_feeds(self, key):
+        """Deterministic NON-degenerate probe batch for one bucket
+        signature.  All-zero feeds would be useless as a probe: a
+        zero-bias model maps zeros to the same output whatever its
+        weights, so a rehab candidate rebuilt from wrong params would
+        pass.  Small integer values (0,1,2 cycling) excite the weights
+        while staying legal for id-valued inputs (Embedding rows); a
+        repaired graph's valid-length vector is set to each input's
+        full live extent so the spliced masks keep every probe row
+        live."""
+        feeds = {}
+        for name, shape in key:
+            if name == self._valid_name:
+                continue
+            n = int(np.prod(shape)) if len(shape) else 1
+            feeds[name] = (np.arange(n) % 3).astype(
+                self._dtype).reshape(shape)
+        if self._valid_name is not None:
+            shapes = dict(key)
+            b = shapes[self._valid_name][0]
+            name, ax = next(iter(sorted(self._length_sources.items())))
+            ext = shapes[name][1 + ax]
+            feeds[self._valid_name] = pad_valid_lengths([ext] * b, b)
+        return feeds
+
     def _dispatch(self, reqs, t_pop=None, replica=None):
         tm = self._tm
         rep = replica if replica is not None else self._replicas[0]
@@ -1468,6 +1685,8 @@ class ServingEngine(object):
                 "bucket_keys": len(self._cache.bucket_keys),
                 "max_batch": self._policy.max_batch,
                 "replicas": [r.describe() for r in self._replicas],
+                "aot": (self._aot.stats() if self._aot is not None
+                        else {"enabled": False}),
                 "repairs": {
                     "applied": (len(self.repair_plan.actions)
                                 if self.repair_plan is not None else 0),

@@ -183,8 +183,9 @@ class ServeReplica(object):
     __slots__ = ("index", "label", "ctx", "cache", "healthy",
                  "accepting", "pending",
                  "in_dispatch", "dispatched_keys", "batches", "failures",
-                 "hb_t", "thread", "tm_dispatch", "tm_occupancy",
-                 "tm_retraces", "tm_batches", "tm_failures")
+                 "probations", "hb_t", "thread", "tm_dispatch",
+                 "tm_occupancy", "tm_retraces", "tm_batches",
+                 "tm_failures")
 
     def __init__(self, index, ctx, cache):
         self.index = index
@@ -192,6 +193,10 @@ class ServeReplica(object):
         self.ctx = ctx
         self.cache = cache
         self.healthy = True
+        # times this replica re-entered service through the probation
+        # warmup + bitwise probe gate (engine.rehabilitate) after a
+        # dispatch failure retired it
+        self.probations = 0
         # flipped False UNDER the engine's router lock the moment this
         # replica's thread decides to exit — the router must never
         # append work a dead thread will not drain (is_alive() has a
@@ -225,6 +230,7 @@ class ServeReplica(object):
                 "inflight": self.inflight(),
                 "batches": self.batches,
                 "failures": self.failures,
+                "probations": self.probations,
                 "compile_count": self.cache.compile_count}
 
 
@@ -240,8 +246,8 @@ class DecodeReplica(object):
     __slots__ = ("index", "label", "ctx", "program", "prefill_caches",
                  "prefill_buckets", "slots", "tokens_np", "pos_np",
                  "valid_np", "reset_np", "states", "pending", "healthy",
-                 "accepting", "in_step", "hb_t", "thread", "tm_step_ms",
-                 "tm_failures")
+                 "accepting", "in_step", "probations", "hb_t", "thread",
+                 "tm_step_ms", "tm_failures")
 
     def __init__(self, index, ctx, program):
         import numpy as np
@@ -249,6 +255,8 @@ class DecodeReplica(object):
         self.label = str(index)
         self.ctx = ctx
         self.program = program
+        # probation re-entries (DecodeEngine.rehabilitate)
+        self.probations = 0
         # see ServeReplica.accepting: flipped False under the engine's
         # router lock when this replica's scheduler thread exits
         self.accepting = True
@@ -293,4 +301,5 @@ class DecodeReplica(object):
                 "slots": self.program.num_slots,
                 "slots_occupied": self.occupied_count(),
                 "pending": len(self.pending),
+                "probations": self.probations,
                 "compile_count": self.program.trace_count}

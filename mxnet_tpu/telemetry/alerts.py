@@ -532,7 +532,8 @@ def load_rules_file(path=None, manager=None):
     return added
 
 
-def register_engine_default_rules(kind, engine_label, watchdog_s=None):
+def register_engine_default_rules(kind, engine_label, watchdog_s=None,
+                                  aot=False):
     """The default SLO rule set one engine contributes (ISSUE 9):
 
     - ``serve_queue_saturation_burn`` (shared): rejected+shed over
@@ -547,7 +548,13 @@ def register_engine_default_rules(kind, engine_label, watchdog_s=None):
       ``MXNET_TELEMETRY_WATCHDOG_SECS``);
     - ``serve_engine<N>_retrace_storm`` (one-shot engines): any
       post-warmup retrace delta in 2 minutes — the compile-once
-      contract breaking under live traffic.
+      contract breaking under live traffic;
+    - ``<kind>_engine<N>_aot_reject`` (``aot=True`` — engines with a
+      persistent AOT program cache): any reject delta in 2 minutes —
+      a cold start that should have been warm (cache entries present
+      but unusable: corruption or fingerprint drift).  The flight
+      bundle the firing dumps captures the engine's stats(), whose
+      ``aot.last_reject`` block names the offending key.
 
     Returns the owner token to pass to
     ``default_manager().remove_owner(...)`` at close.
@@ -573,6 +580,21 @@ def register_engine_default_rules(kind, engine_label, watchdog_s=None):
             annotations={"engine": engine_label,
                          "summary": "post-warmup XLA retraces observed "
                                     "— compile-once contract broken"}),
+            owner=owner)
+    if aot:
+        mgr.add_rule(AlertRule(
+            "%s_engine%s_aot_reject" % (kind, engine_label),
+            "threshold",
+            series="mxnet_serve_aot_rejects_total",
+            labels={"engine": engine_label}, query="delta",
+            window_s=120.0, op=">", threshold=0.0,
+            annotations={"engine": engine_label, "kind": kind,
+                         "summary": "cold start that should have been "
+                                    "warm: AOT-cache entries present "
+                                    "but unusable (corruption or "
+                                    "fingerprint drift); the bundle's "
+                                    "engine stats aot.last_reject "
+                                    "names the key"}),
             owner=owner)
     mgr.add_rule(AlertRule(
         "serve_queue_saturation_burn", "burn_rate",

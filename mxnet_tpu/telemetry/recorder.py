@@ -511,7 +511,12 @@ class FlightRecorder(object):
         name = "flight_%s_%06d_%s.json" % (
             time.strftime("%Y%m%dT%H%M%S"), next(_FLIGHT_SEQ), safe)
         path = os.path.join(self.directory, name)
-        tmp = "%s.tmp.%d" % (path, os.getpid())
+        # dot-prefixed tmp: a reader globbing flight_* (operators,
+        # tools/telemetry_dump.py, tests) must never pick up a
+        # half-written bundle mid-dump — the atomic-write promise
+        # covers the LISTING, not just the final rename
+        tmp = os.path.join(self.directory,
+                           ".%s.tmp.%d" % (name, os.getpid()))
         try:
             with open(tmp, "w") as f:
                 json.dump(_finite(bundle), f, indent=1, sort_keys=True,

@@ -205,9 +205,16 @@ def test_serving_replica_failover(tmp_path, monkeypatch):
     assert st["replicas"][0]["failures"] == 1
     hb = eng._heartbeat()
     assert hb["replicas"][0]["healthy"] is False
-    # the flight recorder dumped on the unhealthy transition
-    bundles = [p for p in os.listdir(str(tmp_path))
-               if p.startswith("flight_")]
+    # the flight recorder dumped on the unhealthy transition — on the
+    # REPLICA thread, after the client's future already failed, so
+    # give the (registry-size-dependent) bundle write a bounded wait
+    deadline = time.monotonic() + 30
+    bundles = []
+    while not bundles and time.monotonic() < deadline:
+        bundles = [p for p in os.listdir(str(tmp_path))
+                   if p.startswith("flight_")]
+        if not bundles:
+            time.sleep(0.02)
     assert bundles, "no flight bundle written on replica failure"
     doc = json.load(open(os.path.join(str(tmp_path), bundles[0])))
     assert "replica_failed" in doc["reason"]
@@ -339,8 +346,15 @@ def test_decode_replica_failover_partial_output(tmp_path, monkeypatch):
     # new work lands on the survivor
     r3 = eng.submit([5], max_new_tokens=30).result(timeout=120)
     assert list(r3.tokens) == want[5]
-    bundles = [p for p in os.listdir(str(tmp_path))
-               if p.startswith("flight_")]
+    # bounded wait: the bundle is written on the failed replica's
+    # thread, concurrent with the survivor serving the asserts above
+    deadline = time.monotonic() + 30
+    bundles = []
+    while not bundles and time.monotonic() < deadline:
+        bundles = [p for p in os.listdir(str(tmp_path))
+                   if p.startswith("flight_")]
+        if not bundles:
+            time.sleep(0.02)
     assert bundles and "replica_failed" in json.load(
         open(os.path.join(str(tmp_path), bundles[0])))["reason"]
     eng.close()

@@ -1,0 +1,232 @@
+"""Manage a persistent AOT program cache (serving/aot_cache.py).
+
+Operates on the directory the serving tier writes compiled-program
+entries into (``MXNET_AOT_CACHE_DIR``; ``--dir`` overrides)::
+
+  python tools/aot_cache.py list
+  python tools/aot_cache.py list --dir /var/aot --json
+  python tools/aot_cache.py verify              # exit 1 on corruption
+  python tools/aot_cache.py verify --shallow    # hash-only, no jax load
+  python tools/aot_cache.py prune --max-age-s 604800
+  python tools/aot_cache.py prune --max-total-mb 512 --dry-run
+
+``list`` prints one line per committed entry — key prefix, kind
+(serve / prefill / decode_step / decode_set_row), input signature,
+platform, age, payload size — oldest first, plus a totals row.
+
+``verify`` re-hashes every payload against its recorded sha256,
+re-parses metadata, compares the environment half of the validity
+fingerprint (jax/library versions, device kind — ``--no-env-check``
+to skip when auditing another platform's volume), and (unless
+``--shallow``) round-trips the payload through
+``jax.export.deserialize`` — the same checks a loading engine
+applies, so a clean ``verify`` means tomorrow's restart loads warm.
+Any unsound entry is reported and the exit code is nonzero; serving
+processes never need this first (they reject unsound entries at load
+and fall back to fresh compiles), but CI and cache-volume janitors do.
+
+``prune`` removes entries past ``--max-age-s`` and/or evicts oldest-
+first down to ``--max-total-mb`` of payload.  Metadata is removed
+BEFORE payload (the commit marker goes first, so a concurrent loader
+can never observe a committed entry with a vanished payload), and
+orphaned ``.bin``/tmp files are swept too.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _dir_from(args):
+    d = args.dir
+    if not d:
+        d = os.environ.get("MXNET_AOT_CACHE_DIR", "").strip()
+    if not d:
+        print("no cache directory: pass --dir or set "
+              "MXNET_AOT_CACHE_DIR", file=sys.stderr)
+        sys.exit(2)
+    if not os.path.isdir(d):
+        print("not a directory: %r" % d, file=sys.stderr)
+        sys.exit(2)
+    return d
+
+
+def _entries(d):
+    from mxnet_tpu.serving.aot_cache import iter_entries
+    return list(iter_entries(d))
+
+
+def _fmt_sig(meta):
+    sig = (meta or {}).get("signature")
+    if not sig:
+        return "?"
+    return ",".join("x".join(map(str, shape)) + ":" + dtype
+                    for shape, dtype in sig)
+
+
+def _size(bin_path):
+    try:
+        return os.path.getsize(bin_path)
+    except OSError:
+        return -1
+
+
+def cmd_list(args):
+    d = _dir_from(args)
+    now = time.time()
+    rows, total = [], 0
+    for key, _mp, bin_path, meta in _entries(d):
+        size = _size(bin_path)
+        total += max(size, 0)
+        rows.append({
+            "key": key,
+            "kind": (meta or {}).get("kind", "?"),
+            "signature": _fmt_sig(meta),
+            "platform": ((meta or {}).get("fingerprint") or {})
+            .get("device_kind", "?"),
+            "age_s": round(now - (meta or {}).get("created", now), 1),
+            "size": size})
+    if args.json:
+        print(json.dumps({"dir": d, "entries": rows,
+                          "total_bytes": total}, indent=1))
+        return 0
+    if not rows:
+        print("(empty cache: %s)" % d)
+        return 0
+    w = max(len(r["kind"]) for r in rows)
+    for r in rows:
+        print("%s  %-*s  %-10s  age %8.1fs  %8d B  %s"
+              % (r["key"][:16], w, r["kind"], r["platform"],
+                 r["age_s"], r["size"], r["signature"]))
+    print("%d entr%s, %.1f KiB payload total"
+          % (len(rows), "y" if len(rows) == 1 else "ies",
+             total / 1024.0))
+    return 0
+
+
+def cmd_verify(args):
+    from mxnet_tpu.serving.aot_cache import verify_entry
+    d = _dir_from(args)
+    bad = 0
+    entries = _entries(d)
+    for key, _mp, bin_path, meta in entries:
+        problems = verify_entry(key, meta, bin_path,
+                                deep=not args.shallow,
+                                env_check=not args.no_env_check)
+        if problems:
+            bad += 1
+            for p in problems:
+                print("UNSOUND %s: %s" % (key[:16], p))
+        elif args.verbose:
+            print("ok      %s  %s" % (key[:16],
+                                      (meta or {}).get("kind", "?")))
+    print("%d entr%s checked, %d unsound"
+          % (len(entries), "y" if len(entries) == 1 else "ies", bad))
+    return 1 if bad else 0
+
+
+def cmd_prune(args):
+    d = _dir_from(args)
+    now = time.time()
+    entries = _entries(d)            # oldest first already
+    keep, drop = [], []
+    for e in entries:
+        key, _mp, bin_path, meta = e
+        age = now - (meta or {}).get("created", 0.0)
+        if args.max_age_s is not None and age > args.max_age_s:
+            drop.append((e, "age %.0fs > %.0fs" % (age, args.max_age_s)))
+        else:
+            keep.append(e)
+    if args.max_total_mb is not None:
+        budget = int(args.max_total_mb * 1024 * 1024)
+        total = sum(max(_size(bp), 0) for _k, _mp, bp, _m in keep)
+        i = 0
+        while total > budget and i < len(keep):
+            e = keep[i]
+            total -= max(_size(e[2]), 0)
+            drop.append((e, "evicted oldest-first for --max-total-mb"))
+            i += 1
+        keep = keep[i:]
+    removed = 0
+    for (key, meta_path, bin_path, _meta), why in drop:
+        print("%s %s: %s" % ("would prune" if args.dry_run
+                             else "pruned", key[:16], why))
+        if args.dry_run:
+            continue
+        # metadata (the commit marker) goes first: a concurrent loader
+        # must never find a committed entry whose payload is gone
+        for p in (meta_path, bin_path):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        removed += 1
+    # orphan sweep: payloads with no metadata (interrupted writers,
+    # half-pruned entries) and stale tmp files
+    committed = {k for k, _mp, _bp, _m in keep}
+    for n in sorted(os.listdir(d)):
+        path = os.path.join(d, n)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue        # a live writer renamed/removed it: skip
+        stale_tmp = ".tmp." in n and now - mtime > 3600
+        # the same age guard as tmp files: a fresh payload may be a
+        # live writer's bin-before-metadata commit window, not an
+        # orphan — sweeping it would discard a just-paid compile and
+        # break the commit-marker promise the moment the .json lands
+        orphan_bin = (n.endswith(".bin")
+                      and now - mtime > 3600
+                      and n[:-len(".bin")] not in committed
+                      and not any(n == k + ".bin"
+                                  for (k, _mp, _bp, _m), _w in drop))
+        if stale_tmp or (orphan_bin and (args.max_age_s is not None
+                                         or args.max_total_mb
+                                         is not None)):
+            print("%s orphan %s" % ("would sweep" if args.dry_run
+                                    else "swept", n))
+            if not args.dry_run:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+    print("%d entr%s removed, %d kept"
+          % (removed, "y" if removed == 1 else "ies", len(keep)))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="list / verify / prune a persistent AOT program "
+                    "cache directory")
+    ap.add_argument("--dir", default="",
+                    help="cache directory (default: MXNET_AOT_CACHE_DIR)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("list", help="one line per committed entry")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_list)
+    p = sub.add_parser("verify",
+                       help="re-hash + load-check every entry; exit 1 "
+                            "on corruption")
+    p.add_argument("--shallow", action="store_true",
+                   help="skip the jax.export deserialization check")
+    p.add_argument("--no-env-check", action="store_true",
+                   help="skip the jax/library/device-kind fingerprint "
+                        "comparison against THIS host (for janitor "
+                        "boxes verifying another platform's volume)")
+    p.add_argument("--verbose", action="store_true")
+    p.set_defaults(fn=cmd_verify)
+    p = sub.add_parser("prune", help="remove entries by age/total size")
+    p.add_argument("--max-age-s", type=float, default=None)
+    p.add_argument("--max-total-mb", type=float, default=None)
+    p.add_argument("--dry-run", action="store_true")
+    p.set_defaults(fn=cmd_prune)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
