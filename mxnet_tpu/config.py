@@ -305,6 +305,74 @@ VARIABLES = {v.name: v for v in [
          "building them.  Off by default: it flips process-wide jax "
          "config (cache thresholds included), which a library should "
          "only do when asked."),
+    _Var("MXNET_FAULT_PLAN", str, "",
+         "Deterministic fault-injection plan (serving/faults.py).  "
+         "Either a JSON list of clause dicts or the compact grammar "
+         "'site:action:k=v,k=v;...' — e.g. "
+         "'decode.step:raise:on=5,replica=1;aot.load:corrupt:on=1'.  "
+         "Sites: serve.dispatch, decode.step, decode.prefill, "
+         "aot.load, admission.admit.  Actions: raise (FaultInjected), "
+         "hang (hang_s seconds), corrupt (aot.load payload bytes).  "
+         "Triggers: on=N (1-based Nth matching hit), after=N, "
+         "every=K, times=M, p=P with seed=S (seeded, reproducible).  "
+         "Empty = off: the injection sites are a single predicate "
+         "check and serving behavior is byte-for-byte the uninjected "
+         "engine."),
+    _Var("MXNET_SUPERVISOR", bool, False,
+         "Automatic replica probation (serving/supervisor.py).  When "
+         "on, a refcounted supervisor thread watches every engine's "
+         "replica health and drives rehabilitate() for retired "
+         "replicas on an exponential-backoff-with-jitter clock "
+         "(MXNET_SUPERVISOR_BACKOFF_MS doubling up to "
+         "MXNET_SUPERVISOR_BACKOFF_MAX_MS, MXNET_SUPERVISOR_ATTEMPTS "
+         "bounded attempts, then permanent retirement + alert).  Off "
+         "by default: rehabilitation stays an operator verb."),
+    _Var("MXNET_SUPERVISOR_BACKOFF_MS", float, 500.0,
+         "Supervisor probation backoff base: the first rehab attempt "
+         "for a freshly retired replica waits this long; each failed "
+         "attempt doubles it (plus deterministic jitter)."),
+    _Var("MXNET_SUPERVISOR_BACKOFF_MAX_MS", float, 30000.0,
+         "Supervisor probation backoff ceiling."),
+    _Var("MXNET_SUPERVISOR_ATTEMPTS", int, 5,
+         "Failed rehab attempts before the supervisor permanently "
+         "retires a replica (alert + flight bundle; an operator "
+         "rehabilitate() call can still bring it back)."),
+    _Var("MXNET_SUPERVISOR_INTERVAL_MS", float, 100.0,
+         "Supervisor poll interval: how often replica health and due "
+         "probation clocks are checked."),
+    _Var("MXNET_REGULATOR", bool, False,
+         "SLO-driven overload regulator (serving/regulator.py).  When "
+         "on (and telemetry + the history recorder are running), each "
+         "engine runs a regulator thread that reads the burn-rate "
+         "rule states (serve_queue_saturation_burn, "
+         "serve_deadline_miss_burn) each cycle and adapts the "
+         "admission plane: firing tightens the effective queue limit "
+         "multiplicatively (shedding the highest padded-element-cost "
+         "requests first), resolution relaxes it back to the "
+         "configured max_queue.  Off by default: admission behavior "
+         "is byte-for-byte the unregulated engine."),
+    _Var("MXNET_REGULATOR_INTERVAL_MS", float, 500.0,
+         "Regulator evaluation interval."),
+    _Var("MXNET_REGULATOR_MIN_QUEUE", int, 8,
+         "Floor on the regulator's tightened admission-queue limit — "
+         "overload control may shed aggressively but must never "
+         "choke the queue below a dispatchable batch."),
+    _Var("MXNET_AOT_CACHE_MAX_MB", float, 0.0,
+         "Size budget for the persistent AOT cache volume.  > 0: "
+         "after every store() the writer best-effort prunes entries "
+         "oldest-first until the directory fits the budget (counted "
+         "in mxnet_serve_aot_prunes_total; tolerant of concurrent "
+         "writers — a vanished file is someone else's prune, not an "
+         "error).  0 = unbounded (janitoring via tools/aot_cache.py "
+         "prune)."),
+    _Var("MXNET_FLIGHT_RING_MB", float, 4.0,
+         "Binary ring-file flight-recorder window: with "
+         "MXNET_FLIGHT_RECORDER_DIR set, the history recorder appends "
+         "every sample to a preallocated fixed-size ring file "
+         "(ring.bin, this many MB) so a SIGKILL/OOM leaves a readable "
+         "trailing telemetry window no Python-level hook could have "
+         "written.  Render with tools/telemetry_dump.py ring.  "
+         "0 = off."),
     _Var("MXNET_FLIGHT_RECORDER_DIR", str, "",
          "Black-box post-mortem directory.  When set, any alert "
          "transition to firing (watchdog trips included) atomically "

@@ -17,7 +17,17 @@ reference stops at the single-client C predict API).  Five parts:
   probation re-warm (``MXNET_SERVE_REPLICAS``);
 - :mod:`.aot_cache` — persistent content-addressed AOT program cache
   (``MXNET_AOT_CACHE_DIR``): restarts and replica scale-ups load
-  compiled programs from disk instead of retracing.
+  compiled programs from disk instead of retracing;
+- :mod:`.faults`    — deterministic seeded fault injection
+  (``MXNET_FAULT_PLAN``): chaos schedules as reproducible fixtures,
+  zero-overhead no-op when disabled;
+- :mod:`.supervisor` — automatic replica probation
+  (``MXNET_SUPERVISOR``): drives ``rehabilitate()`` on an
+  exponential-backoff clock, bounded attempts then permanent
+  retirement + alert;
+- :mod:`.regulator` — SLO-driven overload control
+  (``MXNET_REGULATOR``): burn-rate rule firings tighten admission
+  (cost-aware shedding), resolution relaxes it back.
 
 Quick start::
 
@@ -33,11 +43,14 @@ from .admission import (AdmissionController, Request, QueueFullError,
                         EngineClosedError)
 from .buckets import BucketPolicy, ProgramCache, pad_valid_lengths
 from .aot_cache import AOTCache
+from .faults import FaultPlan, FaultInjected
 from .replica import (ServeReplica, DecodeReplica, replica_contexts)
 from .engine import ServingEngine
 from .decode import (DecodeEngine, DecodeResult, StepProgram,
                      greedy_decode, Sampler, GreedySampler,
                      TemperatureSampler)
+from .supervisor import Supervisor
+from .regulator import Regulator
 
 __all__ = ["ServingEngine", "BucketPolicy", "ProgramCache",
            "AOTCache", "pad_valid_lengths",
@@ -45,6 +58,7 @@ __all__ = ["ServingEngine", "BucketPolicy", "ProgramCache",
            "greedy_decode",
            "Sampler", "GreedySampler", "TemperatureSampler",
            "ServeReplica", "DecodeReplica", "replica_contexts",
+           "FaultPlan", "FaultInjected", "Supervisor", "Regulator",
            "AdmissionController", "Request", "QueueFullError",
            "DeadlineExceededError", "ServerOverloadError",
            "EngineClosedError"]

@@ -29,6 +29,7 @@ import threading
 import time
 
 from ..base import MXNetError
+from . import faults as _faults
 
 __all__ = ["AdmissionController", "Request", "QueueFullError",
            "DeadlineExceededError", "ServerOverloadError",
@@ -82,6 +83,13 @@ class Request(object):
     which requests yield a stored span tree — is decided at finish by
     the tail-biased sampler chain.
 
+    ``cost`` is the request's padded-element price (the engine computes
+    it from the bucket-padded group shapes; decode uses prompt +
+    generation budget) — what the overload regulator's cost-aware
+    shedding ranks by: under pressure the HIGHEST-cost queued request
+    sheds first, buying the most queue drain per lost request.  None
+    ranks as zero (raw Requests staged by tests keep working).
+
     ``on_expire`` generalizes deadline accounting beyond the original
     one-dispatch-per-request model: a MULTI-STEP request (continuous-
     batching decode, serving/decode.py — its deadline is re-checked on
@@ -94,10 +102,10 @@ class Request(object):
     leave it unset and keep the original fail-fast contract.
     """
     __slots__ = ("inputs", "group", "future", "t_enqueue", "deadline",
-                 "out_rows", "trace", "on_expire")
+                 "out_rows", "trace", "on_expire", "cost")
 
     def __init__(self, inputs, group, future, deadline=None,
-                 out_rows=None, trace=None, on_expire=None):
+                 out_rows=None, trace=None, on_expire=None, cost=None):
         self.inputs = inputs
         self.group = group
         self.future = future
@@ -106,6 +114,7 @@ class Request(object):
         self.out_rows = out_rows
         self.trace = trace
         self.on_expire = on_expire
+        self.cost = cost                    # padded elements (regulator)
 
     def expired(self, now=None):
         return self.deadline is not None and \
@@ -141,6 +150,12 @@ class AdmissionController(object):
         self.admitted = 0
         self.rejected = 0
         self.shed = 0
+        # regulator-pressure sheds, counted SEPARATELY from policy
+        # sheds: the queue-saturation burn rule's numerator includes
+        # mxnet_serve_shed_total, so regulator sheds feeding it would
+        # be a positive feedback loop (shed -> burn -> tighten ->
+        # shed) that ratchets the limit to the floor and never relaxes
+        self.pressure_shed = 0
         self.expired = 0
         # optional telemetry bundle (engine._EngineTelemetry): the
         # registry mirrors of the counters above plus the queue-depth
@@ -148,18 +163,51 @@ class AdmissionController(object):
         # makes zero instrument calls.  Instrument locks are leaves, so
         # updating them under _cond's lock cannot deadlock.
         self._telemetry = telemetry
+        # overload-regulator pressure (serving/regulator.py): a
+        # tightened effective queue limit below max_queue.  None =
+        # unregulated — admit() then behaves byte-for-byte as before.
+        self._pressure = None
 
     # ------------------------------------------------------------- producer
     def admit(self, req):
         """Enqueue a request or apply the overload policy.  Thread-safe;
         called from client threads."""
+        if _faults.ACTIVE:
+            # chaos seam (serving/faults.py): an admission stall
+            # (hang) or front-door failure (raise) lands on the
+            # SUBMITTING client, before any queue state changes
+            _faults.trip("admission.admit")
         failures, reject = [], None
         tm = self._telemetry
         with self._cond:
             if self._closed:
                 raise EngineClosedError("serving engine is closed")
             failures += self._sweep_locked()
-            if len(self._queue) >= self.max_queue:
+            pressure = self._pressure
+            if pressure is not None and len(self._queue) >= pressure \
+                    and len(self._queue) < self.max_queue:
+                # regulated overload below the hard bound: shed the
+                # highest padded-element-cost request (the incoming
+                # one included — if IT is the most expensive, reject
+                # it rather than evict cheaper queued work)
+                victim = max(list(self._queue) + [req],
+                             key=self._cost_key)
+                self.pressure_shed += 1
+                if tm is not None:
+                    tm.regulator_shed.inc()
+                exc = ServerOverloadError(
+                    "request shed by the overload regulator: queue at "
+                    "the tightened limit (%d < max_queue %d) and this "
+                    "is the highest-cost pending request"
+                    % (pressure, self.max_queue))
+                if victim is req:
+                    reject = exc
+                else:
+                    self._queue.remove(victim)
+                    if victim.deadline is not None:
+                        self._n_deadlined -= 1
+                    failures.append((victim, exc))
+            elif len(self._queue) >= self.max_queue:
                 if self.overload_policy == "shed-oldest":
                     victim = self._queue.popleft()
                     if victim.deadline is not None:
@@ -266,6 +314,47 @@ class AdmissionController(object):
         if self._telemetry is not None:
             self._telemetry.queue_depth.set(len(keep))
         return taken
+
+    # ------------------------------------------------------------ pressure
+    @staticmethod
+    def _cost_key(r):
+        """Cost-aware shed ranking: highest padded-element cost first,
+        oldest first among equals (old work is least likely to still
+        meet its deadline — the shed-oldest rationale)."""
+        return (r.cost if r.cost is not None else 0, -r.t_enqueue)
+
+    @property
+    def pressure(self):
+        return self._pressure
+
+    def apply_pressure(self, limit):
+        """Set (or withdraw, ``None``) the regulator's tightened queue
+        limit, shedding cost-aware down to it immediately — a limit
+        that only bites on the next admit would leave a deep queue
+        burning the deadline budget for seconds after the regulator
+        reacted.  Thread-safe; futures fail outside the lock."""
+        failures = []
+        tm = self._telemetry
+        with self._cond:
+            self._pressure = None if limit is None else max(1, int(limit))
+            shed_to = self._pressure
+            while shed_to is not None and len(self._queue) > shed_to:
+                victim = max(self._queue, key=self._cost_key)
+                self._queue.remove(victim)
+                if victim.deadline is not None:
+                    self._n_deadlined -= 1
+                self.pressure_shed += 1
+                if tm is not None:
+                    tm.regulator_shed.inc()
+                failures.append((victim, ServerOverloadError(
+                    "request shed by the overload regulator after "
+                    "%.1f ms queued: queue tightened to %d (max_queue "
+                    "%d) under a firing burn-rate rule"
+                    % ((time.monotonic() - victim.t_enqueue) * 1e3,
+                       shed_to, self.max_queue))))
+            if failures and tm is not None:
+                tm.queue_depth.set(len(self._queue))
+        self._deliver(failures)
 
     # -------------------------------------------------------------- expiry
     def _sweep_locked(self):
@@ -382,8 +471,10 @@ class AdmissionController(object):
         with self._cond:
             return {"queue_depth": len(self._queue),
                     "max_queue": self.max_queue,
+                    "pressure": self._pressure,
                     "overload_policy": self.overload_policy,
                     "admitted": self.admitted,
                     "rejected": self.rejected,
                     "shed": self.shed,
+                    "pressure_shed": self.pressure_shed,
                     "expired": self.expired}

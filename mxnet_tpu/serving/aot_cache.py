@@ -174,7 +174,13 @@ class AOTCache(object):
         self.misses = 0
         self.writes = 0
         self.rejects = 0
+        self.prunes = 0
         self.last_reject = None         # {"key","reason","time"}
+        # write-path size budget (MXNET_AOT_CACHE_MAX_MB): > 0 prunes
+        # oldest-first after every store() until the volume fits
+        from .. import config
+        self.max_bytes = int(
+            config.get("MXNET_AOT_CACHE_MAX_MB") * (1 << 20))
         # bound telemetry children, set post-construction by the
         # engine's bundle (None with telemetry off): (hits, misses,
         # writes, rejects) counter instances
@@ -206,16 +212,17 @@ class AOTCache(object):
         return cache
 
     # ------------------------------------------------------------ metrics
-    def bind_telemetry(self, hits, misses, writes, rejects):
+    def bind_telemetry(self, hits, misses, writes, rejects, prunes):
         """Attach the engine's bound ``mxnet_serve_aot_*_total``
         counter children and catch them up to events that happened
         before the telemetry bundle existed (nothing does today —
         program resolution is lazy, post-construction — but the
         catch-up keeps the counters honest if that ever changes)."""
         with self._lock:
-            self._tm = (hits, misses, writes, rejects)
+            self._tm = (hits, misses, writes, rejects, prunes)
             for child, v in zip(self._tm, (self.hits, self.misses,
-                                           self.writes, self.rejects)):
+                                           self.writes, self.rejects,
+                                           self.prunes)):
                 if v:
                     child.inc(v)
 
@@ -224,8 +231,8 @@ class AOTCache(object):
             setattr(self, which, getattr(self, which) + amount)
             tm = self._tm
         if tm is not None:
-            tm[("hits", "misses", "writes", "rejects").index(which)] \
-                .inc(amount)
+            tm[("hits", "misses", "writes", "rejects",
+                "prunes").index(which)].inc(amount)
 
     def _reject(self, key, reason):
         self.last_reject = {"key": key, "reason": reason,
@@ -240,7 +247,8 @@ class AOTCache(object):
             return {"enabled": True,
                     "dir": self.dir, "hits": self.hits,
                     "misses": self.misses, "writes": self.writes,
-                    "rejects": self.rejects,
+                    "rejects": self.rejects, "prunes": self.prunes,
+                    "max_bytes": self.max_bytes or None,
                     "last_reject": dict(self.last_reject)
                     if self.last_reject else None}
 
@@ -296,6 +304,14 @@ class AOTCache(object):
         except OSError as e:
             self._reject(key, "unreadable payload (%r)" % (e,))
             return None
+        from . import faults as _faults
+        if _faults.ACTIVE:
+            # chaos seam: a firing corrupt clause flips payload bytes
+            # BEFORE the integrity checks — the hash mismatch below
+            # must catch it, reject the entry, and self-heal with a
+            # fresh compile (the path the aot_reject alert watches)
+            payload = _faults.corrupt_bytes("aot.load", payload,
+                                            key=key[:16])
         if not isinstance(meta, dict) \
                 or meta.get("version") != ENTRY_VERSION:
             self._reject(key, "unknown entry version %r"
@@ -368,7 +384,46 @@ class AOTCache(object):
                        "programs" % (self.dir, e))
             return False
         self._count("writes")
+        if self.max_bytes > 0:
+            self._auto_prune(protect=key)
         return True
+
+    def _auto_prune(self, protect=None):
+        """Best-effort oldest-first eviction down to the
+        ``MXNET_AOT_CACHE_MAX_MB`` budget, run on the write path
+        (ROADMAP b3).  Concurrent-writer tolerant by construction:
+        the commit-marker metadata file is removed FIRST (a reader
+        racing it sees a vanished entry — a plain miss, never a
+        paging reject; load() already has that contract) and every
+        unlink tolerates ENOENT (the other writer's prune got there
+        first).  ``protect`` exempts the just-written key — a store
+        must never evict its own entry, however tight the budget."""
+        try:
+            entries = []
+            for key, meta_path, bin_path, meta in iter_entries(self.dir):
+                size = 0
+                for p in (meta_path, bin_path):
+                    try:
+                        size += os.path.getsize(p)
+                    except OSError:
+                        pass
+                entries.append((key, meta_path, bin_path, size))
+            total = sum(e[3] for e in entries)
+            for key, meta_path, bin_path, size in entries:
+                if total <= self.max_bytes:
+                    break
+                if key == protect:
+                    continue
+                for p in (meta_path, bin_path):  # marker first
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+                total -= size
+                self._count("prunes")
+        except Exception:
+            # janitoring must never break the store that triggered it
+            pass
 
 
 _XLA_CACHE_SET = False

@@ -73,10 +73,12 @@ import numpy as np
 
 from ..base import MXNetError
 from .. import telemetry as _telemetry
+from . import faults as _faults
 from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
 from .buckets import ProgramCache, _next_pow2
-from .engine import _ENGINE_SEQ, _percentile, aot_metric_families
+from .engine import (_ENGINE_SEQ, _percentile, aot_metric_families,
+                     _supervisor_state)
 from .replica import DecodeReplica, replica_contexts
 
 __all__ = ["DecodeEngine", "DecodeResult", "StepProgram", "greedy_decode",
@@ -593,6 +595,12 @@ class _DecodeTelemetry(object):
         self.shed = reg.counter(
             "mxnet_serve_shed_total",
             "requests shed under the shed-oldest overload policy")
+        self.regulator_shed = reg.counter(
+            "mxnet_serve_regulator_shed_total",
+            "requests shed cost-aware by the overload regulator's "
+            "tightened queue limit — deliberately NOT part of the "
+            "queue-saturation burn numerator (the regulator's own "
+            "sheds must not re-fire the rule it is resolving)")
         self.expired = reg.counter(
             "mxnet_serve_expired_total",
             "requests expired past their deadline while queued")
@@ -613,6 +621,12 @@ class _DecodeTelemetry(object):
             "mxnet_serve_decode_joins_total",
             "requests that joined the running decode batch (slot "
             "assigned between steps — never a retrace)")
+        self.steals = reg.counter(
+            "mxnet_serve_decode_steals_total",
+            "routed-but-unseated requests STOLEN by a sibling replica "
+            "with free slots (cross-replica work stealing: a request "
+            "queued behind a full pool re-offers instead of waiting "
+            "out its pinned replica's generations)")
         self.leaves = reg.counter(
             "mxnet_serve_decode_leaves_total",
             "requests that left the decode batch, by how generation "
@@ -782,6 +796,8 @@ class DecodeEngine(object):
                  overload_policy=None, ctx=None, dtype=np.float32,
                  start=True, sampler=None, replicas=None):
         from .. import config
+        # chaos plan (serving/faults.py): see ServingEngine
+        _faults.ensure_env_plan()
         if num_slots is None:
             num_slots = config.get("MXNET_DECODE_SLOTS")
         if max_len is None:
@@ -884,6 +900,7 @@ class DecodeEngine(object):
         self._lat_ms = collections.deque(maxlen=4096)
         self._steps = 0
         self._joins = 0
+        self._steals = 0
         self._leaves = 0
         self._evictions = 0
         self._tokens_out = 0
@@ -913,6 +930,19 @@ class DecodeEngine(object):
                     _telemetry.register_engine_default_rules(
                         "decode", self._tm.engine_label,
                         aot=self._aot is not None)
+        # self-healing control plane (ISSUE 12): see ServingEngine
+        self._regulator = None
+        if self._tm is not None and config.get("MXNET_REGULATOR"):
+            from .regulator import Regulator
+            self._regulator = Regulator(
+                self._adm, engine_label=self._tm.engine_label,
+                name=self._obs_name or "decode")
+        self._sup_owner = False
+        if config.get("MXNET_SUPERVISOR"):
+            from . import supervisor as _supervisor
+            _supervisor.engine_acquire(self,
+                                       name=self._obs_name or "decode")
+            self._sup_owner = True
         self._worker = None
         if start:
             self.start()
@@ -1074,6 +1104,15 @@ class DecodeEngine(object):
         requests run to completion first; otherwise queued futures
         fail with EngineClosedError and in-flight requests resolve
         with their PARTIAL tokens (finish_reason "closed")."""
+        # regulator first: a drain must not race a still-ticking
+        # regulator shedding the queued work it is trying to finish
+        if self._regulator is not None:
+            self._regulator.close()
+            self._regulator = None
+        if self._sup_owner:
+            from . import supervisor as _supervisor
+            self._sup_owner = False
+            _supervisor.engine_release(self)
         if not drain:
             self._abort = True
         self._adm.close(drain=drain)
@@ -1159,6 +1198,10 @@ class DecodeEngine(object):
                                              name="decode.request")
         req = DecodeRequest(prompt, max_new_tokens, fut,
                             deadline=deadline, trace=trace)
+        # padded-element cost for the regulator's cost-aware shed: a
+        # decode request prices as its bucketed prompt plus the
+        # positions its generation budget can occupy
+        req.cost = int(_next_pow2(len(prompt)) + max_new_tokens)
         # a deadline hit — queued or mid-generation — COMPLETES the
         # request with whatever was generated (admission._deliver
         # routes DeadlineExceededError through this instead of failing)
@@ -1381,10 +1424,32 @@ class DecodeEngine(object):
                 return
             self._sweep_pending(rep, time.monotonic())
             seats = []
+            stolen = 0
             with self._dr_lock:
                 n_free = rep.free_slots()
                 while rep.pending and len(seats) < n_free:
                     seats.append(rep.pending.popleft())
+                if len(seats) < n_free and rep.healthy:
+                    # cross-replica work stealing (ROADMAP a3): a
+                    # request routed to a sibling whose pool is FULL
+                    # would otherwise wait a whole generation for its
+                    # pinned replica — re-offer it here instead (it
+                    # has not seated, so no device state moves).  The
+                    # window exists after a failure re-route overflows
+                    # a sibling, or when a pool saturates between the
+                    # router's capacity check and the seat.
+                    for sib in self._replicas:
+                        if sib is rep or len(seats) >= n_free:
+                            continue
+                        while sib.pending and sib.free_slots() == 0 \
+                                and len(seats) < n_free:
+                            seats.append(sib.pending.popleft())
+                            stolen += 1
+            if stolen:
+                with self._lock:
+                    self._steals += stolen
+                if self._tm is not None:
+                    self._tm.steals.inc(stolen)
             for req in seats:
                 self._seat(rep, req)
             if not rep.occupied_count():
@@ -1487,7 +1552,7 @@ class DecodeEngine(object):
                 self._assign(req)
         self._slot_free.set()
 
-    def rehabilitate(self):
+    def rehabilitate(self, replicas=None):
         """Replica probation/re-warm (ROADMAP follow-up a2): rebuild
         every retired replica's programs from scratch (its donated
         state buffers may be consumed), re-warm them — drawn from the
@@ -1498,13 +1563,18 @@ class DecodeEngine(object):
         scratch state — deterministic for stochastic samplers too).
         A replica that fails any stage stays retired.
 
-        Returns one outcome dict per previously-unhealthy replica:
+        ``replicas`` restricts probation to those replica indices
+        (the supervisor's one-due-replica-at-a-time calls; None =
+        every unhealthy replica).
+
+        Returns one outcome dict per attempted replica:
         ``{"replica", "ok", "reason"}``.
         """
         if self._adm.closed:
             raise EngineClosedError("decode engine is closed")
+        want = None if replicas is None else {int(i) for i in replicas}
         return [self._rehabilitate_one(r) for r in self._replicas
-                if not r.healthy]
+                if not r.healthy and (want is None or r.index in want)]
 
     def _rehabilitate_one(self, rep):
         out = {"replica": rep.label, "ok": False, "reason": None}
@@ -1617,6 +1687,10 @@ class DecodeEngine(object):
         the pow2 bucket grid, run the prefill program (batch 1), sample
         the last-valid-position logits into the first generated token,
         scatter the output state rows into the free slot."""
+        if _faults.ACTIVE:
+            # chaos seam: fails exactly ONE request (the joining one),
+            # never the pool — the per-request prefill isolation path
+            _faults.trip("decode.prefill", replica=rep.label)
         plen = len(req.prompt)
         bucket = next(b for b in rep.prefill_buckets if b >= plen)
         arr = np.zeros((1, bucket), np.float32)
@@ -1661,6 +1735,11 @@ class DecodeEngine(object):
                 occ.append(i)
         if not occ:
             return
+        if _faults.ACTIVE:
+            # chaos seam: a raise retires this replica through the
+            # real step-failure path (partial-output eviction +
+            # re-route); a hang wedges the pool for the watchdog
+            _faults.trip("decode.step", replica=rep.label)
         sampled, rep.states = rep.program.step(
             rep.tokens_np, rep.pos_np, rep.valid_np, rep.states,
             reset=rep.reset_np)
@@ -1838,6 +1917,7 @@ class DecodeEngine(object):
                 "steps": self._steps,
                 "tokens_generated": self._tokens_out,
                 "joins": self._joins,
+                "steals": self._steals,
                 "leaves": self._leaves,
                 "evictions": self._evictions,
                 "requests_served": self._requests_served,
@@ -1862,4 +1942,9 @@ class DecodeEngine(object):
                     "p99": _percentile(lat, 0.99),
                 },
             }
+        snap["supervisor"] = _supervisor_state(self)
+        snap["regulator"] = (self._regulator.stats()
+                             if self._regulator is not None
+                             else {"enabled": False})
+        snap["faults"] = _faults.stats()
         return snap

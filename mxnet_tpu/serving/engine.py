@@ -69,6 +69,7 @@ import numpy as np
 from ..base import MXNetError
 from .. import profiler
 from .. import telemetry as _telemetry
+from . import faults as _faults
 from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
 from .buckets import BucketPolicy, ProgramCache, pad_valid_lengths
@@ -106,11 +107,13 @@ _NULL_COUNTER = _telemetry.Counter()
 def aot_metric_families(reg):
     """Register (idempotently) the persistent-AOT-cache traffic
     families both engine kinds share — ``mxnet_serve_aot_{hits,misses,
-    writes,rejects}_total``, per engine.  Hits are programs loaded
-    from disk with zero traces; misses compiled fresh and persisted;
-    writes are entries committed; rejects are present-but-unusable
-    entries (corruption / fingerprint drift) — the "cold start that
-    should have been warm" signal the default alert rule fires on."""
+    writes,rejects,prunes}_total``, per engine.  Hits are programs
+    loaded from disk with zero traces; misses compiled fresh and
+    persisted; writes are entries committed; rejects are
+    present-but-unusable entries (corruption / fingerprint drift) —
+    the "cold start that should have been warm" signal the default
+    alert rule fires on; prunes are entries evicted oldest-first by
+    the ``MXNET_AOT_CACHE_MAX_MB`` write-path size budget."""
     return tuple(reg.counter(
         "mxnet_serve_aot_%s_total" % what, doc, labelnames=("engine",))
         for what, doc in (
@@ -124,7 +127,21 @@ def aot_metric_families(reg):
                         "corrupt payload or fingerprint drift — "
                         "forcing a cold compile that should have "
                         "been warm (alertable; the engine's stats() "
-                        "names the offending key)")))
+                        "names the offending key)"),
+            ("prunes", "AOT-cache entries evicted oldest-first by "
+                       "the MXNET_AOT_CACHE_MAX_MB size budget on "
+                       "the store() write path")))
+
+
+def _supervisor_state(engine):
+    """One engine's ``stats()["supervisor"]`` block: the live process
+    supervisor's per-engine slice, ``{"enabled": False}`` otherwise.
+    Shared by both engine kinds (decode imports it)."""
+    try:
+        from . import supervisor as _supervisor
+        return _supervisor.engine_state(engine)
+    except Exception:
+        return {"enabled": False}
 
 
 class _EngineTelemetry(object):
@@ -222,6 +239,12 @@ class _EngineTelemetry(object):
         self.shed = reg.counter(
             "mxnet_serve_shed_total",
             "requests shed under the shed-oldest overload policy")
+        self.regulator_shed = reg.counter(
+            "mxnet_serve_regulator_shed_total",
+            "requests shed cost-aware by the overload regulator's "
+            "tightened queue limit — deliberately NOT part of the "
+            "queue-saturation burn numerator (the regulator's own "
+            "sheds must not re-fire the rule it is resolving)")
         self.expired = reg.counter(
             "mxnet_serve_expired_total",
             "requests expired past their deadline while queued")
@@ -399,6 +422,10 @@ class ServingEngine(object):
                  overload_policy=None, dtype=np.float32, start=True,
                  replicas=None):
         from .. import config
+        # chaos plan (serving/faults.py): installs MXNET_FAULT_PLAN if
+        # one is named; with none the injection sites stay a single
+        # predicate check and the engine is byte-for-byte uninjected
+        _faults.ensure_env_plan()
         self._policy = policy or BucketPolicy.from_config()
         if max_queue is None:
             max_queue = config.get("MXNET_SERVE_MAX_QUEUE")
@@ -566,6 +593,23 @@ class ServingEngine(object):
                     _telemetry.register_engine_default_rules(
                         "serve", self._tm.engine_label,
                         aot=self._aot is not None)
+        # self-healing control plane (ISSUE 12), both OFF by default:
+        # the SLO-driven overload regulator (reads the burn-rate rule
+        # states, adapts admission pressure) and the automatic
+        # probation supervisor (drives rehabilitate() on a backoff
+        # clock when a replica retires)
+        self._regulator = None
+        if self._tm is not None and config.get("MXNET_REGULATOR"):
+            from .regulator import Regulator
+            self._regulator = Regulator(
+                self._adm, engine_label=self._tm.engine_label,
+                name=self._obs_name or "serve")
+        self._sup_owner = False
+        if config.get("MXNET_SUPERVISOR"):
+            from . import supervisor as _supervisor
+            _supervisor.engine_acquire(self,
+                                       name=self._obs_name or "serve")
+            self._sup_owner = True
         self._worker = None
         if start:
             self.start()
@@ -837,6 +881,15 @@ class ServingEngine(object):
         queue needs; the no-drain path fails pending futures and bounds
         the wait.  The worker handle is only cleared once the thread is
         actually dead."""
+        # stop the overload regulator FIRST: a drain must complete the
+        # queued work, not have a still-ticking regulator shed it
+        if self._regulator is not None:
+            self._regulator.close()
+            self._regulator = None
+        if self._sup_owner:
+            from . import supervisor as _supervisor
+            self._sup_owner = False
+            _supervisor.engine_release(self)
         self._adm.close(drain=drain)
         if self._worker is not None:
             self._worker.join(timeout=None if drain else 60)
@@ -959,7 +1012,13 @@ class ServingEngine(object):
             _, out_shapes, _ = self._sym.infer_shape(
                 **{k: (1,) + v.shape for k, v in feeds.items()})
             out_rows = tuple(tuple(s[1:]) for s in out_shapes)
-        out = tuple(group), out_rows
+        # padded-element cost: what this request occupies in a
+        # dispatched batch (the per-bucket padded/live element
+        # accounting prices batches with exactly these numbers) —
+        # the overload regulator's cost-aware shed ranks by it
+        cost = int(sum(int(np.prod(shape)) if shape else 1
+                       for _name, shape in group))
+        out = tuple(group), out_rows, cost
         if sig is not None:
             self._group_cache[sig] = out
         return out
@@ -989,7 +1048,7 @@ class ServingEngine(object):
             raise EngineClosedError("serving engine is closed")
         feeds = {k: np.asarray(v, dtype=self._dtype)
                  for k, v in feeds.items()}
-        group, out_rows = self._group_for(feeds)
+        group, out_rows, cost = self._group_for(feeds)
         if deadline_ms is None and self._default_deadline_s > 0:
             deadline_ms = self._default_deadline_s * 1e3
         deadline = None if not deadline_ms else \
@@ -1007,7 +1066,7 @@ class ServingEngine(object):
                 # minority materializes a real span tree
                 trace = _telemetry.LazyTrace(self._trace_chain)
         req = Request(feeds, group, fut, deadline=deadline,
-                      out_rows=out_rows, trace=trace)
+                      out_rows=out_rows, trace=trace, cost=cost)
         try:
             if profiler.is_running():
                 with profiler.record_span("serve.enqueue", "serve"):
@@ -1263,7 +1322,7 @@ class ServingEngine(object):
             except Exception as e2:
                 self._fail_batch(reqs, e2)
 
-    def rehabilitate(self):
+    def rehabilitate(self, replicas=None):
         """Replica probation/re-warm (ROADMAP follow-up a2): give every
         retired replica a path back into service instead of permanent
         retirement.  Each unhealthy replica gets a FRESH program cache
@@ -1274,13 +1333,18 @@ class ServingEngine(object):
         sibling's output bitwise before the replica takes traffic
         again.  A replica that fails any stage stays retired.
 
-        Returns one outcome dict per previously-unhealthy replica:
+        ``replicas`` restricts probation to those replica indices (the
+        supervisor rehabs one due replica at a time; None = every
+        unhealthy replica, the operator verb).
+
+        Returns one outcome dict per attempted replica:
         ``{"replica", "ok", "reason", "warmed"}``.
         """
         if self._adm.closed:
             raise EngineClosedError("serving engine is closed")
+        want = None if replicas is None else {int(i) for i in replicas}
         return [self._rehabilitate_one(r) for r in self._replicas
-                if not r.healthy]
+                if not r.healthy and (want is None or r.index in want)]
 
     def _rehabilitate_one(self, r):
         out = {"replica": r.label, "ok": False, "reason": None,
@@ -1436,6 +1500,12 @@ class ServingEngine(object):
             # 2049), and the spliced variable declares float32
             feeds[self._valid_name] = pad_valid_lengths(
                 [self._live_length(r) for r in reqs], b)
+        if _faults.ACTIVE:
+            # chaos seam: a raise here rides the REAL failure path —
+            # multi-replica dispatch threads retire the replica and
+            # re-route its queue; the single-replica worker fails the
+            # batch and keeps serving
+            _faults.trip("serve.dispatch", replica=rep.label)
         c0 = rep.cache.compile_count
         t_disp0 = time.perf_counter()
         with profiler.record_span(
@@ -1687,6 +1757,11 @@ class ServingEngine(object):
                 "replicas": [r.describe() for r in self._replicas],
                 "aot": (self._aot.stats() if self._aot is not None
                         else {"enabled": False}),
+                "supervisor": _supervisor_state(self),
+                "regulator": (self._regulator.stats()
+                              if self._regulator is not None
+                              else {"enabled": False}),
+                "faults": _faults.stats(),
                 "repairs": {
                     "applied": (len(self.repair_plan.actions)
                                 if self.repair_plan is not None else 0),

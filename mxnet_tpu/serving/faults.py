@@ -1,0 +1,392 @@
+"""Deterministic fault injection for the serving tier (ISSUE 12).
+
+Chaos testing the replica/failover/rehabilitation machinery used to
+mean hand-rolled monkeypatches — flaky, schedule-dependent, and
+impossible to replay.  This module turns a chaos schedule into a
+reproducible test fixture: a :class:`FaultPlan` (env
+``MXNET_FAULT_PLAN`` or programmatic :func:`install`) names WHERE a
+fault fires (an injection *site* threaded through the hot paths), WHEN
+(a deterministic trigger: the Nth matching hit, every Kth, or a seeded
+coin), and WHAT (raise, hang, or corrupt bytes).  The same plan over
+the same request sequence injects the same faults — CI can assert
+"replica 1 dies on its 5th step, the fleet degrades gracefully, the
+supervisor heals it" as a plain deterministic test.
+
+Injection sites (each names the hot path it interrupts):
+
+- ``serve.dispatch``   one-shot replica batch dispatch (engine.py) —
+                       ``raise`` retires the replica through the real
+                       failover path; ``hang`` wedges it long enough
+                       for the watchdog to name it;
+- ``decode.step``      decode step dispatch (decode.py) — ``raise``
+                       evicts seated requests with partial output and
+                       retires the replica;
+- ``decode.prefill``   bucketed prefill dispatch — fails ONE request,
+                       never the pool;
+- ``aot.load``         AOT-cache payload read (aot_cache.py) —
+                       ``corrupt`` flips payload bytes so the load is
+                       REJECTED (hash mismatch) and self-heals with a
+                       fresh compile, exercising the
+                       cold-start-that-should-have-been-warm alert;
+- ``admission.admit``  request admission (admission.py) — ``hang``
+                       stalls the submitting client (front-door
+                       latency injection).
+
+Sites pass context labels (``replica=...``) a clause may filter on.
+
+**Zero overhead when disabled**: every site is guarded by the module
+flag ``ACTIVE`` (one global read); with no plan installed the serving
+stack is byte-for-byte the uninjected engine — the acceptance tests
+pin that bitwise.
+
+Plan grammar (``MXNET_FAULT_PLAN``): JSON (a list of clause dicts) or
+the compact form ``site:action[:k=v,k=v];site:action...``::
+
+    decode.step:raise:on=5,replica=1;aot.load:corrupt:on=1
+    serve.dispatch:hang:hang_s=0.5,every=10
+    admission.admit:raise:p=0.01,seed=7,times=3
+
+Clause keys: ``on`` (fire exactly on the Nth matching hit, 1-based),
+``after`` (every matching hit past the Nth), ``every`` (every Kth),
+``p`` + ``seed`` (seeded Bernoulli per hit — deterministic given the
+hit sequence), ``times`` (max fires, default 1 for ``on``, unbounded
+otherwise), ``hang_s`` (hang duration, default 0.2), plus any label
+filter (``replica=1``).  Fired faults are counted per site/action
+(:func:`stats`, ``mxnet_serve_faults_injected_total``).
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+from ..base import MXNetError
+
+__all__ = ["FaultInjected", "FaultPlan", "install", "clear", "plan",
+           "ensure_env_plan", "trip", "corrupt_bytes", "stats",
+           "SITES", "ACTIVE"]
+
+# the named injection sites threaded through the serving hot paths —
+# a clause naming anything else is a typo'd plan, refused at parse
+SITES = ("serve.dispatch", "decode.step", "decode.prefill",
+         "aot.load", "admission.admit")
+
+_ACTIONS = ("raise", "hang", "corrupt")
+
+
+class FaultInjected(MXNetError):
+    """The error a ``raise`` clause injects — a distinct type so tests
+    (and retry layers) can tell an injected fault from a real one."""
+
+
+class _Clause(object):
+    """One fault rule: site + trigger + action + label filters."""
+    __slots__ = ("site", "action", "on", "after", "every", "p", "seed",
+                 "times", "hang_s", "labels", "hits", "fires", "_rng")
+
+    def __init__(self, site, action, on=None, after=None, every=None,
+                 p=None, seed=0, times=None, hang_s=0.2, **labels):
+        if site not in SITES:
+            raise MXNetError("unknown fault site %r (sites: %s)"
+                             % (site, list(SITES)))
+        if action not in _ACTIONS:
+            raise MXNetError("unknown fault action %r (actions: %s)"
+                             % (action, list(_ACTIONS)))
+        if action == "corrupt" and site != "aot.load":
+            raise MXNetError("fault action 'corrupt' only applies to "
+                             "the aot.load site")
+        self.site = site
+        self.action = action
+        self.on = None if on is None else int(on)
+        self.after = None if after is None else int(after)
+        self.every = None if every is None else int(every)
+        self.p = None if p is None else float(p)
+        self.seed = int(seed)
+        if times is None:
+            # a bare `on=N` clause is a one-shot by construction
+            times = 1 if (self.on is not None
+                          and self.after is None
+                          and self.every is None
+                          and self.p is None) else 0
+        self.times = int(times)         # 0 = unbounded
+        self.hang_s = float(hang_s)
+        self.labels = {k: str(v) for k, v in labels.items()}
+        if not any(x is not None
+                   for x in (self.on, self.after, self.every, self.p)):
+            # no trigger = every matching hit
+            self.after = 0
+        self.hits = 0
+        self.fires = 0
+        # per-clause stream: deterministic given the matched-hit
+        # sequence, independent of other clauses and of process rng
+        self._rng = random.Random(self.seed)
+
+    def matches(self, labels):
+        return all(labels.get(k) == v for k, v in self.labels.items())
+
+    def should_fire(self):
+        """Called with the plan lock held, once per matching hit."""
+        self.hits += 1
+        if self.times and self.fires >= self.times:
+            return False
+        fire = False
+        if self.on is not None and self.hits == self.on:
+            fire = True
+        if self.after is not None and self.hits > self.after:
+            fire = True
+        if self.every is not None and self.hits % self.every == 0:
+            fire = True
+        if self.p is not None and self._rng.random() < self.p:
+            fire = True
+        if fire:
+            self.fires += 1
+        return fire
+
+    def describe(self):
+        d = {"site": self.site, "action": self.action,
+             "hits": self.hits, "fires": self.fires}
+        for k in ("on", "after", "every", "p", "times"):
+            v = getattr(self, k)
+            if v:
+                d[k] = v
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        return d
+
+
+class FaultPlan(object):
+    """An ordered set of clauses plus its fired-fault accounting.
+    Clause trigger state (hit counters, rng streams) lives in the plan,
+    so installing the same spec twice replays the same schedule."""
+
+    def __init__(self, clauses):
+        self.clauses = list(clauses)
+        self._lock = threading.Lock()
+        self.injected = {}          # (site, action) -> count
+
+    # ---------------------------------------------------------- parsing
+    @classmethod
+    def from_spec(cls, spec):
+        """Parse a plan from the env grammar (JSON or compact)."""
+        spec = spec.strip()
+        if not spec:
+            raise MXNetError("empty fault plan spec")
+        if spec[0] in "[{":
+            doc = json.loads(spec)
+            rows = doc.get("faults") if isinstance(doc, dict) else doc
+            if not isinstance(rows, list):
+                raise MXNetError("JSON fault plan must be a list of "
+                                 "clause dicts (or {'faults': [...]})")
+            return cls([_Clause(**{str(k): v for k, v in row.items()})
+                        for row in rows])
+        clauses = []
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.split(":", 2)
+            if len(bits) < 2:
+                raise MXNetError(
+                    "fault clause %r: want site:action[:k=v,...]" % part)
+            kwargs = {}
+            if len(bits) == 3 and bits[2].strip():
+                for kv in bits[2].split(","):
+                    if "=" not in kv:
+                        raise MXNetError(
+                            "fault clause %r: %r is not k=v" % (part, kv))
+                    k, v = kv.split("=", 1)
+                    kwargs[k.strip()] = v.strip()
+            clauses.append(_Clause(bits[0].strip(), bits[1].strip(),
+                                   **kwargs))
+        if not clauses:
+            raise MXNetError("fault plan %r parsed to no clauses" % spec)
+        return cls(clauses)
+
+    # --------------------------------------------------------- evaluation
+    def _fired(self, labels):
+        """The firing clauses for one site hit, trigger state advanced
+        under the plan lock (hit ordering is the caller's schedule)."""
+        site = labels["site"]
+        out = []
+        with self._lock:
+            for c in self.clauses:
+                if c.site == site and c.matches(labels) \
+                        and c.should_fire():
+                    out.append(c)
+                    self.injected[(site, c.action)] = \
+                        self.injected.get((site, c.action), 0) + 1
+        return out
+
+    def describe(self):
+        with self._lock:
+            return {"clauses": [c.describe() for c in self.clauses],
+                    "injected": {"%s:%s" % k: v
+                                 for k, v in self.injected.items()}}
+
+
+# -- the installed plan ------------------------------------------------------
+#
+# ACTIVE is the one flag every injection site reads: False means no
+# plan and the site is a single predicate check (the zero-overhead
+# contract).  Writes happen under _STATE_LOCK; the flag/plan pair is
+# read unlocked on the hot path — a torn read at worst skips or
+# double-checks one hit during install, which a deterministic test
+# never races anyway.
+
+ACTIVE = False
+_PLAN = None
+_ENV_SPEC = None                # the spec ensure_env_plan installed
+_STATE_LOCK = threading.Lock()
+
+
+def install(plan_or_spec):
+    """Install a plan (FaultPlan, spec string, or clause list) as the
+    process-wide fault schedule.  Returns the installed FaultPlan."""
+    global ACTIVE, _PLAN
+    if isinstance(plan_or_spec, FaultPlan):
+        p = plan_or_spec
+    elif isinstance(plan_or_spec, str):
+        p = FaultPlan.from_spec(plan_or_spec)
+    else:
+        p = FaultPlan(plan_or_spec)
+    with _STATE_LOCK:
+        _PLAN = p
+        ACTIVE = True
+    return p
+
+
+def clear():
+    """Remove the installed plan: every site reverts to its no-op."""
+    global ACTIVE, _PLAN, _ENV_SPEC
+    with _STATE_LOCK:
+        _PLAN = None
+        _ENV_SPEC = None
+        ACTIVE = False
+
+
+def plan():
+    """The installed FaultPlan, or None."""
+    return _PLAN
+
+
+def ensure_env_plan():
+    """Engine-construction hook: install (once) the plan
+    ``MXNET_FAULT_PLAN`` names.  Re-reads the env each call so a test
+    can point a fresh engine at a fresh plan, but never clobbers a
+    programmatically installed plan with the same env spec twice (the
+    clause hit counters are the schedule — resetting them mid-run
+    would replay fired faults).  A malformed spec warns and installs
+    nothing: a typo'd chaos knob must not take down serving."""
+    global _ENV_SPEC
+    from .. import config
+    spec = config.get("MXNET_FAULT_PLAN").strip()
+    if not spec:
+        return None
+    with _STATE_LOCK:
+        if ACTIVE and (_ENV_SPEC is None or _ENV_SPEC == spec):
+            # a PROGRAMMATIC install (env spec never recorded) always
+            # wins over the env: replacing it would reset clause hit
+            # counters and replay already-fired one-shot faults
+            return _PLAN
+    try:
+        p = install(spec)
+    except Exception as e:
+        import warnings
+        warnings.warn("MXNET_FAULT_PLAN: cannot parse %r (%s); no "
+                      "faults installed" % (spec, e))
+        return None
+    with _STATE_LOCK:
+        _ENV_SPEC = spec
+    return p
+
+
+def _tm_count(site, action):
+    """Count one injected fault in the registry — lazily, only when a
+    fault actually fires, so a disabled plan leaves zero series."""
+    try:
+        from .. import telemetry
+        if telemetry.enabled():
+            telemetry.counter(
+                "mxnet_serve_faults_injected_total",
+                "faults injected by the active MXNET_FAULT_PLAN, by "
+                "site and action (serving/faults.py) — nonzero in "
+                "production means a chaos plan is live",
+                labelnames=("site", "action")).labels(
+                    site=site, action=action).inc()
+    except Exception:
+        pass
+
+
+def trip(site, **labels):
+    """One injection-site hit.  No-op without a plan; with one, any
+    matching ``raise`` clause raises :class:`FaultInjected` and any
+    matching ``hang`` clause sleeps ``hang_s`` first (a hang then a
+    raise composes: wedge, then die — the watchdog-plus-failover
+    drill).  Callers gate on ``faults.ACTIVE`` so the disabled path
+    costs one global read."""
+    p = _PLAN
+    if p is None:
+        return
+    labels = {k: str(v) for k, v in labels.items()}
+    labels["site"] = site
+    exc = None
+    for c in p._fired(labels):
+        _tm_count(site, c.action)
+        if c.action == "hang":
+            time.sleep(c.hang_s)
+        elif c.action == "raise":
+            exc = FaultInjected(
+                "injected fault at %s (hit %d%s)"
+                % (site, c.hits,
+                   "".join(", %s=%s" % kv
+                           for kv in sorted(c.labels.items()))))
+    if exc is not None:
+        raise exc
+
+
+def corrupt_bytes(site, payload, **labels):
+    """The ``corrupt`` action's seam (aot.load): when a matching
+    clause fires, return ``payload`` with bytes flipped — downstream
+    integrity checks (the AOT entry's sha256) must detect and REJECT
+    it, which is exactly the self-healing path under test.  Without a
+    firing clause the payload passes through untouched."""
+    p = _PLAN
+    if p is None:
+        return payload
+    labels = {k: str(v) for k, v in labels.items()}
+    labels["site"] = site
+    fired = []
+    for c in p._fired(labels):
+        _tm_count(site, c.action)
+        if c.action == "hang":
+            time.sleep(c.hang_s)
+        elif c.action == "raise":
+            # a raise at a byte-stream site still fires: the caller's
+            # own failure discipline (degrade to a fresh compile) is
+            # exactly what is under test
+            raise FaultInjected("injected fault at %s (hit %d)"
+                                % (site, c.hits))
+        else:
+            fired.append(c)
+    if not fired:
+        return payload
+    if not payload:
+        return b"\xff"
+    buf = bytearray(payload)
+    # flip a deterministic spread of bytes: enough to guarantee the
+    # hash check trips whatever the payload
+    for i in range(0, len(buf), max(1, len(buf) // 8)):
+        buf[i] ^= 0xFF
+    return bytes(buf)
+
+
+def stats():
+    """{"active", "clauses", "injected"} for /healthz and engine
+    stats() — what chaos is live and what it has done so far."""
+    p = _PLAN
+    if p is None:
+        return {"active": False}
+    d = p.describe()
+    d["active"] = True
+    return d

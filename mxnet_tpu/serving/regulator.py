@@ -1,0 +1,247 @@
+"""SLO-driven overload regulator — the actuator half of ROADMAP item 3
+(ISSUE 12).
+
+The sensor plane is done (PR 9): ``recorder.rate()`` windows, the
+``serve_queue_saturation_burn`` and ``serve_deadline_miss_burn``
+burn-rate rules.  Until now a firing rule PAGED — an operator read the
+flight bundle and tightened admission by hand.  This module closes the
+loop in-process: a per-engine regulator thread reads the burn-rule
+states (and the request-rate window, for the record) every evaluation
+cycle and adapts the engine's :class:`AdmissionController`:
+
+- **tighten** while a watched rule FIRES: the effective queue limit
+  halves per cycle (never below ``MXNET_REGULATOR_MIN_QUEUE``), and
+  the controller sheds down to it **cost-aware** — the highest
+  padded-element-cost request goes first, priced by the same
+  padded-elements accounting the padding-waste counters carry.
+  Shedding expensive work first buys the most queue drain per lost
+  request, which is what turns a deadline-miss burn around;
+- **relax** once every watched rule has been quiet for
+  ``relax_after`` consecutive cycles: the limit doubles per cycle
+  back up to the configured ``max_queue``, at which point pressure is
+  withdrawn entirely and admission is byte-for-byte the unregulated
+  engine again.
+
+AIMD, deliberately: multiplicative decrease reacts to a burn within
+one evaluation cycle; gentle recovery avoids oscillating back into
+overload (the TCP congestion-control shape, applied to a queue).
+
+Observability: ``mxnet_serve_regulator_limit`` /
+``mxnet_serve_regulator_overload`` gauges and
+``mxnet_serve_regulator_adjustments_total{direction}`` per engine
+(reclaimed at close), ``stats()["regulator"]``, and the rule states
+themselves on ``GET /alerts``.
+
+Enabled by ``MXNET_REGULATOR=1`` (requires telemetry + a running
+history recorder, since the burn rules evaluate there).  Off by
+default — the acceptance tests pin that admission behavior is then
+bitwise-identical to the unregulated engine.  Tests drive
+:meth:`Regulator.evaluate_once` by hand against their own
+AlertManager, no thread required.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import telemetry as _telemetry
+
+__all__ = ["Regulator", "WATCHED_RULES"]
+
+# the burn-rate rules the regulator actuates on (alerts.py registers
+# them shared across engines): saturation = availability budget,
+# deadline-miss = latency budget — both resolve by shedding load
+WATCHED_RULES = ("serve_queue_saturation_burn",
+                 "serve_deadline_miss_burn")
+
+
+def _regulator_metric_families(reg):
+    limit = reg.gauge(
+        "mxnet_serve_regulator_limit",
+        "effective admission-queue limit the overload regulator "
+        "holds, per engine (== max_queue when relaxed / steady-state)",
+        labelnames=("engine",))
+    overload = reg.gauge(
+        "mxnet_serve_regulator_overload",
+        "1 while a watched burn-rate rule is firing and the regulator "
+        "is tightening admission, else 0, per engine",
+        labelnames=("engine",))
+    adjustments = reg.counter(
+        "mxnet_serve_regulator_adjustments_total",
+        "regulator actuations by direction: tighten (limit halved "
+        "under a firing burn rule) / relax (limit doubled after the "
+        "burn resolved)",
+        labelnames=("engine", "direction"))
+    return limit, overload, adjustments
+
+
+class Regulator(object):
+    """One engine's overload-control loop.
+
+    Parameters: ``admission`` (the engine's AdmissionController),
+    ``engine_label`` (metric label; None = no instruments), ``name``
+    (for logs/stats), ``manager``/``recorder_fn`` (injectable for
+    tests; default the process alert manager and recorder),
+    ``rules`` (watched rule names), ``start=False`` builds a
+    regulator tests step with :meth:`evaluate_once`.
+    """
+
+    def __init__(self, admission, engine_label=None, name=None,
+                 interval_s=None, floor=None, relax_after=2,
+                 manager=None, recorder_fn=None, rules=WATCHED_RULES,
+                 start=True):
+        from .. import config
+        if interval_s is None:
+            interval_s = config.get("MXNET_REGULATOR_INTERVAL_MS") / 1e3
+        if floor is None:
+            floor = config.get("MXNET_REGULATOR_MIN_QUEUE")
+        self._adm = admission
+        self.name = name or "engine"
+        self.engine_label = engine_label
+        self.interval_s = float(interval_s)
+        self.max_queue = int(admission.max_queue)
+        self.floor = max(1, min(int(floor), self.max_queue))
+        self.relax_after = int(relax_after)
+        self.rules = tuple(rules)
+        self._manager = manager
+        self._recorder_fn = recorder_fn
+        self._limit = self.max_queue    # effective limit (no pressure)
+        self._overload = False
+        self._calm_cycles = 0
+        self.tightenings = 0
+        self.relaxations = 0
+        self.last_decision = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._tm = None
+        if self.engine_label is not None and _telemetry.enabled():
+            fams = _regulator_metric_families(_telemetry.registry())
+            self._tm = tuple(
+                fam.labels(engine=self.engine_label)
+                if i < 2 else fam
+                for i, fam in enumerate(fams))
+            self._tm[0].set(self._limit)
+            self._tm[1].set(0.0)
+        if start:
+            self._thread = threading.Thread(
+                target=self._run,
+                name="mxnet-serve-regulator-%s" % self.name,
+                daemon=True)
+            self._thread.start()
+
+    # -------------------------------------------------------------- sensing
+    def _mgr(self):
+        if self._manager is not None:
+            return self._manager
+        return _telemetry.default_manager()
+
+    def _recorder(self):
+        if self._recorder_fn is not None:
+            return self._recorder_fn()
+        return _telemetry.get_recorder()
+
+    def _rule_states(self):
+        mgr = self._mgr()
+        out = {}
+        for name in self.rules:
+            try:
+                out[name] = mgr.state_of(name)
+            except Exception:
+                out[name] = None
+        return out
+
+    # ------------------------------------------------------------- actuation
+    def evaluate_once(self, now=None):
+        """One control cycle; returns the decision record (also kept
+        as ``last_decision``).  Safe to call from tests without the
+        thread — all state transitions happen here."""
+        now = time.monotonic() if now is None else now
+        states = self._rule_states()
+        firing = any(s == "firing" for s in states.values())
+        rec = self._recorder()
+        req_rate = None
+        if rec is not None:
+            try:
+                req_rate = rec.rate("mxnet_serve_requests_total",
+                                    window_s=30.0)
+            except Exception:
+                req_rate = None
+        action = "hold"
+        with self._lock:
+            if firing:
+                self._overload = True
+                self._calm_cycles = 0
+                new = max(self.floor, self._limit // 2)
+                if new < self._limit:
+                    self._limit = new
+                    self.tightenings += 1
+                    action = "tighten"
+            else:
+                if self._overload:
+                    self._calm_cycles += 1
+                    if self._calm_cycles >= self.relax_after:
+                        new = min(self.max_queue, self._limit * 2)
+                        if new > self._limit:
+                            self._limit = new
+                            self.relaxations += 1
+                            action = "relax"
+                        if self._limit >= self.max_queue:
+                            # steady state: withdraw pressure entirely
+                            self._overload = False
+                            self._calm_cycles = 0
+            limit = self._limit
+            pressure = limit if limit < self.max_queue else None
+        # actuate OUTSIDE the regulator lock: apply_pressure delivers
+        # shed futures (client callbacks run there)
+        self._adm.apply_pressure(pressure)
+        if self._tm is not None:
+            self._tm[0].set(limit)
+            self._tm[1].set(1.0 if firing else 0.0)
+            if action != "hold":
+                self._tm[2].labels(
+                    engine=self.engine_label,
+                    direction=action).inc()
+        self.last_decision = {
+            "t": now, "action": action, "firing": firing,
+            "rule_states": states, "limit": limit,
+            "pressure": pressure, "request_rate_per_s": req_rate}
+        return self.last_decision
+
+    # ------------------------------------------------------------- lifecycle
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate_once()
+            except Exception:
+                pass        # regulation must never die of one cycle
+
+    def close(self):
+        """Stop the loop, withdraw pressure (a closing engine must not
+        keep shedding its drain), reclaim this engine's series."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        try:
+            self._adm.apply_pressure(None)
+        except Exception:
+            pass
+        if self._tm is not None and _telemetry.enabled():
+            _telemetry.remove_labeled_series(
+                _regulator_metric_families(_telemetry.registry()),
+                self.engine_label)
+            self._tm = None
+
+    def stats(self):
+        with self._lock:
+            return {"enabled": True,
+                    "limit": self._limit,
+                    "max_queue": self.max_queue,
+                    "floor": self.floor,
+                    "overload": self._overload,
+                    "interval_s": self.interval_s,
+                    "rules": list(self.rules),
+                    "tightenings": self.tightenings,
+                    "relaxations": self.relaxations,
+                    "last_decision": self.last_decision}

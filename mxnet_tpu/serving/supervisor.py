@@ -1,0 +1,465 @@
+"""Automatic replica probation — the supervisor half of self-healing
+serving (ISSUE 12, closing ROADMAP follow-up a2).
+
+PR 11 gave retired replicas a road back (``engine.rehabilitate()``:
+fresh programs, AOT-drawn re-warm, one bitwise probe batch against a
+live sibling) but left the verb to an operator.  This module makes
+probation automatic: a single refcounted supervisor thread (the
+recorder/HTTP-server lifecycle discipline) watches every registered
+engine's replica health and, when a replica retires, drives
+``rehabilitate()`` for it on an **exponential-backoff-with-jitter**
+clock — the ps-lite retry discipline applied to replica re-admission:
+
+- first attempt after ``MXNET_SUPERVISOR_BACKOFF_MS``; each FAILED
+  attempt (probe divergence, rebuild error, no healthy sibling)
+  doubles the wait up to ``MXNET_SUPERVISOR_BACKOFF_MAX_MS``, with a
+  deterministic per-(engine, replica, attempt) jitter so a fleet of
+  processes does not synchronize its probation storms;
+- after ``MXNET_SUPERVISOR_ATTEMPTS`` failures the replica is
+  **permanently retired**: the supervisor stops trying, dumps a flight
+  bundle, publishes an SSE event, and the
+  ``serve_supervisor_replica_retired`` alert rule pages on the
+  ``mxnet_serve_supervisor_retired`` gauge — a replica that cannot
+  pass its bitwise probe is an incident, not a retry loop.  An
+  operator ``rehabilitate()`` call can still bring it back (success
+  clears the record);
+- a probe that succeeds clears the replica's record entirely: the next
+  failure starts a fresh backoff ladder.
+
+Observability: ``stats()["supervisor"]`` on every registered engine,
+a ``supervisor`` block on ``GET /healthz`` (server.py healthz
+sections), ``mxnet_serve_supervisor_rehabs_total{engine,outcome}``
+counters and ``mxnet_serve_supervisor_{waiting,retired}`` gauges —
+all reclaimed when the last engine releases the supervisor.
+
+Enabled per-process by ``MXNET_SUPERVISOR=1`` (engines acquire at
+construction, release at close); off by default so rehabilitation
+stays an operator verb and the serving stack is exactly PR 11's.
+Tests drive a standalone ``Supervisor(start=False)`` by hand through
+:meth:`Supervisor.poll_once` with explicit clocks.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import warnings
+import weakref
+
+from .. import telemetry as _telemetry
+
+__all__ = ["Supervisor", "engine_acquire", "engine_release",
+           "engine_state", "get_supervisor"]
+
+_RETIRED_RULE = "serve_supervisor_replica_retired"
+
+
+def _supervisor_metric_families(reg):
+    """(rehabs, waiting, retired) families — engine-labeled so a
+    release reclaims exactly its engine's series."""
+    rehabs = reg.counter(
+        "mxnet_serve_supervisor_rehabs_total",
+        "automatic probation attempts by the replica supervisor, by "
+        "outcome (ok = replica re-admitted through the bitwise probe "
+        "gate; fail = it stays retired and the backoff doubles)",
+        labelnames=("engine", "outcome"))
+    waiting = reg.gauge(
+        "mxnet_serve_supervisor_waiting",
+        "retired replicas the supervisor holds on a probation backoff "
+        "clock, per engine",
+        labelnames=("engine",))
+    retired = reg.gauge(
+        "mxnet_serve_supervisor_retired",
+        "replicas PERMANENTLY retired after exhausting the "
+        "supervisor's bounded rehab attempts, per engine — nonzero "
+        "pages via the serve_supervisor_replica_retired rule",
+        labelnames=("engine",))
+    return rehabs, waiting, retired
+
+
+class _Record(object):
+    """Probation state for one (engine, replica) pair."""
+    __slots__ = ("attempts", "next_due", "state", "since", "last_reason")
+
+    def __init__(self, now, first_due):
+        self.attempts = 0
+        self.next_due = first_due
+        self.state = "waiting"          # waiting | retired
+        self.since = now
+        self.last_reason = None
+
+
+class Supervisor(object):
+    """The probation scheduler.  One instance per process in
+    production (module refcount below); tests build their own with
+    ``start=False`` and call :meth:`poll_once` with explicit ``now``
+    values to walk the backoff ladder deterministically."""
+
+    def __init__(self, interval_s=None, backoff_s=None,
+                 backoff_max_s=None, max_attempts=None, jitter=0.25,
+                 seed=0, start=True):
+        from .. import config
+        if interval_s is None:
+            interval_s = config.get("MXNET_SUPERVISOR_INTERVAL_MS") / 1e3
+        if backoff_s is None:
+            backoff_s = config.get("MXNET_SUPERVISOR_BACKOFF_MS") / 1e3
+        if backoff_max_s is None:
+            backoff_max_s = \
+                config.get("MXNET_SUPERVISOR_BACKOFF_MAX_MS") / 1e3
+        if max_attempts is None:
+            max_attempts = config.get("MXNET_SUPERVISOR_ATTEMPTS")
+        self.interval_s = float(interval_s)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.max_attempts = int(max_attempts)
+        self.jitter = float(jitter)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._engines = {}      # id -> (weakref, name, tm_label)
+        self._records = {}      # (id, replica_index) -> _Record
+        self._counts = {"ok": 0, "fail": 0, "retired": 0}
+        self._stop = threading.Event()
+        self._thread = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._run, name="mxnet-serve-supervisor",
+                daemon=True)
+            self._thread.start()
+
+    # ---------------------------------------------------------- registry
+    def register(self, engine, name=None):
+        tm_label = (engine._tm.engine_label
+                    if getattr(engine, "_tm", None) is not None else None)
+        with self._lock:
+            self._engines[id(engine)] = (weakref.ref(engine),
+                                         name or "engine", tm_label)
+
+    def unregister(self, engine):
+        eid = id(engine)
+        with self._lock:
+            entry = self._engines.pop(eid, None)
+            for key in [k for k in self._records if k[0] == eid]:
+                del self._records[key]
+        if entry is not None and entry[2] is not None \
+                and _telemetry.enabled():
+            # reclaim this engine's supervisor series (reload loops)
+            _telemetry.remove_labeled_series(
+                _supervisor_metric_families(_telemetry.registry()),
+                entry[2])
+
+    # -------------------------------------------------------- scheduling
+    def _backoff(self, name, idx, attempt):
+        """Exponential base with deterministic per-(engine, replica,
+        attempt) jitter: reproducible in tests, desynchronized across
+        a fleet (each process seeds with its own name/pid mix)."""
+        base = min(self.backoff_max_s, self.backoff_s * (2 ** attempt))
+        h = int(hashlib.sha256(
+            ("%s|%s|%d|%d" % (name, idx, attempt, self.seed))
+            .encode("utf-8")).hexdigest()[:8], 16)
+        u = (h / float(0xffffffff)) * 2.0 - 1.0
+        return base * (1.0 + self.jitter * u)
+
+    def poll_once(self, now=None):
+        """One supervision cycle: observe health transitions, attempt
+        every due probation.  Returns the rehab outcomes attempted
+        this cycle (list of per-replica outcome dicts).  An explicit
+        ``now`` is a virtual clock (tests); live mode re-stamps after
+        each rehab attempt so a slow rebuild cannot leave ``next_due``
+        already in the past."""
+        live = now is None
+        now = time.monotonic() if live else now
+        with self._lock:
+            engines = list(self._engines.items())
+        outcomes = []
+        for eid, (ref, name, tm_label) in engines:
+            eng = ref()
+            if eng is None:
+                with self._lock:
+                    self._engines.pop(eid, None)
+                    for key in [k for k in self._records
+                                if k[0] == eid]:
+                        del self._records[key]
+                continue
+            due = []
+            with self._lock:
+                for r in eng._replicas:
+                    key = (eid, r.index)
+                    rec = self._records.get(key)
+                    if r.healthy:
+                        if rec is not None:
+                            # healed — by us last cycle or an operator
+                            # call; either way the ladder resets
+                            del self._records[key]
+                        continue
+                    if rec is None:
+                        rec = _Record(now, now + self._backoff(
+                            name, r.index, 0))
+                        self._records[key] = rec
+                        continue
+                    if rec.state == "retired":
+                        continue
+                    if now >= rec.next_due:
+                        due.append((r.index, rec))
+            for idx, rec in due:
+                outcomes.extend(
+                    self._attempt(eng, eid, name, tm_label, idx, rec,
+                                  now, live))
+        self._refresh_gauges()
+        return outcomes
+
+    def _attempt(self, eng, eid, name, tm_label, idx, rec, now, live):
+        rec.attempts += 1
+        try:
+            outs = eng.rehabilitate(replicas=[idx])
+        except Exception as e:
+            # a closing/closed engine is not a failed probe: drop its
+            # records and let close() unregister it
+            from .admission import EngineClosedError
+            if isinstance(e, EngineClosedError):
+                with self._lock:
+                    for key in [k for k in self._records
+                                if k[0] == eid]:
+                        del self._records[key]
+                return []
+            outs = [{"replica": str(idx), "ok": False,
+                     "reason": repr(e)}]
+        if not outs:
+            # replica turned healthy between the due check and the
+            # call — count it as healed
+            outs = [{"replica": str(idx), "ok": True,
+                     "reason": "healthy before probation ran"}]
+        if live:
+            # a rehab (rebuild + re-warm + probe) can outlast the
+            # backoff interval; the ladder must start from when the
+            # attempt FINISHED, or failures retry back-to-back
+            now = time.monotonic()
+        out = dict(outs[0], engine=name, attempt=rec.attempts,
+                   supervised=True)
+        if out.get("ok"):
+            with self._lock:
+                self._records.pop((eid, idx), None)
+                self._counts["ok"] += 1
+            self._count_rehab(tm_label, "ok")
+        else:
+            rec.last_reason = out.get("reason")
+            with self._lock:
+                self._counts["fail"] += 1
+            self._count_rehab(tm_label, "fail")
+            if rec.attempts >= self.max_attempts:
+                rec.state = "retired"
+                rec.since = now
+                with self._lock:
+                    self._counts["retired"] += 1
+                self._retire(eng, name, idx, rec)
+            else:
+                rec.next_due = now + self._backoff(
+                    name, idx, rec.attempts)
+        return [out]
+
+    def _retire(self, eng, name, idx, rec):
+        """Permanent retirement: the supervisor gives up on this
+        replica — page the operator with the evidence."""
+        warnings.warn(
+            "supervisor: replica %d of %s PERMANENTLY retired after "
+            "%d failed probation attempt(s) (last: %s); an operator "
+            "rehabilitate() can still re-probe it"
+            % (idx, name, rec.attempts, rec.last_reason))
+        try:
+            fr = _telemetry.recorder.flight_recorder()
+            if fr is not None:
+                fr.dump("supervisor_retired:%s:%s" % (name, idx),
+                        detail={"engine": name, "replica": idx,
+                                "attempts": rec.attempts,
+                                "last_reason": rec.last_reason})
+        except Exception:
+            pass
+        try:
+            from ..telemetry.server import publish_event
+            publish_event("supervisor", {
+                "event": "retired", "engine": name, "replica": idx,
+                "attempts": rec.attempts, "reason": rec.last_reason})
+        except Exception:
+            pass
+
+    def _count_rehab(self, tm_label, outcome):
+        if tm_label is None or not _telemetry.enabled():
+            return
+        rehabs, _w, _r = _supervisor_metric_families(
+            _telemetry.registry())
+        rehabs.labels(engine=tm_label, outcome=outcome).inc()
+
+    def _refresh_gauges(self):
+        if not _telemetry.enabled():
+            return
+        _rehabs, waiting, retired = _supervisor_metric_families(
+            _telemetry.registry())
+        with self._lock:
+            per = {}
+            for (eid, _idx), rec in self._records.items():
+                entry = self._engines.get(eid)
+                if entry is None or entry[2] is None:
+                    continue
+                slot = per.setdefault(entry[2], [0, 0])
+                slot[1 if rec.state == "retired" else 0] += 1
+            labels = [e[2] for e in self._engines.values()
+                      if e[2] is not None]
+        for lbl in labels:
+            w, r = per.get(lbl, (0, 0))
+            waiting.labels(engine=lbl).set(w)
+            retired.labels(engine=lbl).set(r)
+
+    # --------------------------------------------------------- lifecycle
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                pass        # supervision must never die of one cycle
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ------------------------------------------------------- observation
+    def state(self, now=None):
+        """JSON-able snapshot: per-engine probation records + lifetime
+        outcome counts — the /healthz supervisor block."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            rows = []
+            for (eid, idx), rec in sorted(self._records.items(),
+                                          key=lambda kv: str(kv[0])):
+                entry = self._engines.get(eid)
+                rows.append({
+                    "engine": entry[1] if entry else "?",
+                    "replica": idx,
+                    "state": rec.state,
+                    "attempts": rec.attempts,
+                    "next_due_in_s": (round(rec.next_due - now, 3)
+                                      if rec.state == "waiting"
+                                      else None),
+                    "last_reason": rec.last_reason,
+                })
+            return {"enabled": True,
+                    "engines": len(self._engines),
+                    "interval_s": self.interval_s,
+                    "backoff_s": self.backoff_s,
+                    "backoff_max_s": self.backoff_max_s,
+                    "max_attempts": self.max_attempts,
+                    "rehabs_ok": self._counts["ok"],
+                    "rehabs_failed": self._counts["fail"],
+                    "retired": self._counts["retired"],
+                    "probations": rows}
+
+    def engine_state(self, engine, now=None):
+        """The per-engine slice (``stats()["supervisor"]``)."""
+        now = time.monotonic() if now is None else now
+        eid = id(engine)
+        with self._lock:
+            if eid not in self._engines:
+                return {"enabled": False}
+            rows = {}
+            for (e, idx), rec in self._records.items():
+                if e != eid:
+                    continue
+                rows[str(idx)] = {
+                    "state": rec.state,
+                    "attempts": rec.attempts,
+                    "next_due_in_s": (round(rec.next_due - now, 3)
+                                      if rec.state == "waiting"
+                                      else None),
+                    "last_reason": rec.last_reason}
+        return {"enabled": True, "max_attempts": self.max_attempts,
+                "backoff_s": self.backoff_s, "probations": rows}
+
+
+# -- process-wide refcounted singleton (server.py discipline) ----------------
+
+_LOCK = threading.Lock()
+_SUP = None
+_REFS = 0
+
+
+def get_supervisor():
+    """The live process supervisor, or None."""
+    with _LOCK:
+        return _SUP
+
+
+def engine_acquire(engine, name=None):
+    """Engine-construction hook (``MXNET_SUPERVISOR=1``): the first
+    engine starts the supervisor thread, registers the paging rule for
+    permanent retirements, and exposes the /healthz section; every
+    engine holds one reference and registers itself for supervision.
+    Returns True when the engine holds a reference (its close() must
+    call :func:`engine_release`)."""
+    global _SUP, _REFS
+    with _LOCK:
+        if _SUP is None:
+            _SUP = Supervisor()
+            try:
+                _telemetry.default_manager().add_rule(
+                    _telemetry.AlertRule(
+                        _RETIRED_RULE, "threshold",
+                        series="mxnet_serve_supervisor_retired",
+                        query="latest", op=">", threshold=0.0,
+                        annotations={
+                            "summary": "a replica exhausted its "
+                                       "automatic probation attempts "
+                                       "and is permanently retired — "
+                                       "capacity is down until an "
+                                       "operator intervenes"}),
+                    owner="supervisor")
+            except Exception:
+                pass
+            try:
+                from ..telemetry.server import register_healthz_section
+                register_healthz_section("supervisor", _SUP.state)
+            except Exception:
+                pass
+        _REFS += 1
+        sup = _SUP
+    sup.register(engine, name=name)
+    return True
+
+
+def engine_release(engine):
+    """Drop one engine reference; the last one out stops the thread
+    and reclaims the rule + healthz section (reload loops leak
+    nothing).  Rule/section cleanup happens UNDER the module lock,
+    atomically with clearing the singleton: a stale release running
+    after a concurrent engine_acquire installed a replacement
+    supervisor must not strip the replacement's paging rule and
+    /healthz section (the recorder's generation-token bug class)."""
+    global _SUP, _REFS
+    with _LOCK:
+        sup = _SUP
+        if sup is None:
+            return
+    sup.unregister(engine)
+    with _LOCK:
+        _REFS = max(0, _REFS - 1)
+        if _REFS or _SUP is not sup:
+            return
+        _SUP = None
+        try:
+            _telemetry.default_manager().remove_rule(_RETIRED_RULE)
+        except Exception:
+            pass
+        try:
+            from ..telemetry.server import unregister_healthz_section
+            unregister_healthz_section("supervisor")
+        except Exception:
+            pass
+    sup.stop()
+
+
+def engine_state(engine):
+    """``stats()["supervisor"]`` for one engine: the live process
+    supervisor's per-engine slice, or ``{"enabled": False}``."""
+    sup = get_supervisor()
+    if sup is None:
+        return {"enabled": False}
+    return sup.engine_state(engine)

@@ -58,9 +58,11 @@ from .sampling import (PeriodicSampler, TailSampler, ErrorSampler,
                        persist_tail_state, restore_tail_state)
 from .server import (TelemetryServer, start_server, stop_server,
                      server_address, publish_event, event_hub)
-from .recorder import (HistoryRecorder, FlightRecorder, start_recorder,
+from .recorder import (HistoryRecorder, FlightRecorder, RingFile,
+                       start_recorder,
                        stop_recorder, get_recorder, register_heartbeat,
-                       unregister_heartbeat, heartbeats, flight_recorder)
+                       unregister_heartbeat, heartbeats, flight_recorder,
+                       ring_file)
 from .alerts import (AlertRule, AlertManager, default_manager,
                      register_engine_default_rules, load_rules_file)
 from .step import (StepTimer, PHASES, STEP_SECONDS_BUCKETS,
@@ -80,15 +82,17 @@ __all__ = [
     "chain_from_config", "persist_tail_state", "restore_tail_state",
     "TelemetryServer", "start_server", "stop_server", "server_address",
     "publish_event", "event_hub",
-    "HistoryRecorder", "FlightRecorder", "start_recorder",
+    "HistoryRecorder", "FlightRecorder", "RingFile", "start_recorder",
     "stop_recorder", "get_recorder", "register_heartbeat",
     "unregister_heartbeat", "heartbeats", "flight_recorder",
+    "ring_file",
     "AlertRule", "AlertManager", "default_manager",
     "register_engine_default_rules", "load_rules_file",
     "StepTimer", "PHASES", "STEP_SECONDS_BUCKETS", "PEAKS_TFLOPS",
     "peak_flops_for",
     "enabled", "set_enabled", "registry", "counter", "gauge",
-    "histogram", "bound", "reset", "dump_state", "trace_sample_every",
+    "histogram", "bound", "remove_labeled_series", "reset",
+    "dump_state", "trace_sample_every",
 ]
 
 _REGISTRY = Registry()
@@ -140,6 +144,17 @@ def gauge(name, doc="", labelnames=()):
 
 def histogram(name, doc="", labelnames=(), buckets=LATENCY_MS_BUCKETS):
     return _REGISTRY.histogram(name, doc, labelnames, buckets)
+
+
+def remove_labeled_series(families, label, position=0):
+    """Reclaim every series whose label tuple carries ``label`` at
+    ``position`` from each family — the per-engine series-reclaim
+    idiom subsystems run at close()/release() so reload loops cannot
+    grow scrapes."""
+    for fam in families:
+        for values, _inst in fam.series():
+            if values and values[position] == label:
+                fam.remove(*values)
 
 
 def bound(cache, key, factory):

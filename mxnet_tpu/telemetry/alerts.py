@@ -324,6 +324,14 @@ class AlertManager(object):
         with self._lock:
             return [st.rule for st in self._states.values()]
 
+    def state_of(self, name):
+        """One rule's current state machine position (``"inactive"`` /
+        ``"pending"`` / ``"firing"``), or None when the rule is not
+        registered — the overload regulator's per-cycle read."""
+        with self._lock:
+            st = self._states.get(name)
+            return st.state if st is not None else None
+
     def __len__(self):
         with self._lock:
             return len(self._states)

@@ -50,12 +50,13 @@ import weakref
 
 from ..base import MXNetError
 
-__all__ = ["HistoryRecorder", "FlightRecorder", "start_recorder",
+__all__ = ["HistoryRecorder", "FlightRecorder", "RingFile",
+           "start_recorder",
            "stop_recorder", "get_recorder", "recorder_acquire",
            "recorder_release", "register_heartbeat",
            "unregister_heartbeat", "heartbeats", "register_engine",
            "unregister_engine", "engine_stats", "flight_recorder",
-           "series_key"]
+           "ring_file", "series_key"]
 
 
 def series_key(name, labels=None):
@@ -124,6 +125,11 @@ class HistoryRecorder(object):
         self._stop = threading.Event()
         self._thread = None
         self.t_start = time.monotonic()
+        # binary ring-file window (ROADMAP 5c residual): every sample
+        # also lands in the preallocated on-disk ring so a SIGKILL/OOM
+        # leaves a readable trailing window.  None when no flight dir
+        # or MXNET_FLIGHT_RING_MB=0.
+        self._ringfile = ring_file()
         if start:
             self._thread = threading.Thread(
                 target=self._run, name="mxnet-telemetry-recorder",
@@ -160,6 +166,15 @@ class HistoryRecorder(object):
                         scalars.setdefault(name, {})[lk] = float(v)
         with self._lock:
             self._ring.append(_Sample(t, wall, scalars, hists))
+        if self._ringfile is not None:
+            # flatten to the export key form; best-effort by contract
+            # (a full disk must not break sampling or alerting)
+            flat = {}
+            for name, by_label in scalars.items():
+                for lk, v in by_label.items():
+                    flat[series_key(name, lk)] = v
+            self._ringfile.append({"t": t, "wall": wall,
+                                   "scalars": flat})
         if evaluate and self.alerts is not None:
             try:
                 self.alerts.evaluate(self, now=t)
@@ -415,6 +430,195 @@ def engine_stats():
         except Exception as e:
             out[name] = {"error": repr(e)}
     return out
+
+
+# -- binary ring-file window (ROADMAP 5c residual) ---------------------------
+#
+# The JSON flight bundle needs a LIVE Python thread to write it; a
+# SIGKILL or the OOM killer leaves nothing.  The ring file closes that
+# gap: a PREALLOCATED fixed-size binary file the history recorder
+# appends one record to per sample.  Each slot is self-describing
+# (sequence number + length + crc32 over a zlib-compressed JSON
+# payload), so no cursor needs committing — a crash mid-write corrupts
+# at most the one slot it was writing, and a reader reconstructs the
+# trailing window by scanning every slot and ordering valid records by
+# sequence.  Render with ``tools/telemetry_dump.py ring``.
+
+class RingFile(object):
+    """Fixed-geometry crash-safe sample ring.
+
+    Layout: 16-byte header (``MXRING1\\n`` magic, u32 slot size, u32
+    slot count), then ``nslots`` slots of ``slot_size`` bytes each.
+    Slot: u64 seq (1-based; 0 = never written), u32 payload length,
+    u32 crc32, zlib-compressed JSON payload.  Record ``seq`` lands in
+    slot ``(seq - 1) % nslots`` — the ring overwrites oldest-first by
+    construction.  An existing file with the SAME geometry is ADOPTED
+    (writing continues after its highest sequence) so process restarts
+    extend the window instead of clobbering the previous incarnation's
+    tail; a geometry change (the operator resized
+    ``MXNET_FLIGHT_RING_MB``) recreates the file at the new size.
+    """
+
+    MAGIC = b"MXRING1\n"
+    HEADER = 16
+    SLOT_HEADER = 16
+
+    def __init__(self, path, slot_size=8192, nslots=512):
+        import struct
+        self.path = path
+        self.slot_size = int(slot_size)
+        self.nslots = int(nslots)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._f = None
+        try:
+            adopted = False
+            if os.path.exists(path):
+                try:
+                    with open(path, "rb") as f:
+                        head = f.read(self.HEADER)
+                    magic = head[:8]
+                    ss, ns = struct.unpack("<II", head[8:16])
+                    if magic == self.MAGIC and ss == self.slot_size \
+                            and ns == self.nslots:
+                        self._seq = max(
+                            (seq for seq, _rec in
+                             self._scan(path, ss, ns)), default=0)
+                        adopted = True
+                except Exception:
+                    adopted = False
+            self._f = open(path, "r+b" if adopted else "w+b")
+            if not adopted:
+                # preallocate the whole file up front: appends can
+                # then never fail on a disk that filled up later
+                self._f.write(self.MAGIC
+                              + struct.pack("<II", self.slot_size,
+                                            self.nslots))
+                self._f.truncate(self.HEADER
+                                 + self.slot_size * self.nslots)
+                self._f.flush()
+        except OSError:
+            self._f = None          # degraded: appends become no-ops
+
+    def append(self, record):
+        """Write one record; returns True on success.  Never raises —
+        the black box must not break the sampler feeding it."""
+        import struct
+        import zlib
+        if self._f is None:
+            return False
+        try:
+            payload = self._encode(record)
+            if payload is None:
+                return False
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+                slot = (seq - 1) % self.nslots
+                buf = struct.pack(
+                    "<QII", seq, len(payload),
+                    zlib.crc32(payload) & 0xffffffff) + payload
+                self._f.seek(self.HEADER + slot * self.slot_size)
+                self._f.write(buf)
+                self._f.flush()
+            return True
+        except Exception:
+            return False
+
+    def _encode(self, record):
+        """Compressed payload bounded to the slot: an oversized sample
+        drops its largest series names (sorted tail) and records how
+        many — truncation is explicit, never silent."""
+        import json as _json
+        import zlib
+        cap = self.slot_size - self.SLOT_HEADER
+        scalars = dict(record.get("scalars") or {})
+        dropped = 0
+        while True:
+            doc = dict(record, scalars=scalars)
+            if dropped:
+                doc["truncated"] = dropped
+            payload = zlib.compress(
+                _json.dumps(doc, sort_keys=True,
+                            separators=(",", ":"),
+                            default=str).encode("utf-8"))
+            if len(payload) <= cap:
+                return payload
+            if not scalars:
+                return None             # slot too small even empty
+            keep = sorted(scalars)[:max(0, len(scalars) // 2)]
+            dropped += len(scalars) - len(keep)
+            scalars = {k: scalars[k] for k in keep}
+
+    @staticmethod
+    def _scan(path, slot_size, nslots):
+        """Yield (seq, record) for every valid slot."""
+        import json as _json
+        import struct
+        import zlib
+        with open(path, "rb") as f:
+            for i in range(nslots):
+                f.seek(RingFile.HEADER + i * slot_size)
+                head = f.read(RingFile.SLOT_HEADER)
+                if len(head) < RingFile.SLOT_HEADER:
+                    continue
+                seq, ln, crc = struct.unpack("<QII", head)
+                if seq == 0 or ln == 0 \
+                        or ln > slot_size - RingFile.SLOT_HEADER:
+                    continue
+                payload = f.read(ln)
+                if len(payload) != ln \
+                        or zlib.crc32(payload) & 0xffffffff != crc:
+                    continue            # torn slot: the crash victim
+                try:
+                    yield seq, _json.loads(
+                        zlib.decompress(payload).decode("utf-8"))
+                except Exception:
+                    continue
+
+    @classmethod
+    def read_records(cls, path):
+        """The trailing window a crashed process left: valid records
+        ordered by sequence, each with its ``seq`` attached."""
+        import struct
+        with open(path, "rb") as f:
+            head = f.read(cls.HEADER)
+        if head[:8] != cls.MAGIC:
+            raise MXNetError("%r is not a telemetry ring file "
+                             "(bad magic)" % path)
+        slot_size, nslots = struct.unpack("<II", head[8:16])
+        recs = sorted(cls._scan(path, slot_size, nslots))
+        return [dict(rec, seq=seq) for seq, rec in recs]
+
+
+_RING_LOCK = threading.Lock()
+_RINGFILE = None
+_RING_PATH = None
+
+
+def ring_file():
+    """The process ring-file writer under
+    ``MXNET_FLIGHT_RECORDER_DIR/ring.bin`` sized by
+    ``MXNET_FLIGHT_RING_MB`` (None when either is off) — rebuilt if
+    the knobs change between calls."""
+    global _RINGFILE, _RING_PATH
+    from .. import config
+    d = config.get("MXNET_FLIGHT_RECORDER_DIR")
+    mb = config.get("MXNET_FLIGHT_RING_MB")
+    with _RING_LOCK:
+        if not d or mb <= 0:
+            _RINGFILE, _RING_PATH = None, None
+            return None
+        path = os.path.join(d, "ring.bin")
+        nslots = max(16, int(mb * (1 << 20)) // 8192)
+        if _RINGFILE is None or _RING_PATH != (path, nslots):
+            try:
+                os.makedirs(d, exist_ok=True)
+            except OSError:
+                return None
+            _RINGFILE = RingFile(path, slot_size=8192, nslots=nslots)
+            _RING_PATH = (path, nslots)
+        return _RINGFILE
 
 
 # -- flight recorder ---------------------------------------------------------

@@ -68,7 +68,34 @@ from urllib.parse import urlparse, parse_qs
 from ..base import MXNetError
 
 __all__ = ["TelemetryServer", "start_server", "stop_server",
-           "server_address", "publish_event", "event_hub"]
+           "server_address", "publish_event", "event_hub",
+           "register_healthz_section", "unregister_healthz_section"]
+
+
+# -- pluggable /healthz sections ---------------------------------------------
+#
+# Subsystems outside the metrics registry (the replica supervisor,
+# future control planes) contribute a named block to every /healthz
+# document by registering a provider callable here — server.py stays
+# ignorant of the serving package (no import cycle, no heavyweight
+# import at scrape time).  A raising provider reports itself instead
+# of failing the probe.
+
+_SECTIONS_LOCK = threading.Lock()
+_HEALTHZ_SECTIONS = {}
+
+
+def register_healthz_section(name, fn):
+    """Register ``fn() -> dict-or-None`` to render as ``name`` in
+    every /healthz document (None = omit this scrape).
+    Re-registration replaces."""
+    with _SECTIONS_LOCK:
+        _HEALTHZ_SECTIONS[name] = fn
+
+
+def unregister_healthz_section(name):
+    with _SECTIONS_LOCK:
+        _HEALTHZ_SECTIONS.pop(name, None)
 
 
 class _EventHub(object):
@@ -494,6 +521,17 @@ def _healthz(server):
         out["alerts"] = {"rules": len(mgr), "firing": mgr.firing(),
                          "evaluating": bool(rec is not None
                                             and rec.alerts is mgr)}
+    # pluggable sections (register_healthz_section): the replica
+    # supervisor's probation table lives here
+    with _SECTIONS_LOCK:
+        sections = list(_HEALTHZ_SECTIONS.items())
+    for name, fn in sections:
+        try:
+            block = fn()
+        except Exception as e:
+            block = {"error": repr(e)}
+        if block is not None:
+            out[name] = block
     return out
 
 

@@ -455,6 +455,96 @@ def run_replica_sweep(requests=512, offered_batch=8, feature=512,
     }
 
 
+def run_fault_availability(plan, requests=256, offered_batch=8,
+                           feature=512, hidden=256, classes=10,
+                           layers=2, batch_timeout_ms=2.0,
+                           retries=2):
+    """Availability under a fault schedule (ISSUE 12 CI satellite): a
+    two-replica engine serves ``requests`` closed-loop requests while
+    ``plan`` (serving/faults.py grammar) injects its schedule — the
+    canonical smoke kills one replica mid-traffic.  Clients retry a
+    failed request up to ``retries`` times (the failover contract:
+    the batch caught by the dying dispatch fails once with a clean
+    error; its retry lands on the surviving replica), and
+
+        availability = requests answered with a result / offered
+
+    is HARD-gated at 1.0 by the caller: with a live sibling, failover
+    plus one client retry must answer everything.  Wall-clock is
+    reported advisory-only per the host-noise protocol (this box
+    swings ~40% minute-to-minute; only correctness gates hard).
+
+    Replicas share one device on purpose — availability is a routing/
+    failover property, not a device-scaling one."""
+    import warnings
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving import faults
+
+    net, params = build_model(feature, hidden, classes, layers=layers)
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((requests, feature)).astype(np.float32)
+    installed = faults.install(plan)
+    eng = None
+    try:
+        eng = serving.ServingEngine(
+            net, params, {}, {"data": (feature,)},
+            ctx=[mx.cpu(0), mx.cpu(0)],
+            max_queue=2 * requests + 16,
+            batch_timeout_ms=batch_timeout_ms)
+        warm = eng.warmup()
+        answered = [0] * requests
+        retry_count = [0]
+        lock = threading.Lock()
+
+        def client(tid):
+            for i in range(tid, requests, offered_batch):
+                for attempt in range(retries + 1):
+                    try:
+                        eng.predict(X[i], timeout=120)
+                        answered[i] = 1
+                        break
+                    except Exception:
+                        with lock:
+                            retry_count[0] += 1
+                        if attempt == retries:
+                            pass        # answered[i] stays 0
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in range(offered_batch)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+        st = eng.stats()
+        return {
+            "plan": plan,
+            "requests": requests,
+            "offered_batch": offered_batch,
+            "availability": sum(answered) / float(requests),
+            "client_retries": retry_count[0],
+            "faults_injected": installed.describe()["injected"],
+            "replicas": [{"replica": r["replica"],
+                          "healthy": r["healthy"],
+                          "failures": r["failures"],
+                          "probations": r["probations"]}
+                         for r in st["replicas"]],
+            "retraces": eng.compile_count - warm,
+            "wall_s_advisory": round(dt, 3),
+            "rps_advisory": round(requests / dt, 1),
+        }
+    finally:
+        # an aborted run must not leak a live chaos plan (or the
+        # engine) into the process — this runs in-process in tier-1
+        faults.clear()
+        if eng is not None:
+            eng.close(drain=False)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=512)
@@ -495,10 +585,43 @@ def main():
                          "count=N), interleaved best-of rounds, "
                          "records the serve section of "
                          "BENCH_replica.json via --record")
+    ap.add_argument("--faults", metavar="PLAN",
+                    help="availability smoke under a fault schedule "
+                         "(serving/faults.py grammar), e.g. "
+                         "'serve.dispatch:raise:on=10,replica=0': a "
+                         "two-replica engine serves the offered load "
+                         "while the plan injects, clients retry clean "
+                         "failures once, and availability (answered/"
+                         "offered) is hard-gated at 1.0 — failover "
+                         "must answer everything; wall-clock is "
+                         "advisory per the host-noise protocol")
     ap.add_argument("--record", metavar="PATH",
                     help="append/write the telemetry-gate result row "
                          "to this JSON file (BENCH_*.json bookkeeping)")
     args = ap.parse_args()
+
+    if args.faults:
+        row = run_fault_availability(
+            args.faults, requests=args.requests,
+            offered_batch=(args.offered or [8])[-1],
+            feature=args.feature, hidden=args.hidden,
+            classes=args.classes, layers=args.layers,
+            batch_timeout_ms=args.window_ms)
+        print(json.dumps(row))
+        if args.record:
+            _merge_record(args.record, "faults", row)
+        if row["availability"] < 1.0:
+            print("FAIL: availability %.4f < 1.0 — %d offered "
+                  "request(s) went unanswered despite failover + "
+                  "client retry"
+                  % (row["availability"],
+                     round((1 - row["availability"]) * row["requests"])))
+            sys.exit(1)
+        print("OK: availability 1.0 under fault plan %r "
+              "(%d client retries, %.1f rps advisory)"
+              % (args.faults, row["client_retries"],
+                 row["rps_advisory"]))
+        return
 
     if args.replicas:
         counts = sorted({1} | {int(t) for t in args.replicas.split(",")

@@ -20,6 +20,8 @@ alias), which scrapes ``/metrics.json`` off a running
       --window 60 --url http://host:9100
   python tools/telemetry_dump.py healthz --url http://host:9100
   python tools/telemetry_dump.py bundle /var/flight/flight_*.json
+  python tools/telemetry_dump.py ring /var/flight \
+      --series mxnet_serve_requests_total --last 20
 
 ``snapshot`` prints one line per series with histogram count/mean/max
 bucket; ``trace`` prints the request's span tree with per-stage start
@@ -34,6 +36,12 @@ report per-rank spread (min/max/argmax) — a straggling worker is one
 command away; snapshots whose wall-clock ``scrape_ts`` stamps disagree
 by more than 60 s draw a skew warning (one rank's document is stale —
 ordering or summing across them would lie).
+
+``ring`` reads the binary ring-file window the history recorder
+appends every sample to (``MXNET_FLIGHT_RECORDER_DIR/ring.bin``,
+``MXNET_FLIGHT_RING_MB``) — the trailing telemetry a SIGKILL/OOM-killed
+process left behind when no Python thread survived to write a flight
+bundle.  Torn slots (the crash victim) are skipped via per-slot crc.
 
 ``alerts`` renders the SLO rule table (``GET /alerts`` live, or the
 ``alerts`` section of a flight bundle): state, dwell, value, and the
@@ -547,6 +555,85 @@ def format_bundle(doc, stacks=True):
     return "\n".join(lines)
 
 
+def read_ring(path):
+    """Standalone reader for the binary ring file
+    (telemetry/recorder.py RingFile, format MXRING1): returns valid
+    records ordered by sequence.  Stdlib-only on purpose — the
+    post-mortem tool must work on a box where the library import
+    itself is what crashed."""
+    import struct
+    import zlib
+    MAGIC, HEADER, SLOT_HEADER = b"MXRING1\n", 16, 16
+    with open(path, "rb") as f:
+        head = f.read(HEADER)
+        if head[:8] != MAGIC:
+            raise ValueError("%r is not a telemetry ring file "
+                             "(bad magic)" % path)
+        slot_size, nslots = struct.unpack("<II", head[8:16])
+        recs = []
+        for i in range(nslots):
+            f.seek(HEADER + i * slot_size)
+            sh = f.read(SLOT_HEADER)
+            if len(sh) < SLOT_HEADER:
+                continue
+            seq, ln, crc = struct.unpack("<QII", sh)
+            if seq == 0 or ln == 0 or ln > slot_size - SLOT_HEADER:
+                continue
+            payload = f.read(ln)
+            if len(payload) != ln \
+                    or zlib.crc32(payload) & 0xffffffff != crc:
+                continue                # torn slot: the crash victim
+            try:
+                recs.append((seq, json.loads(
+                    zlib.decompress(payload).decode("utf-8"))))
+            except (ValueError, zlib.error):
+                continue
+    recs.sort()
+    return [dict(rec, seq=seq) for seq, rec in recs]
+
+
+def format_ring(records, series=None, last=None):
+    """Render the trailing ring window: one line per record (age
+    within the window, sample count), or — with ``--series`` — that
+    series' value per record plus the exact delta over the window."""
+    if not records:
+        return "(no valid records — empty ring, or every slot torn)"
+    if last:
+        records = records[-last:]
+    t0 = records[0]["t"]
+    lines = ["ring window: %d record(s) over %.1fs (seq %d..%d)"
+             % (len(records), records[-1]["t"] - t0,
+                records[0]["seq"], records[-1]["seq"])]
+    import datetime
+    w = records[-1].get("wall")
+    if w:
+        lines[0] += ", last sample %s" % \
+            datetime.datetime.fromtimestamp(w).isoformat()
+    pts = []
+    for r in records:
+        scalars = r.get("scalars") or {}
+        if series:
+            vals = [v for k, v in scalars.items()
+                    if k == series or k.startswith(series + "{")]
+            v = sum(vals) if vals else None
+            if v is not None:
+                pts.append((r["t"], v))
+            lines.append("  seq %-8d t+%8.3fs  %s=%s"
+                         % (r["seq"], r["t"] - t0, series, _num(v)))
+        else:
+            lines.append("  seq %-8d t+%8.3fs  %d series%s"
+                         % (r["seq"], r["t"] - t0, len(scalars),
+                            "  [truncated %d]" % r["truncated"]
+                            if r.get("truncated") else ""))
+    if series and len(pts) >= 2:
+        dt = pts[-1][0] - pts[0][0]
+        delta = pts[-1][1] - pts[0][1]
+        lines.append("delta=%s  rate=%s/s over %.3fs"
+                     % (_num(delta),
+                        _num(delta / dt) if dt > 0 else "null", dt))
+    return "\n".join(lines)
+
+
 def _resolve_source(args, what="snapshot file"):
     src = getattr(args, "url", None) or getattr(args, "file", None)
     if not src:
@@ -619,7 +706,32 @@ def main(argv=None):
     p_bun.add_argument("file", help="flight_*.json bundle path")
     p_bun.add_argument("--no-stacks", action="store_true",
                        help="omit the all-thread stack dump")
+    p_ring = sub.add_parser(
+        "ring", help="read the binary ring-file window a killed "
+                     "process left (MXNET_FLIGHT_RECORDER_DIR/"
+                     "ring.bin)")
+    p_ring.add_argument("path", help="ring.bin path, or the flight-"
+                                     "recorder directory holding one")
+    p_ring.add_argument("--series",
+                        help="print this series' value per record "
+                             "(label sets summed) plus the window "
+                             "delta/rate")
+    p_ring.add_argument("--last", type=int,
+                        help="only the newest N records")
     args = ap.parse_args(argv)
+
+    if args.cmd == "ring":
+        import os as _os
+        path = args.path
+        if _os.path.isdir(path):
+            path = _os.path.join(path, "ring.bin")
+        try:
+            records = read_ring(path)
+        except (OSError, ValueError) as e:
+            print("ring: %s" % e, file=sys.stderr)
+            return 2
+        print(format_ring(records, series=args.series, last=args.last))
+        return 0
 
     if args.cmd == "alerts":
         src = _resolve_source(args, "bundle/snapshot file")
