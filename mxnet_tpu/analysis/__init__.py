@@ -63,7 +63,8 @@ from .padding import PaddingSoundnessPass, classify_padding, PadViolation
 from .flops import FlopsPass, count_flops
 from .rewrite import RepairPlan, plan_repair, repair_serving_graph
 from .optimize import (OptPlan, OptAction, optimize_graph,
-                       register_opt_pass, DEFAULT_OPT_PASSES)
+                       register_opt_pass, DEFAULT_OPT_PASSES,
+                       SELECT_OPT_PASSES)
 
 __all__ = [
     "Severity", "Diagnostic", "Report", "AnalysisError",
@@ -76,7 +77,7 @@ __all__ = [
     "FlopsPass", "count_flops",
     "RepairPlan", "plan_repair", "repair_serving_graph",
     "OptPlan", "OptAction", "optimize_graph", "register_opt_pass",
-    "DEFAULT_OPT_PASSES",
+    "DEFAULT_OPT_PASSES", "SELECT_OPT_PASSES",
     "check_serving_graph", "check_decode_step", "verify",
 ]
 

@@ -126,6 +126,14 @@ _RULES = {
     "log_softmax":    (lambda a, i, o: 5.0 * _prod(o), 1.0),
     "SoftmaxActivation": (lambda a, i, o: 5.0 * _prod(o), 1.0),
     "SoftmaxOutput":  (lambda a, i, o: 5.0 * _prod(o), 1.0),
+    # scatter-at-index KV write (ops/cache.py): O(d) data movement per
+    # slot row, no arithmetic — priced as the row elements written so
+    # the optimizer's blend->scatter selection registers as the FLOP
+    # reduction it is (the one-hot blend it replaces costs
+    # O(slots * max_len * d) in muls and adds)
+    "_cache_write_row": (
+        lambda a, i, o: float(_prod(i[1])) if len(i) > 1 and i[1]
+        else 0.0, 1.0),
 }
 
 _DEFAULT_BWD = 1.0

@@ -50,6 +50,22 @@ Passes (``DEFAULT_OPT_PASSES`` order; ``register_opt_pass`` adds more):
   single-consumer chains of elementwise ops are TAGGED as diagnostics
   for the XLA-facing layer, never rewritten — XLA's own fuser is the
   executor here, the hint is observability.
+- ``select``  — fused-op SELECTION (the fusion-hint pass graduated
+  from diagnostic to rewrite, ISSUE 13): pattern-matches subgraphs
+  that state a dedicated kernel's semantics the long way and swaps in
+  the registry op that says it directly.  Today's one pattern is the
+  one-hot-blend KV-cache row write — ``cache*(1-oh[...,None]) +
+  row[:,None,:]*oh[...,None]`` with ``oh = one_hot(pos, max_len)``,
+  O(max_len*d) per token because XLA's fuser sees broadcasts and
+  elementwise ops, not the scatter they spell (2301.13062's gap) —
+  replaced by ``_cache_write_row(cache, row, pos)`` (ops/cache.py: a
+  Pallas kernel on TPU, dynamic_update_slice elsewhere, O(d)).  Not in
+  ``DEFAULT_OPT_PASSES``: callers opt in via ``SELECT_OPT_PASSES``
+  (DecodeEngine does, behind ``MXNET_OPT_SELECT_KERNELS``), and every
+  selection rides the same verdict gate — re-analysis no worse, the
+  slot axis still row-local under pad-dirty seeding — so a selection
+  the padding rules cannot prove sound is rejected with a reason and
+  the caller serves the unmodified graph.
 
 Entry point::
 
@@ -75,12 +91,19 @@ from .graph import redirect_entries
 from .rewrite import _unique_name
 
 __all__ = ["OptAction", "OptPlan", "OptState", "optimize_graph",
-           "register_opt_pass", "DEFAULT_OPT_PASSES", "OPT_PASSES"]
+           "register_opt_pass", "DEFAULT_OPT_PASSES", "SELECT_OPT_PASSES",
+           "OPT_PASSES"]
 
 #: driver order: identities first (exposes constants), folding next
 #: (creates constants CSE can merge), CSE, then the liveness sweep;
 #: the diagnostic fuse pass runs once after the fixed point converges
 DEFAULT_OPT_PASSES = ("algebraic", "fold", "cse", "dce", "fuse")
+
+#: the kernel-selection pipeline: selection first (the blend subgraph
+#: must be matched before folding/CSE restructure its neighborhood),
+#: then the default pipeline — DCE sweeps the orphaned blend nodes and
+#: attributes them to ``select``
+SELECT_OPT_PASSES = ("select",) + DEFAULT_OPT_PASSES
 
 #: passes that only observe (no rewrites): excluded from the fixed point
 _DIAGNOSTIC_PASSES = frozenset(["fuse"])
@@ -693,6 +716,156 @@ def _fuse_pass(state):
 
 
 # ---------------------------------------------------------------------------
+# fused-op selection (opt-in: SELECT_OPT_PASSES / MXNET_OPT_SELECT_KERNELS)
+# ---------------------------------------------------------------------------
+
+def _entry_key(e):
+    return (id(e[0]), e[1])
+
+
+def _match_kv_write(state, n):
+    """Match the one-hot-blend KV-cache row write rooted at ``n``
+    (an ``_add``)::
+
+        ohe  = expand_dims(one_hot(pos, depth=T, on=1, off=0), axis=2)
+        n    = cache * (1.0 - ohe)  +  expand_dims(row, axis=1) * ohe
+
+    with ``cache (N, T) + tail``, ``row (N,) + tail``, ``pos (N,)``,
+    the SAME ``ohe`` entry on both sides, and ``depth == T``.  Both
+    add operand orders and both mul operand orders are tried (the mul
+    family is commutative).  Returns ``(cache_entry, row_entry,
+    pos_entry)`` or None.
+    """
+    if n.op is None or n.op.name != "_add" or len(n.inputs) != 2:
+        return None
+    for ka in (0, 1):
+        m = _match_kv_sides(state, n.inputs[ka], n.inputs[1 - ka])
+        if m is not None:
+            return m
+    return None
+
+
+def _match_kv_sides(state, keep_e, write_e):
+    keep, write = keep_e[0], write_e[0]
+    if keep_e[1] != 0 or write_e[1] != 0:
+        return None
+    for node in (keep, write):
+        if node.op is None or node.op.name != "_mul" \
+                or len(node.inputs) != 2:
+            return None
+    for wi in (0, 1):
+        ohe_e, rowx_e = write.inputs[wi], write.inputs[1 - wi]
+        ohe = ohe_e[0]
+        if ohe.op is None or ohe.op.name != "expand_dims" \
+                or ohe_e[1] != 0:
+            continue
+        oattrs = _norm(ohe)
+        oh_e = ohe.inputs[0]
+        oh = oh_e[0]
+        if oattrs is None or oh.op is None or oh.op.name != "one_hot" \
+                or oh_e[1] != 0:
+            continue
+        oh_shape = state.shapes.get(_entry_key(oh_e))
+        if oh_shape is None or len(oh_shape) != 2:
+            continue
+        ax = int(oattrs.get("axis", 0))
+        if (ax + 3 if ax < 0 else ax) != 2:
+            continue
+        hattrs = _norm(oh)
+        if hattrs is None \
+                or float(hattrs.get("on_value", 1.0)) != 1.0 \
+                or float(hattrs.get("off_value", 0.0)) != 0.0:
+            continue
+        depth = int(hattrs["depth"])
+        pos_e = oh.inputs[0]
+        rowx = rowx_e[0]
+        if rowx.op is None or rowx.op.name != "expand_dims" \
+                or rowx_e[1] != 0:
+            continue
+        rattrs = _norm(rowx)
+        row_e = rowx.inputs[0]
+        row_shape = state.shapes.get(_entry_key(row_e))
+        if rattrs is None or row_shape is None:
+            continue
+        rax = int(rattrs.get("axis", 0))
+        if rax < 0:
+            rax += len(row_shape) + 1
+        if rax != 1:
+            continue
+        for ki in (0, 1):
+            inv_e, cache_e = keep.inputs[ki], keep.inputs[1 - ki]
+            inv = inv_e[0]
+            if inv.op is None or inv.op.name != "_rminus_scalar" \
+                    or inv_e[1] != 0:
+                continue
+            iattrs = _norm(inv)
+            if iattrs is None \
+                    or float(iattrs.get("scalar", 0.0)) != 1.0:
+                continue
+            if _entry_key(inv.inputs[0]) != _entry_key(ohe_e):
+                continue        # both sides must blend the SAME mask
+            cshape = state.shapes.get(_entry_key(cache_e))
+            pshape = state.shapes.get(_entry_key(pos_e))
+            if cshape is None or pshape is None or len(cshape) < 2:
+                continue
+            if cshape[1] != depth or oh_shape != (cshape[0], depth) \
+                    or pshape != (cshape[0],) \
+                    or row_shape != (cshape[0],) + tuple(cshape[2:]):
+                continue
+            return cache_e, row_e, pos_e
+    return None
+
+
+@register_opt_pass("select")
+def _select_pass(state):
+    """Swap matched one-hot-blend KV writes for ``_cache_write_row``.
+
+    The replacement must hand consumers exactly the blend's output
+    signature (the scatter's output is the cache's shape and dtype, so
+    a blend whose arithmetic PROMOTED the dtype — e.g. an f16 cache
+    blended through an f32 mask — fails the guard and stands down).
+    Semantic boundary, stated for the record: the blend treats an
+    out-of-range ``pos`` as a no-op (the one-hot row is all zero)
+    while the scatter clamps it into range, and a non-finite value in
+    the overwritten cell propagates through the blend's ``c*0`` but
+    not through the scatter — both are outside the decode engine's
+    cache discipline (positions bounded by ``max_len``, joining slots
+    zeroed), which is why selection is opt-in and verdict-gated rather
+    than a default rewrite.
+    """
+    repl = {}
+    applied = 0
+    for n in _topo(state.symbol._outputs):
+        if n.op is None or (id(n), 0) in repl:
+            continue
+        m = _match_kv_write(state, n)
+        if m is None:
+            continue
+        cache_e, row_e, pos_e = m
+        out_s, out_d = state.sig((n, 0))
+        c_s, c_d = state.sig(tuple(cache_e))
+        if out_s is None or out_d is None \
+                or out_s != c_s or out_d != c_d:
+            continue        # promotion/broadcast changed the signature
+        opdef = get_op("_cache_write_row")
+        node = SymNode(opdef,
+                       _unique_name(state.taken, n.name + "_scatter"),
+                       opdef.normalize({}),
+                       [tuple(cache_e), tuple(row_e), tuple(pos_e)])
+        state.track(node, shape=out_s, dtype=out_d)
+        repl[(id(n), 0)] = (node, 0)
+        state.attr.setdefault(id(n), "select")
+        state.record(
+            "select", "select", n,
+            "one-hot-blend KV write -> _cache_write_row(%s, %s, %s): "
+            "O(d) scatter-at-index replaces the O(max_len*d) blend"
+            % (cache_e[0].name, row_e[0].name, pos_e[0].name))
+        applied += 1
+    _apply(state, repl)
+    return applied
+
+
+# ---------------------------------------------------------------------------
 # plan + driver
 # ---------------------------------------------------------------------------
 
@@ -825,7 +998,8 @@ class OptPlan(object):
 def optimize_graph(symbol, data_shapes=None, dtypes=None, policy=None,
                    pad_axes=None, training=False, valid_lengths=None,
                    passes=None, max_iter=8,
-                   fold_limit=DEFAULT_FOLD_LIMIT, precomputed=None):
+                   fold_limit=DEFAULT_FOLD_LIMIT, precomputed=None,
+                   pad_dirty=None):
     """Run the optimizing pass pipeline over ``symbol``; returns an
     :class:`OptPlan`.
 
@@ -839,7 +1013,12 @@ def optimize_graph(symbol, data_shapes=None, dtypes=None, policy=None,
     axis verdict gets worse".  ``precomputed`` may carry a
     ``(report, ctx)`` pair from an ``analyze`` run over the SAME
     symbol/shapes/spec so the pre-optimization analysis is not
-    repeated.  Never raises for an unoptimizable graph: the plan
+    repeated.  ``pad_dirty`` forwards to the padding classifier on
+    BOTH sides of the acceptance re-analysis (decode slot-state
+    inputs: stale garbage gets no zero-absorption credit — the
+    ``check_decode_step`` seeding, so a kernel selection over a decode
+    step is gated on the same row-locality bar the engine's preflight
+    enforces).  Never raises for an unoptimizable graph: the plan
     carries ``accepted=False`` and the reason.
     """
     names = tuple(passes if passes is not None else DEFAULT_OPT_PASSES)
@@ -870,6 +1049,7 @@ def optimize_graph(symbol, data_shapes=None, dtypes=None, policy=None,
                                 dtypes=dtypes, policy=policy,
                                 pad_axes=pad_axes, training=training,
                                 valid_lengths=valid_lengths,
+                                pad_dirty=pad_dirty,
                                 passes=tuple(analysis_passes))
     plan.report_before = report0
     plan.verdicts_before = dict(ctx0.pad_verdicts)
@@ -949,6 +1129,7 @@ def optimize_graph(symbol, data_shapes=None, dtypes=None, policy=None,
                             dtypes=dtypes, policy=policy,
                             pad_axes=pad_axes, training=training,
                             valid_lengths=valid_lengths,
+                            pad_dirty=pad_dirty,
                             passes=tuple(analysis_passes))
     plan.report_after = report1
     plan.verdicts_after = dict(ctx1.pad_verdicts)

@@ -880,6 +880,30 @@ class PaddingSoundnessPass(AnalysisPass):
         idx = h.ins[0]
         return [_Pad(idx.axes, False, idx.diffuse)]
 
+    def _op_cache_write(self, h):
+        """``_cache_write_row(cache, row, pos)``: output row i is
+        cache row i with element ``pos[i]`` overwritten by ``row[i]``
+        — each output row reads ONLY its own row of every operand, so
+        the op is row-local along the slot axis (axis 0) by
+        construction, with no zero-pad credit (the written position
+        makes pad rows nonzero, and a stale cache row passes through
+        untouched)."""
+        cache = h.ins[0]
+        row = h.ins[1] if len(h.ins) > 1 else _EMPTY
+        pos = h.ins[2] if len(h.ins) > 2 else _EMPTY
+        if (row.axes - {0}) or (pos.axes - {0}):
+            # padding carried on a non-slot axis of the row/pos operand
+            # lands at shifted output coordinates — nothing downstream
+            # tracks that mapping, so stand down conservatively
+            h.emit("_cache_write_row: row/pos operand carries padding "
+                   "on a non-slot axis — position tracking lost")
+            return [_Pad(diffuse=True, zero=False)]
+        axes = set(cache.axes)
+        if 0 in row.axes or 0 in pos.axes:
+            axes.add(0)
+        return [_Pad(axes, False,
+                     cache.diffuse or row.diffuse or pos.diffuse)]
+
     def _op_sequence_mask(self, h):
         data = h.ins[0]
         if not h.attrs.get("use_sequence_length"):
@@ -1038,6 +1062,7 @@ _HANDLERS = {
     "take": "gather", "batch_take": "gather", "gather_nd": "gather",
     "pick": "gather",
     "one_hot": "one_hot",
+    "_cache_write_row": "cache_write",
     "SequenceMask": "sequence_mask",
     "RNN": "rnn",
     "broadcast_to": "broadcast", "broadcast_axis": "broadcast",

@@ -151,6 +151,37 @@ VARIABLES = {v.name: v for v in [
          "this many positions per slot up front; prompt length + "
          "generated tokens may not exceed it (requests finish with "
          "reason 'length' at the cap)."),
+    _Var("MXNET_DECODE_COALESCE_PREFILL", bool, True,
+         "Coalesce concurrent decode joiners through the bucketed "
+         "prefill path (serving/decode.py): requests joining in the "
+         "same scheduler iteration whose prompts pad to the same pow2 "
+         "seq bucket prefill in ONE dispatch (batch padded onto pow2 "
+         "batch buckets, output state rows scattered into each "
+         "request's slot) instead of one batch-1 dispatch each — the "
+         "TTFT lever at concurrency (perf/decode_bench.py --prefill).  "
+         "0 = the serial per-joiner prefill, byte-for-byte the "
+         "pre-coalescing engine."),
+    _Var("MXNET_CACHE_SCATTER_IMPL", str, "auto",
+         "Implementation of the _cache_write_row scatter-at-index op "
+         "(ops/cache.py): 'auto' = Pallas kernel on TPU, vmapped "
+         "jax.lax.dynamic_update_slice elsewhere; 'pallas' forces the "
+         "kernel; 'interpret' runs the Pallas kernel in interpreter "
+         "mode on any backend (CI's bitwise pin of the kernel on CPU "
+         "hosts); 'xla' forces the dynamic_update_slice fallback "
+         "everywhere."),
+    _Var("MXNET_OPT_SELECT_KERNELS", bool, True,
+         "Fused-op selection stage of the graph optimizer "
+         "(analysis/optimize.py 'select' pass): pattern-matches "
+         "subgraphs that state a fused kernel's semantics the long way "
+         "— today the one-hot-blend KV-cache row write, O(max_len*d) "
+         "per token — and swaps in the dedicated registry op "
+         "(_cache_write_row, O(d)) behind the same verdict gate as "
+         "every other rewrite (re-analysis no worse, slot-axis "
+         "row-locality preserved under pad-dirty seeding; a rejected "
+         "plan serves the unmodified graph).  DecodeEngine applies it "
+         "to the step graph it compiles; requires MXNET_SERVE_OPTIMIZE "
+         "and MXNET_ANALYSIS_ON.  0 = diagnostic fusion hints only, "
+         "no kernel swaps."),
     _Var("MXNET_ANALYSIS_ON", bool, True,
          "Run the static-analysis passes (mxnet_tpu.analysis) at "
          "Predictor/ServingEngine construction: the IR verifier always, "

@@ -22,3 +22,4 @@ from . import contrib_rcnn  # noqa: F401
 from . import contrib_deform  # noqa: F401
 from . import sparse_ops    # noqa: F401
 from . import fused_unit    # noqa: F401
+from . import cache         # noqa: F401

@@ -1,0 +1,163 @@
+"""Scatter-at-index KV-cache write — the O(1)-per-token step op.
+
+The continuous-batching decode step (serving/decode.py) keeps per-slot
+KV caches in the fixed ``(slots, max_len, d)`` layout of PAPERS.md
+arxiv 2603.09555 and, until this op existed, wrote one row per step
+with a one-hot blend::
+
+    oh    = one_hot(pos, depth=max_len)            # (N, T)
+    cache = cache * (1 - oh[..., None]) + row[:, None, :] * oh[..., None]
+
+because a blend is the only formulation XLA reliably fuses into the
+step program (arxiv 2301.13062 frames exactly this gap: the pattern is
+semantically a scatter, but the fusion layer sees three broadcasts and
+two elementwise ops and happily materializes O(max_len * d) work per
+generated token).  ``_cache_write_row`` states the scatter directly:
+
+    out[i, pos[i], :] = row[i, :]        (every other element unchanged)
+
+- **TPU**: a Pallas kernel (one grid step per slot, the write position
+  scalar-prefetched, the cache aliased input->output) touches exactly
+  the d elements being written — O(d) per slot per token, never
+  O(max_len * d);
+- **CPU / fallback**: a vmapped ``jax.lax.dynamic_update_slice`` —
+  XLA lowers it to an in-place row update when the buffer is donated,
+  so tier-1 (CPU) exercises the same graph shape and the same O(1)
+  cache discipline;
+- ``MXNET_CACHE_SCATTER_IMPL=interpret`` runs the Pallas kernel in
+  interpreter mode on any backend — how CPU CI pins the kernel
+  bitwise against the XLA fallback without TPU hardware.
+
+Bitwise contract (tests/test_decode_fastpath.py): for finite cache
+values the scatter is bitwise-identical to the one-hot blend it
+replaces — at the written position the blend computes ``c*0 + r*1 ==
+r``, elsewhere ``c*1 + r*0 == c`` (the decode engine zeroes joining
+slots, so the overwritten cell is never non-finite).  The optimizer's
+fused-op selection stage (analysis/optimize.py "select" pass) swaps
+the blend subgraph for this op behind the same verdict gate as every
+other rewrite.
+
+Gradient: the fallback path is plain jax (``dynamic_update_slice``),
+so ``jax.vjp`` through the op is exact — cotangents route to ``cache``
+with the written row zeroed and to ``row`` via the gathered slice.
+The Pallas path is inference-only (decode serving; ``pallas_call``
+defines no autodiff rule): the op registers ``mode_dependent``, and
+training-mode traces take the fallback on every backend — the two
+impls are bitwise-identical, so train-vs-serve parity is unaffected.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register, P
+
+
+def _impl_mode():
+    """Which implementation this dispatch should trace.
+
+    ``MXNET_CACHE_SCATTER_IMPL``: ``auto`` (Pallas on TPU, XLA
+    ``dynamic_update_slice`` elsewhere), ``pallas`` (force the kernel),
+    ``interpret`` (Pallas interpreter — CPU-runnable, CI's bitwise pin
+    of the kernel), ``xla`` (force the fallback everywhere).
+    """
+    from .. import config
+    mode = str(config.get("MXNET_CACHE_SCATTER_IMPL") or "auto").lower()
+    if mode == "auto":
+        import jax
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return mode
+
+
+def _scatter_xla(cache, row, idx):
+    """Fallback: one ``dynamic_update_slice`` per slot row, vmapped
+    over the slot axis.  The index is a traced scalar per slot, so the
+    compiled program is shape-stable across every write position."""
+    import jax
+
+    def write_one(c, r, p):
+        # dynamic_update_slice clamps the start index into range, the
+        # same containment the engine's pos bookkeeping guarantees
+        return jax.lax.dynamic_update_slice_in_dim(c, r[None], p, axis=0)
+    return jax.vmap(write_one)(cache, row, idx)
+
+
+def _scatter_pallas(cache, row, idx, interpret):
+    """The Pallas TPU kernel: grid over slots, the per-slot write
+    position scalar-prefetched (available before the kernel body, per
+    the TPU guide), the cache kept UNBLOCKED in HBM (``pltpu.ANY``)
+    and aliased input->output.  Each grid step issues one async DMA of
+    exactly the d-wide row into ``out[i, pos[i]]`` — O(d) data
+    movement per slot per token, and the aliased buffer's other
+    ``max_len - 1`` rows are never read, copied, or written (a BLOCKED
+    VMEM output window would be copied back whole per grid step, which
+    both destroys the O(d) story and — since the kernel writes only
+    one row of the window — would ship uninitialized VMEM over the
+    aliased cache)."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = cache.shape[0]
+    # row reshaped to (N, 1) + tail so the DMA source slice matches the
+    # (1, 1) + tail destination slice rank-for-rank
+    row3 = row.reshape((n, 1) + row.shape[1:])
+
+    def kernel(pos_ref, cache_ref, row_ref, out_ref, sem):
+        # cache_ref is the aliased input view of out_ref; it is never
+        # touched — the single DMA below IS the whole write
+        i = pl.program_id(0)
+        p = pos_ref[i]
+        copy = pltpu.make_async_copy(
+            row_ref.at[pl.ds(i, 1)],
+            out_ref.at[pl.ds(i, 1), pl.ds(p, 1)],
+            sem)
+        copy.start()
+        copy.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(cache.shape, cache.dtype),
+        # operand order with scalar prefetch: (idx, cache, row3) — the
+        # cache (operand 1) aliases the output for the in-place update
+        input_output_aliases={1: 0},
+        interpret=bool(interpret),
+    )(idx, cache, row3)
+
+
+@register("_cache_write_row", nin=3,
+          input_names=["cache", "row", "pos"],
+          mode_dependent=True,
+          params={"clip": P(bool, True)})
+def _cache_write_row(attrs, cache, row, pos):
+    """out[i, pos[i], ...] = row[i, ...]; all other elements of
+    ``cache`` pass through untouched.  ``cache`` is ``(slots, max_len)
+    + tail``, ``row`` is ``(slots,) + tail``, ``pos`` a ``(slots,)``
+    vector of write positions (any real dtype; cast to int32)."""
+    import jax.numpy as jnp
+    idx = pos.astype(jnp.int32)
+    if attrs.get("clip", True):
+        # both backends clamp (dynamic_update_slice by contract, the
+        # kernel via this explicit clip) so the op has ONE out-of-range
+        # story instead of a per-backend one
+        idx = jnp.clip(idx, 0, cache.shape[1] - 1)
+    row = jnp.asarray(row, cache.dtype)
+    mode = _impl_mode()
+    if mode in ("pallas", "interpret") and attrs.get("_training"):
+        # pallas_call defines no autodiff rule: training graphs trace
+        # the differentiable fallback on EVERY backend (mode_dependent
+        # threads the flag in; the two impls are bitwise-identical, so
+        # train-vs-serve parity is unaffected)
+        mode = "xla"
+    if mode in ("pallas", "interpret"):
+        return _scatter_pallas(cache, row, idx,
+                               interpret=(mode == "interpret"))
+    return _scatter_xla(cache, row, idx)

@@ -249,6 +249,14 @@ class AOTCache(object):
                     "misses": self.misses, "writes": self.writes,
                     "rejects": self.rejects, "prunes": self.prunes,
                     "max_bytes": self.max_bytes or None,
+                    # key-anatomy visibility: the fused-op selection
+                    # the engine's optimizer adopted (decode engines;
+                    # None elsewhere).  It rides the validity
+                    # FINGERPRINT via the artifact, so toggling
+                    # selection between restarts REJECTS every entry
+                    # the previous regime wrote instead of serving a
+                    # stale program
+                    "selection": (self.artifact or {}).get("selection"),
                     "last_reject": dict(self.last_reject)
                     if self.last_reject else None}
 

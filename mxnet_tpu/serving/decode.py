@@ -36,7 +36,30 @@ workload):
   through the running step batch (teacher forcing — no extra
   programs), or, with a ``prefill_sym``, in ONE dispatch through the
   existing :class:`~mxnet_tpu.serving.buckets.ProgramCache` at pow2
-  seq buckets, its output state scattered into the free slot;
+  seq buckets, its output state scattered into the free slot —
+  and concurrent joiners COALESCE (``MXNET_DECODE_COALESCE_PREFILL``,
+  default on): requests joining in the same scheduler iteration whose
+  prompts pad to the same seq bucket share one dispatch at the next
+  pow2 batch extent instead of prefilling at batch 1 each, the direct
+  TTFT lever at concurrency (``perf/decode_bench.py --prefill``);
+- **fused-op selection**: before any program compiles, the optimizer's
+  kernel-selection pipeline (``analysis.SELECT_OPT_PASSES``, behind
+  ``MXNET_SERVE_OPTIMIZE`` + ``MXNET_OPT_SELECT_KERNELS``) rewrites
+  the step graph under the same slot-axis/pad-dirty spec the preflight
+  lint uses — today swapping the one-hot-blend KV-cache row write
+  (O(max_len*d) per token; all XLA's fuser reliably handles, per
+  arxiv 2301.13062) for the O(d) ``_cache_write_row`` scatter
+  (ops/cache.py: Pallas kernel on TPU, ``dynamic_update_slice``
+  elsewhere).  Adoption is verdict-gated exactly like every optimizer
+  rewrite: re-analysis no worse, slot row-locality preserved under
+  pad-dirty seeding, rejected plans serve the unmodified step.  The
+  adopted selection rides the AOT cache's validity fingerprint, so
+  toggling it between restarts REJECTS stale entries;
+- **per-token streaming**: ``submit(..., on_token=cb)`` fires the
+  callback with each generated token id in order (the exact
+  ``greedy_decode`` prefix) from the slot loop; a raising callback
+  evicts only its own request (SSE per-request streams remain a
+  follow-up — this is their engine seam);
 - **admission + per-step deadlines**: the same
   :class:`~mxnet_tpu.serving.admission.AdmissionController` front door
   (bounded queue, reject/shed overload policies); deadlines are
@@ -199,14 +222,22 @@ class DecodeRequest(Request):
     """One decode request: a prompt plus generation bookkeeping the
     scheduler mutates as the request moves queue -> slot -> done."""
     __slots__ = ("prompt", "max_new", "tokens", "prompt_i", "slot",
-                 "t_join", "n_steps", "t_first_tok", "t_last_tok")
+                 "t_join", "n_steps", "t_first_tok", "t_last_tok",
+                 "on_token")
 
     def __init__(self, prompt, max_new, future, deadline=None,
-                 trace=None):
+                 trace=None, on_token=None):
         super().__init__({}, ("__decode__",), future, deadline=deadline,
                          trace=trace)
         self.prompt = list(prompt)
         self.max_new = int(max_new)
+        # per-token streaming hook (ROADMAP 4a): called from the slot
+        # loop with each generated token id, in generation order — the
+        # exact greedy_decode prefix.  A raising callback evicts ONLY
+        # its own request (the future fails with the exception; co-
+        # residents keep generating).  SSE per-request streaming stays
+        # a follow-up; this is its engine-side seam.
+        self.on_token = on_token
         self.tokens = []            # generated ids (host mirror)
         self.prompt_i = 0           # next prompt token to teacher-force
         self.slot = None
@@ -822,8 +853,28 @@ class DecodeEngine(object):
         if config.get("MXNET_ANALYSIS_ON"):
             self._preflight(step_sym, state_info, token_name, pos_name,
                             valid_name, config.get("MXNET_ANALYSIS_STRICT"))
+        # fused-op selection (ISSUE 13): run the optimizer's kernel-
+        # selection pipeline over the step graph BEFORE any program is
+        # built, so StepProgram serves the optimized graph — the
+        # one-hot-blend KV write becomes the O(d) _cache_write_row
+        # scatter (ops/cache.py) when the verdict-gated plan accepts.
+        # A rejected/crashed plan serves the step exactly as handed in.
+        self.opt_plan = None
+        self.selection = None
+        if config.get("MXNET_SERVE_OPTIMIZE") \
+                and config.get("MXNET_ANALYSIS_ON") \
+                and config.get("MXNET_OPT_SELECT_KERNELS"):
+            step_sym = self._optimize_step(step_sym, state_info,
+                                           token_name, pos_name,
+                                           valid_name)
         self._prefill_data_name = prefill_data_name
         self._prefill_len_name = prefill_len_name
+        # coalesced bucketed prefill (ROADMAP 4b): joiners landing in
+        # the same scheduler iteration share ONE prefill dispatch per
+        # pow2 (batch, prompt) bucket instead of batch-1 each — the
+        # direct TTFT lever at concurrency (decode_bench --prefill)
+        self._coalesce = bool(config.get("MXNET_DECODE_COALESCE_PREFILL"))
+        self._prefill_dispatches = 0
         # device replicas (serving/replica.py, ROADMAP 2a): each owns a
         # FULL slot pool — persistent step program + device-resident
         # state + prefill bucket caches, params uploaded once per
@@ -847,6 +898,16 @@ class DecodeEngine(object):
                 buckets.append(b)
                 b <<= 1
             prefill_buckets = tuple(buckets)
+        # coalesced prefill dispatches at pow2 BATCH buckets too (a
+        # group of joiners pads up to the next one); serial mode only
+        # ever dispatches batch 1 — warmup warms exactly this grid, so
+        # the zero-warm-retrace contract covers every coalesced shape
+        batches, bb = [], 1
+        top_b = _next_pow2(self.num_slots)
+        while bb <= top_b:
+            batches.append(bb)
+            bb <<= 1
+        self._prefill_batches = tuple(batches) if self._coalesce else (1,)
         # persistent AOT program cache (serving/aot_cache.py,
         # MXNET_AOT_CACHE_DIR): one per engine, shared by every
         # replica's step program, prefill buckets, and row-scatter
@@ -858,9 +919,30 @@ class DecodeEngine(object):
         from .aot_cache import AOTCache
         sampler_fp = {k: v for k, v in self._sampler.describe().items()
                       if k != "seed"}
+        # the fused-op selection outcome rides the validity FINGERPRINT
+        # (not the key): flipping MXNET_OPT_SELECT_KERNELS between
+        # restarts moves the fingerprint, so every entry the previous
+        # selection regime wrote is REJECTED on load (alertable "cold
+        # start that should have been warm") rather than any program
+        # compiled under different analysis conclusions being served —
+        # the step graph's own key also moves (its canonical form
+        # changed), but graph-invariant entries (prefill buckets,
+        # universal row-scatter kernels) are only protected by the
+        # fingerprint (tests/test_decode_fastpath.py pins the reject)
         self._aot = AOTCache.from_config(
             artifact={"kind": "decode",
-                      "step_verdict": self.step_verdict},
+                      "step_verdict": self.step_verdict,
+                      "selection": self.selection,
+                      "optimizer": {
+                          "accepted": (bool(self.opt_plan.accepted)
+                                       if self.opt_plan is not None
+                                       else None),
+                          "nodes_before": (self.opt_plan.nodes_before
+                                           if self.opt_plan is not None
+                                           else None),
+                          "nodes_after": (self.opt_plan.nodes_after
+                                          if self.opt_plan is not None
+                                          else None)}},
             key_extra={"engine_kind": "decode", "sampler": sampler_fp})
         # everything _new_replica needs, kept for probation re-warm
         # (rehabilitate): the param handles are the same NDArrays the
@@ -1073,6 +1155,59 @@ class DecodeEngine(object):
                           "MXNET_ANALYSIS_STRICT=0; decoded output "
                           "WILL differ from single-request decode")
 
+    def _optimize_step(self, step_sym, state_info, token_name, pos_name,
+                       valid_name):
+        """Run the kernel-selection optimizer pipeline
+        (``analysis.SELECT_OPT_PASSES``) over the step graph under the
+        SAME spec the preflight lint uses — slot-pool shapes, slot
+        padded axis, state inputs seeded pad-DIRTY — so a selection is
+        adopted only via an accepted verdict-gated OptPlan: re-analysis
+        no worse, slot-axis row-locality preserved.  Returns the graph
+        StepProgram should compile (the input graph verbatim on
+        rejection or crash)."""
+        from ..analysis import optimize_graph, SELECT_OPT_PASSES
+        try:
+            n = self.num_slots
+            arg_names = set(step_sym.list_arguments())
+            shapes = {token_name: (n,)}
+            dtypes = {token_name: np.dtype(np.float32)}
+            state_names = []
+            for info in state_info:
+                shapes[info["name"]] = (n,) + tuple(info["shape"])
+                dtypes[info["name"]] = np.dtype(info.get("dtype")
+                                                or self._dtype)
+                state_names.append(info["name"])
+            for extra in (pos_name, valid_name):
+                if extra in arg_names:
+                    shapes[extra] = (n,)
+                    dtypes[extra] = np.dtype(np.float32)
+            plan = optimize_graph(
+                step_sym, data_shapes=shapes, dtypes=dtypes,
+                pad_axes={"slot": {name: 0 for name in shapes}},
+                valid_lengths=({"slot": valid_name}
+                               if valid_name in arg_names else None),
+                pad_dirty=tuple(state_names),
+                passes=SELECT_OPT_PASSES)
+        except Exception as e:    # optimizer crash must never block
+            warnings.warn("DecodeEngine: step-graph optimization "
+                          "crashed (%r); serving the unmodified step"
+                          % (e,))
+            return step_sym
+        self.opt_plan = plan
+        if plan.accepted and plan.symbol is not None and plan.rewrites:
+            # the fingerprint-visible selection summary: which fused
+            # kernels the accepted plan swapped in, and where
+            self.selection = [{"op": "_cache_write_row",
+                               "site": a.node}
+                              for a in plan.actions
+                              if a.kind == "select"]
+            return plan.symbol
+        if not plan.accepted:
+            warnings.warn("DecodeEngine: step-graph optimization "
+                          "rejected (%s); serving the unmodified step"
+                          % plan.reason)
+        return step_sym
+
     # ---------------------------------------------------------- lifecycle
     def start(self):
         if self._adm.closed:
@@ -1160,14 +1295,23 @@ class DecodeEngine(object):
         return False
 
     # ------------------------------------------------------------- client
-    def submit(self, prompt, max_new_tokens=None, deadline_ms=None):
+    def submit(self, prompt, max_new_tokens=None, deadline_ms=None,
+               on_token=None):
         """Enqueue one generation request; returns a Future resolving
         to a :class:`DecodeResult`.
 
         ``prompt`` is a non-empty sequence of token ids; generation
         continues until ``eos_id`` is sampled, ``max_new_tokens`` are
         out, the slot's ``max_len`` positions fill, or the deadline
-        passes (partial result, ``expired=True``)."""
+        passes (partial result, ``expired=True``).
+
+        ``on_token`` optionally streams the generation: it is called
+        with each generated token id (int) in order — the exact prefix
+        the final ``DecodeResult.tokens`` will hold — from the engine's
+        scheduler thread, so it must be cheap and thread-safe.  A
+        raising callback evicts only its own request: the future fails
+        with the callback's exception and co-resident requests keep
+        generating."""
         if self._adm.closed:
             raise EngineClosedError("decode engine is closed")
         prompt = [int(t) for t in prompt]
@@ -1197,7 +1341,8 @@ class DecodeEngine(object):
                 trace = _telemetry.LazyTrace(self._trace_chain,
                                              name="decode.request")
         req = DecodeRequest(prompt, max_new_tokens, fut,
-                            deadline=deadline, trace=trace)
+                            deadline=deadline, trace=trace,
+                            on_token=on_token)
         # padded-element cost for the regulator's cost-aware shed: a
         # decode request prices as its bucketed prompt plus the
         # positions its generation budget can occupy
@@ -1285,15 +1430,15 @@ class DecodeEngine(object):
                     batch = self._adm.take(free, 0.0)
                     if batch is None:
                         return          # closed and drained
-                    for r in batch:
-                        self._join(rep, r)
+                    self._join_many(rep, batch)
                     continue
                 # busy: admit opportunistically (never block a step),
                 # and keep queued deadlines honest even when no slot
                 # is free — expiry must not wait for a drain
                 if free:
-                    for r in self._adm.poll(free):
-                        self._join(rep, r)
+                    polled = self._adm.poll(free)
+                    if polled:
+                        self._join_many(rep, polled)
                 else:
                     self._adm.sweep()
                 self._hb_busy = True    # a wedged step must read busy
@@ -1450,8 +1595,19 @@ class DecodeEngine(object):
                     self._steals += stolen
                 if self._tm is not None:
                     self._tm.steals.inc(stolen)
+            live = []
             for req in seats:
-                self._seat(rep, req)
+                # honor deadlines that expired in the routed-but-
+                # unseated window exactly like the admission sweep
+                # (AdmissionController.expire_request): the request
+                # completes with its (empty) partial output
+                if req.expired():
+                    self._adm.expire_request(req,
+                                             "expired before seating")
+                else:
+                    live.append(req)
+            if live:
+                self._join_many(rep, live)
             if not rep.occupied_count():
                 with self._dr_cond:
                     if rep.pending:
@@ -1475,17 +1631,6 @@ class DecodeEngine(object):
             rep.hb_t = time.monotonic()
             if rep.free_slots():
                 self._slot_free.set()
-
-    def _seat(self, rep, req):
-        """Seat one routed request, honoring a deadline that expired in
-        the routed-but-unseated window exactly like the admission sweep
-        would have (``AdmissionController.expire_request``): the
-        request completes with its (empty) partial output, never
-        occupies a slot."""
-        if req.expired():
-            self._adm.expire_request(req, "expired before seating")
-            return
-        self._join(rep, req)
 
     def _sweep_pending(self, rep, now):
         """Per-iteration deadline sweep over this replica's routed-but-
@@ -1634,18 +1779,70 @@ class DecodeEngine(object):
         return out
 
     def _join(self, rep, req):
-        """Seat one admitted request in a free slot BETWEEN steps: zero
-        (or prefill-fill) the slot's state rows, stage its first token,
-        mark the slot valid.  No shape changes anywhere — the next step
-        dispatch reuses the same compiled program."""
+        """Seat one admitted request BETWEEN steps (single-request
+        compatibility wrapper over :meth:`_join_many`)."""
+        self._join_many(rep, [req])
+
+    def _join_many(self, rep, reqs):
+        """Seat a batch of admitted requests in free slots BETWEEN
+        steps: zero (or prefill-fill) each slot's state rows, stage
+        first tokens, mark slots valid.  No shape changes anywhere —
+        the next step dispatch reuses the same compiled program.
+
+        With a prefill graph and ``MXNET_DECODE_COALESCE_PREFILL``
+        (default on), joiners landing in the same iteration COALESCE:
+        one dispatch per pow2 (batch, prompt) bucket instead of batch 1
+        per joiner — at concurrency the TTFT cost of the Nth joiner
+        stops being N serial prefill dispatches (ROADMAP 4b; the
+        ``decode_bench --prefill`` sweep measures the win).  Serial
+        mode (knob off) dispatches per request, byte-for-byte the
+        pre-coalescing engine."""
+        seated = [req for req in reqs if self._seat_slot(rep, req)]
+        if not seated:
+            return
+        if rep.prefill_caches:
+            # serial mode is the degenerate grouping — one singleton
+            # group per joiner dispatches the identical (1, bucket)
+            # program the pre-coalescing engine did, through the SAME
+            # code path (no serial/coalesced divergence to maintain)
+            groups = []                 # [(bucket, [reqs])], seat order
+            for req in seated:
+                b = next(bk for bk in rep.prefill_buckets
+                         if bk >= len(req.prompt))
+                g = next((g for g in groups if g[0] == b),
+                         None) if self._coalesce else None
+                if g is None:
+                    groups.append((b, [req]))
+                else:
+                    g[1].append(req)
+            for b, grp in groups:
+                self._prefill_group(rep, b, grp)
+        else:
+            for req in seated:
+                # the previous occupant's state rows are cleared IN
+                # the next step dispatch (StepProgram reset mask) — a
+                # join costs zero device traffic of its own
+                slot = req.slot
+                rep.reset_np[slot] = 1.0
+                rep.tokens_np[slot] = req.prompt[0]
+                rep.pos_np[slot] = 0.0
+                req.prompt_i = 1
+        for req in seated:
+            if req.slot is not None and rep.slots[req.slot] is req:
+                self._check_finish(rep, req.slot)
+
+    def _seat_slot(self, rep, req):
+        """Claim a free slot for one admitted request; False when the
+        request was cancelled before seating (counted as a leave so the
+        scraped series and stats() carry the same numbers)."""
         if not req.future.set_running_or_notify_cancel():
             if req.trace is not None:
                 req.trace.abort("cancelled")
             with self._lock:
-                self._leaves += 1     # stats() and the leaves series
-            if self._tm is not None:  # must carry the same numbers
+                self._leaves += 1
+            if self._tm is not None:
                 self._tm.leave("cancelled")
-            return
+            return False
         slot = rep.slots.index(None)
         req.slot = slot
         req.t_join = time.perf_counter()
@@ -1655,68 +1852,104 @@ class DecodeEngine(object):
             self._joins += 1
         if self._tm is not None:
             self._tm.joins.inc()
-        if rep.prefill_caches:
-            # a broken prefill dispatch is THIS request's failure, not
-            # the batch's: co-resident mid-generation requests share no
-            # state with it and must keep their partial generations
-            try:
-                self._prefill(rep, req, slot)
-            except Exception as e:
-                rep.slots[slot] = None
-                rep.valid_np[slot] = 0.0
-                with self._lock:
-                    self._leaves += 1
-                if self._tm is not None:
-                    self._tm.leave("error")
-                _fail_future(req.future, e)
-                if req.trace is not None:
-                    req.trace.abort(type(e).__name__)
-                return
-        else:
-            # the previous occupant's state rows are cleared IN the
-            # next step dispatch (StepProgram reset mask) — a join
-            # costs zero device traffic of its own
-            rep.reset_np[slot] = 1.0
-            rep.tokens_np[slot] = req.prompt[0]
-            rep.pos_np[slot] = 0.0
-            req.prompt_i = 1
-        self._check_finish(rep, slot)
+        return True
 
-    def _prefill(self, rep, req, slot):
-        """One bucketed dispatch consumes the whole prompt: pad onto
-        the pow2 bucket grid, run the prefill program (batch 1), sample
-        the last-valid-position logits into the first generated token,
-        scatter the output state rows into the free slot."""
-        if _faults.ACTIVE:
-            # chaos seam: fails exactly ONE request (the joining one),
-            # never the pool — the per-request prefill isolation path
-            _faults.trip("decode.prefill", replica=rep.label)
-        plen = len(req.prompt)
-        bucket = next(b for b in rep.prefill_buckets if b >= plen)
-        arr = np.zeros((1, bucket), np.float32)
-        arr[0, :plen] = req.prompt
-        feeds = {self._prefill_data_name: arr,
-                 self._prefill_len_name: np.asarray([plen], np.float32)}
-        outs = rep.prefill_caches[bucket].run(feeds)
-        if self._sampler.greedy:
-            first = outs[0][0]
-        else:
-            first = rep.program.sample_tokens(outs[0])[0]
-        rows = {name: outs[1 + i][0]
-                for i, name in enumerate(rep.program.state_names)}
+    def _fail_seated(self, rep, req, exc):
+        """Fail ONE seated request and free its slot — the per-request
+        isolation every prefill/callback failure path rides: co-
+        resident mid-generation requests share no state with it and
+        keep their partial generations."""
+        slot = req.slot
+        if slot is not None and rep.slots[slot] is req:
+            rep.slots[slot] = None
+            rep.valid_np[slot] = 0.0
+        with self._lock:
+            self._leaves += 1
+        if self._tm is not None:
+            self._tm.leave("error")
+        _fail_future(req.future, exc)
+        if req.trace is not None:
+            req.trace.abort(type(exc).__name__)
+
+    def _prefill_group(self, rep, bucket, group):
+        """The coalesced path: every joiner whose prompt pads into
+        ``bucket`` rides ONE dispatch at the next pow2 batch extent
+        (dead rows padded with zero prompts and length 0 — exactly the
+        all-pad rows warmup feeds), output state rows scattered into
+        each request's slot.  A failed dispatch fails the GROUP's
+        requests (they share that one program invocation) and nothing
+        else; the chaos seam still trips per request so a fault plan
+        targeting one joiner fails exactly one."""
+        live = []
+        for req in group:
+            if _faults.ACTIVE:
+                try:
+                    _faults.trip("decode.prefill", replica=rep.label)
+                except Exception as e:
+                    self._fail_seated(rep, req, e)
+                    continue
+            live.append(req)
+        if not live:
+            return
+        bb = next(b for b in self._prefill_batches if b >= len(live))
+        arr = np.zeros((bb, bucket), np.float32)
+        lens = np.zeros((bb,), np.float32)
+        for r_i, req in enumerate(live):
+            plen = len(req.prompt)
+            arr[r_i, :plen] = req.prompt
+            lens[r_i] = plen
+        try:
+            outs = rep.prefill_caches[bucket].run({
+                self._prefill_data_name: arr,
+                self._prefill_len_name: lens})
+            with self._lock:
+                self._prefill_dispatches += 1
+            if self._sampler.greedy:
+                first = np.asarray(outs[0])
+            else:
+                first = rep.program.sample_tokens(outs[0])
+            rows_all = [np.asarray(o) for o in outs[1:]]
+        except Exception as e:
+            for req in live:
+                self._fail_seated(rep, req, e)
+            return
+        for r_i, req in enumerate(live):
+            rows = {name: rows_all[i][r_i]
+                    for i, name in enumerate(rep.program.state_names)}
+            self._commit_prefill(rep, req, rows, first[r_i])
+
+    def _commit_prefill(self, rep, req, rows, first):
+        """Scatter one request's prefill output rows into its slot and
+        deliver the first generated token (row scatter stays one
+        traced-index kernel per state shape — never a new compile)."""
+        slot = req.slot
         rep.states = rep.program.write_row(rep.states, slot, rows)
         rep.reset_np[slot] = 0.0        # prefill rows are live data
-        req.prompt_i = plen
+        req.prompt_i = len(req.prompt)
         req.tokens.append(int(first))
         now = time.monotonic()
         req.t_first_tok = req.t_last_tok = now
         rep.tokens_np[slot] = first
-        rep.pos_np[slot] = float(plen)
+        rep.pos_np[slot] = float(len(req.prompt))
         with self._lock:
             self._tokens_out += 1
         if self._tm is not None:
             self._tm.tokens.inc()
             self._tm.ttft.observe(now - req.t_enqueue)
+        if req.on_token is not None:
+            self._fire_on_token(rep, req, int(first))
+
+    def _fire_on_token(self, rep, req, tok):
+        """Streaming hook: a raising callback evicts ONLY its own
+        request (future fails with the exception, slot frees, co-
+        residents untouched).  Returns False when the request was
+        evicted."""
+        try:
+            req.on_token(int(tok))
+            return True
+        except Exception as e:
+            self._fail_seated(rep, req, e)
+            return False
 
     def _step_once(self, rep):
         t0 = time.perf_counter()
@@ -1771,6 +2004,9 @@ class DecodeEngine(object):
                     if self._tm is not None:
                         self._tm.ttft.observe(t_tok - req.t_enqueue)
                 req.t_last_tok = t_tok
+                if req.on_token is not None \
+                        and not self._fire_on_token(rep, req, tok):
+                    continue        # evicted by its own callback
             self._check_finish(rep, i)
         dt_ms = (time.perf_counter() - t0) * 1e3
         with self._lock:
@@ -1884,9 +2120,15 @@ class DecodeEngine(object):
             rows[info["name"]] = np.zeros(tuple(info["shape"]), dt)
         prog.write_row(states, 0, rows)
         for b in rep.prefill_buckets:
-            rep.prefill_caches[b].run({
-                self._prefill_data_name: np.zeros((1, b), np.float32),
-                self._prefill_len_name: np.zeros((1,), np.float32)})
+            # the full (batch, prompt) bucket grid: coalesced prefill
+            # dispatches at pow2 BATCH extents too, and every shape
+            # live traffic can meet must be warm or the zero-warm-
+            # retrace contract would leak through the coalesced path
+            for bb in self._prefill_batches:
+                rep.prefill_caches[b].run({
+                    self._prefill_data_name: np.zeros((bb, b),
+                                                      np.float32),
+                    self._prefill_len_name: np.zeros((bb,), np.float32)})
 
     @property
     def compile_count(self):
@@ -1929,6 +2171,19 @@ class DecodeEngine(object):
                 "prefill": ("bucket" if self._prefill_caches
                             else "step"),
                 "prefill_buckets": list(self._prefill_buckets),
+                "prefill_coalesced": bool(self._coalesce),
+                "prefill_batch_buckets": list(self._prefill_batches),
+                "prefill_dispatches": self._prefill_dispatches,
+                "optimizer": {
+                    "accepted": (bool(self.opt_plan.accepted)
+                                 if self.opt_plan is not None else None),
+                    "rewrites": (len(self.opt_plan.rewrites)
+                                 if self.opt_plan is not None
+                                 and self.opt_plan.accepted else 0),
+                    "reason": (self.opt_plan.reason
+                               if self.opt_plan is not None else None),
+                    "selection": self.selection,
+                },
                 "step_ms": {
                     "count": len(step),
                     "mean": float(np.mean(step)) if step else 0.0,

@@ -1,0 +1,481 @@
+"""Decode fast-path tests (ISSUE 13): the O(1) KV-cache scatter op,
+the optimizer's verdict-gated fused-op selection stage, coalesced
+bucketed prefill, and the per-token streaming hook.
+
+Coverage per the issue contract: ``_cache_write_row`` bitwise against
+the one-hot blend it replaces across float32/float16 and edge indices
+(0, max_len-1), Pallas-interpret vs XLA-fallback agreement, selection
+adopted only via an accepted verdict-gated OptPlan (the engine serves
+the scatter-optimized step bitwise-identical to ``greedy_decode`` with
+compile counters pinned across churn; a rejected plan serves the
+unmodified graph), coalesced prefill serving staggered joiners bitwise
+vs ``greedy_decode`` in fewer dispatches, ``on_token`` callbacks
+observing the exact greedy prefix (a raising callback evicts only its
+own request), selection-toggle AOT fingerprint REJECTs, warm restart
+of a selection-optimized step with 0 traces, the ``graph_lint
+--decode-step`` selection report, and the ``decode_bench --prefill``
+smoke.
+"""
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import invoke_jax
+from mxnet_tpu.serving import DecodeEngine, StepProgram, greedy_decode
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from test_decode import _attn_step, _lstm_step, _sum_state_model  # noqa: E402
+
+
+def _import_tool(name):
+    path = os.path.join(REPO, "tools", "%s.py" % name)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _step_ops(program):
+    """Primary op names in a StepProgram's served graph."""
+    from mxnet_tpu.symbol.symbol import _topo
+    return [n.op.name for n in _topo(program._serve_sym._outputs)
+            if n.op is not None]
+
+
+# ---------------------------------------------------------------------------
+# the scatter op: bitwise against the one-hot blend it replaces
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float16],
+                         ids=["f32", "f16"])
+@pytest.mark.parametrize("positions", [
+    [0, 0, 0, 0],            # edge: first position
+    [15, 15, 15, 15],        # edge: max_len - 1
+    [3, 0, 15, 7],           # mixed, both edges included
+], ids=["pos0", "posmax", "mixed"])
+def test_scatter_bitwise_vs_onehot_blend(dtype, positions):
+    """out[i, pos[i], :] = row[i, :] must equal the blend
+    ``cache*(1-oh) + row*oh`` BITWISE: at the written position the
+    blend computes c*0 + r*1 == r, elsewhere c*1 + r*0 == c."""
+    import jax.numpy as jnp
+    n, max_len, d = 4, 16, 8
+    rng = np.random.default_rng(7)
+    cache = rng.standard_normal((n, max_len, d)).astype(dtype)
+    row = rng.standard_normal((n, d)).astype(dtype)
+    pos = np.asarray(positions, np.float32)
+    out = np.asarray(invoke_jax(
+        "_cache_write_row", {}, jnp.asarray(cache), jnp.asarray(row),
+        jnp.asarray(pos))[0])
+    oh = np.zeros((n, max_len), dtype)
+    oh[np.arange(n), pos.astype(int)] = 1
+    ohe = oh[:, :, None]
+    blend = (cache * (1 - ohe) + row[:, None, :] * ohe).astype(dtype)
+    assert out.dtype == np.dtype(dtype)
+    assert out.tobytes() == blend.tobytes()
+
+
+def test_scatter_pallas_interpret_matches_xla(monkeypatch):
+    """MXNET_CACHE_SCATTER_IMPL=interpret runs the Pallas kernel in
+    interpreter mode on CPU — it must agree bitwise with the
+    dynamic_update_slice fallback (CI's pin of the TPU kernel)."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(3)
+    cache = rng.standard_normal((5, 12, 6)).astype(np.float32)
+    row = rng.standard_normal((5, 6)).astype(np.float32)
+    pos = np.asarray([0, 11, 4, 11, 0], np.float32)
+    outs = {}
+    for mode in ("interpret", "xla"):
+        monkeypatch.setenv("MXNET_CACHE_SCATTER_IMPL", mode)
+        outs[mode] = np.asarray(invoke_jax(
+            "_cache_write_row", {}, jnp.asarray(cache),
+            jnp.asarray(row), jnp.asarray(pos))[0])
+    assert outs["interpret"].tobytes() == outs["xla"].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# fused-op selection: verdict-gated adoption
+# ---------------------------------------------------------------------------
+
+def _attn_spec(n=4, max_len=16, d=8):
+    shapes = {"token": (n,), "pos": (n,),
+              "k_cache": (n, max_len, d), "v_cache": (n, max_len, d)}
+    return shapes, {"slot": {k: 0 for k in shapes}}, \
+        ("k_cache", "v_cache")
+
+
+def test_selection_accepted_on_attention_step():
+    """The select pass swaps BOTH one-hot-blend KV writes for the
+    scatter op, the slot verdict stays row-local under pad-dirty
+    seeding, and analytic FLOPs drop (O(max_len*d) blends gone)."""
+    from mxnet_tpu.analysis import optimize_graph, SELECT_OPT_PASSES
+    step, _params, _si = _attn_step()
+    shapes, pad_axes, dirty = _attn_spec()
+    plan = optimize_graph(step, data_shapes=shapes, pad_axes=pad_axes,
+                          pad_dirty=dirty, passes=SELECT_OPT_PASSES)
+    assert plan.accepted, plan.reason
+    sels = [a for a in plan.actions if a.kind == "select"]
+    assert len(sels) == 2
+    assert plan.verdicts_after.get("slot") == "row-local"
+    from mxnet_tpu.symbol.symbol import _topo
+    ops = [x.op.name for x in _topo(plan.symbol._outputs)
+           if x.op is not None]
+    assert ops.count("_cache_write_row") == 2
+    assert "one_hot" not in ops
+    delta = plan.flops_delta()
+    assert delta is not None and delta[1] < delta[0]
+
+
+def test_selection_rejected_serves_unmodified(monkeypatch):
+    """When the padding classifier cannot prove the scatter row-local
+    (its transfer rule deleted — the candidate re-analysis goes
+    cross-position), the verdict gate REJECTS the plan and the engine
+    serves the unmodified one-hot-blend step, still bitwise against
+    greedy_decode."""
+    from mxnet_tpu.analysis import optimize_graph, SELECT_OPT_PASSES
+    from mxnet_tpu.analysis import padding as _padding
+    monkeypatch.delitem(_padding._HANDLERS, "_cache_write_row")
+    step, params, state_info = _attn_step()
+    shapes, pad_axes, dirty = _attn_spec()
+    plan = optimize_graph(step, data_shapes=shapes, pad_axes=pad_axes,
+                          pad_dirty=dirty, passes=SELECT_OPT_PASSES)
+    assert not plan.accepted
+    assert "verdict" in (plan.reason or "")
+    # the engine rides the same gate: rejected plan -> unmodified graph
+    with pytest.warns(UserWarning, match="rejected"):
+        eng = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                           max_len=16, default_deadline_ms=0)
+    assert "_cache_write_row" not in _step_ops(eng._program)
+    assert eng.stats()["decode"]["optimizer"]["accepted"] is False
+    eng.warmup()
+    got = eng.generate([1, 2], max_new_tokens=6, timeout=120)
+    eng.close()
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    assert np.array_equal(got.tokens,
+                          greedy_decode(ref, [1, 2], 6, max_len=16))
+
+
+def test_engine_serves_selected_step_bitwise_with_pinned_compiles():
+    """The acceptance gate: DecodeEngine serves the scatter-selected
+    step (adopted via the verdict-gated OptPlan, not hand-editing) and
+    its tokens are bitwise-identical to greedy_decode over the
+    UNOPTIMIZED one-hot-blend program, with the compile counter pinned
+    across join/leave churn."""
+    step, params, state_info = _attn_step()
+    max_len = 16
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=4,
+                       max_len=max_len, default_deadline_ms=0)
+    ops = _step_ops(eng._program)
+    assert ops.count("_cache_write_row") == 2     # the selection served
+    sel = eng.stats()["decode"]["optimizer"]
+    assert sel["accepted"] is True
+    assert [s["op"] for s in sel["selection"]] == ["_cache_write_row"] * 2
+    c0 = eng.warmup()
+    prompts = [[1, 2], [3], [5, 1, 4], [2, 2], [7], [1, 1, 1, 1]]
+    futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    res = [f.result(timeout=120) for f in futs]
+    assert eng.compile_count == c0                # pinned across churn
+    eng.close()
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    assert "_cache_write_row" not in _step_ops(ref)   # blend reference
+    for p, r in zip(prompts, res):
+        want = greedy_decode(ref, p, 8, max_len=max_len)
+        assert np.array_equal(r.tokens, want), (p, r.tokens, want)
+
+
+def test_selection_knob_off_serves_blend(monkeypatch):
+    monkeypatch.setenv("MXNET_OPT_SELECT_KERNELS", "0")
+    step, params, state_info = _attn_step()
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                       max_len=16, default_deadline_ms=0, start=False)
+    assert "_cache_write_row" not in _step_ops(eng._program)
+    assert eng.opt_plan is None
+    eng.close(drain=False)
+
+
+def test_lstm_step_selects_nothing():
+    """No KV-write pattern in a recurrent step: the selection stage
+    stands down (no scatter node) and the plan still accepts."""
+    step, params, state_info = _lstm_step()
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                       max_len=16, default_deadline_ms=0, start=False)
+    assert "_cache_write_row" not in _step_ops(eng._program)
+    opt = eng.stats()["decode"]["optimizer"]
+    assert opt["accepted"] in (True, None)
+    assert not opt["selection"]
+    eng.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# coalesced bucketed prefill
+# ---------------------------------------------------------------------------
+
+def test_coalesced_prefill_staggered_joins_bitwise():
+    """Concurrent + staggered joiners through the coalesced prefill
+    path: every request's tokens equal greedy_decode exactly, the
+    engine dispatched FEWER prefills than joins (coalescing actually
+    happened), and the compile counter is pinned across the churn."""
+    step, prefill, params, state_info = _sum_state_model()
+    max_len = 32
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=4,
+                       max_len=max_len, prefill_sym=prefill,
+                       max_queue=32, default_deadline_ms=0)
+    c0 = eng.warmup()
+    assert eng.stats()["decode"]["prefill_coalesced"] is True
+    rng = np.random.default_rng(5)
+    prompts = [[int(t) for t in rng.integers(16, size=rng.integers(1, 9))]
+               for _ in range(12)]
+    futs = []
+    for i, p in enumerate(prompts):      # burst + stagger mix
+        futs.append(eng.submit(p, max_new_tokens=6))
+        if i % 4 == 3:
+            time.sleep(0.003)
+    res = [f.result(timeout=120) for f in futs]
+    st = eng.stats()["decode"]
+    assert eng.compile_count == c0
+    assert st["joins"] == 12
+    assert 0 < st["prefill_dispatches"] < 12      # coalesced
+    eng.close()
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    for p, r in zip(prompts, res):
+        want = greedy_decode(ref, p, 6, max_len=max_len)
+        assert np.array_equal(r.tokens, want), (p, r.tokens, want)
+
+
+def test_coalesce_knob_off_is_serial_and_bitwise(monkeypatch):
+    step, prefill, params, state_info = _sum_state_model()
+    monkeypatch.setenv("MXNET_DECODE_COALESCE_PREFILL", "0")
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=4,
+                       max_len=32, prefill_sym=prefill,
+                       max_queue=32, default_deadline_ms=0)
+    eng.warmup()
+    monkeypatch.delenv("MXNET_DECODE_COALESCE_PREFILL")
+    prompts = [[1, 2, 3], [4], [5, 6], [7, 8, 9, 10]]
+    futs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    res = [f.result(timeout=120) for f in futs]
+    st = eng.stats()["decode"]
+    assert st["prefill_coalesced"] is False
+    assert st["prefill_batch_buckets"] == [1]
+    assert st["prefill_dispatches"] == 4          # one per joiner
+    eng.close()
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    for p, r in zip(prompts, res):
+        assert np.array_equal(r.tokens,
+                              greedy_decode(ref, p, 5, max_len=32))
+
+
+def test_coalesced_prefill_fault_fails_one_request(monkeypatch):
+    """The decode.prefill chaos seam still fails exactly ONE request
+    under coalescing: the seam trips per request BEFORE the group
+    dispatch, so group peers prefill normally."""
+    from mxnet_tpu.serving import faults as _faults
+    step, prefill, params, state_info = _sum_state_model()
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=4,
+                       max_len=32, prefill_sym=prefill,
+                       max_queue=32, default_deadline_ms=0, start=False)
+    eng.warmup()
+    _faults.install("decode.prefill:raise:on=2")
+    try:
+        eng.start()
+        prompts = [[1, 2], [3, 4], [5, 6], [7, 8]]
+        futs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        outcomes = []
+        for f in futs:
+            try:
+                outcomes.append(("ok", list(f.result(timeout=120).tokens)))
+            except _faults.FaultInjected:
+                outcomes.append(("fault", None))
+    finally:
+        _faults.clear()
+        eng.close()
+    assert sum(1 for k, _ in outcomes if k == "fault") == 1
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    for (kind, toks), p in zip(outcomes, prompts):
+        if kind == "ok":
+            assert toks == list(greedy_decode(ref, p, 4, max_len=32))
+
+
+# ---------------------------------------------------------------------------
+# per-token streaming hook
+# ---------------------------------------------------------------------------
+
+def test_on_token_observes_exact_greedy_prefix():
+    """Callbacks see each generated token, in order, equal to the
+    final DecodeResult.tokens — across BOTH the teacher-forcing path
+    (LSTM) and the prefill path (first token from the prefill
+    dispatch)."""
+    for builder in ("lstm", "prefill"):
+        if builder == "lstm":
+            step, params, state_info = _lstm_step()
+            prefill = None
+        else:
+            step, prefill, params, state_info = _sum_state_model()
+        eng = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                           max_len=32, prefill_sym=prefill,
+                           max_queue=16, default_deadline_ms=0)
+        eng.warmup()
+        seen = {}
+        futs = []
+        for i, p in enumerate([[1, 2], [3], [4, 5, 6]]):
+            seen[i] = []
+            futs.append(eng.submit(p, max_new_tokens=6,
+                                   on_token=seen[i].append))
+        res = [f.result(timeout=120) for f in futs]
+        eng.close()
+        ref = StepProgram(step, params, {}, state_info, num_slots=1)
+        for i, (p, r) in enumerate(zip([[1, 2], [3], [4, 5, 6]], res)):
+            assert seen[i] == [int(t) for t in r.tokens]
+            assert np.array_equal(r.tokens,
+                                  greedy_decode(ref, p, 6, max_len=32))
+
+
+def test_raising_callback_evicts_only_its_own_request():
+    step, params, state_info = _lstm_step()
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=4,
+                       max_len=64, max_queue=16, default_deadline_ms=0)
+    eng.warmup()
+
+    class Boom(RuntimeError):
+        pass
+
+    got = []
+
+    def bad(tok):
+        got.append(tok)
+        if len(got) >= 3:
+            raise Boom("stream consumer gone")
+
+    doomed = eng.submit([1], max_new_tokens=20, on_token=bad)
+    others = [eng.submit([t], max_new_tokens=8) for t in (2, 3, 4)]
+    with pytest.raises(Boom):
+        doomed.result(timeout=120)
+    res = [f.result(timeout=120) for f in others]
+    st = eng.stats()["decode"]
+    eng.close()
+    assert len(got) == 3                  # stopped at the raise
+    assert all(len(r) == 8 and r.finish_reason == "length" for r in res)
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    for t, r in zip((2, 3, 4), res):
+        assert np.array_equal(r.tokens,
+                              greedy_decode(ref, [t], 8, max_len=64))
+    assert st["leaves"] == 4              # 3 finishes + 1 eviction
+
+
+# ---------------------------------------------------------------------------
+# AOT cache: selection rides the validity fingerprint
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "aot")
+    monkeypatch.setenv("MXNET_AOT_CACHE_DIR", d)
+    monkeypatch.setenv("MXNET_AOT_CACHE", "1")
+    return d
+
+
+def test_warm_restart_of_selected_step_zero_traces(cache_dir):
+    """A restarted engine whose step graph carries the scatter
+    selection draws every program from the AOT cache: ZERO traces,
+    bitwise-identical tokens."""
+    step, params, state_info = _attn_step()
+    e1 = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                      max_len=16, default_deadline_ms=0)
+    assert "_cache_write_row" in _step_ops(e1._program)
+    e1.warmup()
+    ref = list(e1.generate([1, 2], max_new_tokens=6,
+                           timeout=120).tokens)
+    assert e1.compile_count > 0
+    st1 = e1.stats()["decode"]["aot"]
+    assert st1["selection"] and st1["rejects"] == 0
+    e1.close()
+
+    e2 = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                      max_len=16, default_deadline_ms=0)
+    e2.warmup()
+    got = list(e2.generate([1, 2], max_new_tokens=6,
+                           timeout=120).tokens)
+    st2 = e2.stats()["decode"]["aot"]
+    assert e2.compile_count == 0          # fully warm restart
+    assert st2["rejects"] == 0 and st2["hits"] > 0
+    e2.close()
+    assert got == ref
+
+
+def test_selection_toggle_rejects_stale_entries(cache_dir, monkeypatch):
+    """Flipping MXNET_OPT_SELECT_KERNELS between restarts moves the
+    validity fingerprint: the restarted engine REJECTS the previous
+    regime's entries (alertable) instead of serving a stale program,
+    recompiles fresh, and still decodes bitwise vs greedy_decode."""
+    step, prefill, params, state_info = _sum_state_model()
+    e1 = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                      max_len=16, prefill_sym=prefill,
+                      max_queue=8, default_deadline_ms=0)
+    e1.warmup()
+    w1 = e1.compile_count
+    assert w1 > 0 and e1.stats()["decode"]["aot"]["writes"] > 0
+    e1.close()
+
+    monkeypatch.setenv("MXNET_OPT_SELECT_KERNELS", "0")
+    e2 = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                      max_len=16, prefill_sym=prefill,
+                      max_queue=8, default_deadline_ms=0)
+    e2.warmup()
+    st2 = e2.stats()["decode"]["aot"]
+    # prefill programs and row-scatter kernels are graph-identical
+    # across the toggle — only the fingerprint protects them, and it
+    # must: present-but-unusable entries REJECT, none load as hits
+    assert st2["rejects"] > 0, st2
+    assert st2["hits"] == 0
+    assert e2.compile_count > 0           # recompiled fresh
+    got = e2.generate([1, 2, 3], max_new_tokens=5, timeout=120)
+    e2.close()
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    assert np.array_equal(
+        got.tokens, greedy_decode(ref, [1, 2, 3], 5, max_len=16))
+
+
+# ---------------------------------------------------------------------------
+# CLI + bench smokes
+# ---------------------------------------------------------------------------
+
+def test_graph_lint_reports_decode_step_selections(tmp_path, capsys):
+    step, _params, _si = _attn_step()
+    path = str(tmp_path / "attn_step.json")
+    step.save(path)
+    lint = _import_tool("graph_lint")
+    rc = lint.main([path, "--decode-step", "--json",
+                    "--shapes", "token=4", "--shapes", "pos=4",
+                    "--shapes", "k_cache=4,16,8",
+                    "--shapes", "v_cache=4,16,8",
+                    "--decode-state", "k_cache,v_cache"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    entry = doc["graphs"][path]
+    assert entry["verdicts"]["slot"] == "row-local"
+    sels = entry["selections"]
+    assert len(sels) == 2
+    assert all(s["op"] == "_cache_write_row" for s in sels)
+    assert all(s["verdict"] == "accepted" for s in sels)
+
+
+def test_prefill_bench_smoke():
+    """Fast smoke of the decode_bench --prefill sweep: hard gates
+    (bitwise, zero retraces) asserted here; the recorded BENCH_ttft
+    numbers are advisory per the host-noise protocol."""
+    sys.path.insert(0, os.path.join(REPO, "perf"))
+    import decode_bench
+    row = decode_bench.run_prefill_sweep(
+        requests=8, slots=4, max_len=32, max_prompt=8, max_new=2,
+        repeats=1)
+    assert row["bitwise_identical"]
+    assert row["retraces"] == {"serial": 0, "coalesced": 0}
+    assert row["prefill_dispatches"]["coalesced"] \
+        < row["prefill_dispatches"]["serial"]
+    assert row["ttft_serial"]["mean_ms"] > 0
+    assert row["ttft_coalesced"]["mean_ms"] > 0

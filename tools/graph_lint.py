@@ -33,7 +33,14 @@ Usage:
 
     # continuous-batching decode: is the masked step row-local along
     # the SLOT axis (axis 0), with state inputs seeded pad-dirty?
-    python tools/graph_lint.py step-symbol.json --decode-step \
+    # Also reports the fused-op selections (op, site, verdict) the
+    # optimizer's selection stage would make on this step — the
+    # offline audit of MXNET_OPT_SELECT_KERNELS kernel swaps.  The
+    # selection report is ADVISORY: it never moves the exit code
+    # (--decode-step exits on the verdict/findings exactly as before;
+    # a rejected selection plan shows up as verdict "rejected: ...",
+    # not as a failure)
+    python tools/graph_lint.py step-symbol.json --decode-step --json \
         --shapes token=8 --shapes h=8,32 --shapes c=8,32 \
         --decode-state h,c
 
@@ -256,12 +263,27 @@ def main(argv=None):
             hard = bool(report.errors)
             unsound = verdict == "cross-position"
             failed = unsound or not report.clean(strict=args.strict)
+            # fused-op selection audit (advisory, never moves the exit
+            # code): which kernel swaps the optimizer's selection stage
+            # WOULD make on this step graph, and whether the verdict-
+            # gated plan accepts them — so operators can audit what
+            # MXNET_OPT_SELECT_KERNELS will serve, offline, before a
+            # deploy flips the knob
+            selections = []
+            if not hard:
+                selections = _decode_selections(
+                    analysis, graph, shapes, state_names,
+                    args.decode_valid, args.training)
             doc[spec] = {"findings": report.to_list(),
-                         "verdicts": {"slot": verdict}, "repairs": []}
+                         "verdicts": {"slot": verdict}, "repairs": [],
+                         "selections": selections}
             if not args.as_json and (failed or not args.quiet):
                 print("== %s ==" % spec)
                 print(report.format())
                 print("  decode-step slot axis: %s" % verdict)
+                for s in selections:
+                    print("  fused-op selection: %s at %s (%s)"
+                          % (s["op"], s["site"], s["verdict"]))
                 if unsound:
                     print("  FAIL: step graph is cross-position along "
                           "the slot axis — a dead slot's stale state "
@@ -329,6 +351,30 @@ def main(argv=None):
     if args.as_json:
         print(json.dumps({"graphs": doc}, indent=2, default=str))
     return worst
+
+
+def _decode_selections(analysis, graph, shapes, state_names,
+                       valid_name, training):
+    """Report the fused-op selections (op, site, verdict) the
+    optimizer's selection stage would make on a decode step graph —
+    the offline audit of ``MXNET_OPT_SELECT_KERNELS`` kernel swaps.
+    Advisory by contract: a crash or a rejected plan is itself part of
+    the report, never an exit-code change."""
+    try:
+        plan = analysis.optimize_graph(
+            graph, data_shapes=shapes,
+            pad_axes={"slot": {n: 0 for n in shapes}},
+            valid_lengths=({"slot": valid_name} if valid_name else None),
+            pad_dirty=tuple(state_names), training=training,
+            passes=analysis.SELECT_OPT_PASSES)
+    except Exception as e:
+        return [{"op": None, "site": None,
+                 "verdict": "error: %s" % e}]
+    verdict = "accepted" if plan.accepted \
+        else "rejected: %s" % plan.reason
+    return [{"op": "_cache_write_row", "site": a.node,
+             "verdict": verdict, "detail": a.detail}
+            for a in plan.actions if a.kind == "select"]
 
 
 def _json_float(v):
