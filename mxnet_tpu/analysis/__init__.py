@@ -65,6 +65,8 @@ from .rewrite import RepairPlan, plan_repair, repair_serving_graph
 from .optimize import (OptPlan, OptAction, optimize_graph,
                        register_opt_pass, DEFAULT_OPT_PASSES,
                        SELECT_OPT_PASSES)
+from .sharding import (ShardingCheck, check_sharding_plan,
+                       audit_sharding_plan)
 
 __all__ = [
     "Severity", "Diagnostic", "Report", "AnalysisError",
@@ -79,6 +81,7 @@ __all__ = [
     "OptPlan", "OptAction", "optimize_graph", "register_opt_pass",
     "DEFAULT_OPT_PASSES", "SELECT_OPT_PASSES",
     "check_serving_graph", "check_decode_step", "verify",
+    "ShardingCheck", "check_sharding_plan", "audit_sharding_plan",
 ]
 
 

@@ -1,0 +1,243 @@
+"""Sharding-plan soundness check — the verdict gate model-parallel
+serving rides (ROADMAP item 1).
+
+A :class:`~mxnet_tpu.parallel.mesh.ShardingPlan` partitions a served
+program's arrays over a device group; XLA's SPMD partitioner inserts
+the collectives, so *values* never change — but the serving tier's
+padding machinery does: a plan that partitions a **padded data axis**
+(the pow2 batch bucket, a seq bucket, the decode slot axis) splits pad
+slots and live slots across devices, and the padded-axis verdicts are
+exactly the statement of whether that is sound.  A graph that is
+**cross-position** along a padded axis mixes pad garbage into live
+rows already; partitioning that axis additionally bakes the mixing
+into cross-device collectives, where the engine's degrade paths
+(exact-length programs, ``max_batch=1``) no longer exist.  So the rule
+is the same one every rewrite obeys: a plan is ACCEPTED only when
+every padded axis it partitions carries a row-local verdict, and
+rejected with a reason naming the axis and its verdict otherwise.
+Partitioning parameters or decode slot-state feature axes (tensor
+parallelism proper) is always placement-only and never gated.
+
+Two consumers share this module: the serving engines (construction
+time, verdicts already in hand from the preflight) and
+``tools/graph_lint.py --sharding-plan`` (offline, over a symbol JSON —
+it also reports which graph nodes the plan partitions, i.e. every node
+downstream of a partitioned input under the computation-follows-data
+placement model).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["ShardingCheck", "check_sharding_plan", "audit_sharding_plan",
+           "gate_plan_spec"]
+
+
+class ShardingCheck(object):
+    """Outcome of checking one plan spec against padded-axis verdicts:
+    ``accepted`` + ``reasons`` (rejection causes, empty when accepted),
+    ``partitioned`` — one row per partitioned input axis with the
+    verdict that justified or rejected it — and the normalized
+    ``spec``."""
+    __slots__ = ("accepted", "reasons", "partitioned", "spec")
+
+    def __init__(self, accepted, reasons, partitioned, spec):
+        self.accepted = accepted
+        self.reasons = list(reasons)
+        self.partitioned = list(partitioned)
+        self.spec = spec
+
+    def to_dict(self):
+        return {"accepted": self.accepted, "reasons": self.reasons,
+                "partitioned": self.partitioned, "spec": self.spec}
+
+    def __repr__(self):
+        return ("<ShardingCheck accepted>" if self.accepted else
+                "<ShardingCheck REJECTED: %s>" % "; ".join(self.reasons))
+
+
+# which data-axis fields of a plan spec partition which padded-axis
+# verdict label, per engine kind: the one-shot engine pads batch=dim0
+# (and optionally a seq axis).  None = the field has no meaning for
+# that kind and a plan setting it is rejected outright.  Decode
+# rejects BOTH: the slot pool shards via state_rules axis 0 (which
+# carries its own slot-verdict gate below), while batch_axis would
+# physically partition the coalesced-PREFILL batch — a padded axis no
+# analysis pass covers, so the gate could only approve an unproven
+# partition; and a slot pool has no dim-1 data axis (state positions
+# shard via state_rules).
+_AXIS_LABELS = {
+    "serve": {"batch_axis": "batch", "seq_axis": "seq"},
+    "decode": {"batch_axis": None, "seq_axis": None},
+}
+_NO_AXIS_REASON = {
+    ("decode", "batch_axis"):
+        "batch_axis has no gated meaning for a decode plan: the slot "
+        "pool shards via state_rules axis 0 (slot-verdict gated), and "
+        "partitioning the padded prefill batch is not covered by any "
+        "analysis pass",
+    ("decode", "seq_axis"):
+        "seq_axis has no meaning for a decode plan (a slot pool has "
+        "no dim-1 data axis; shard state positions via state_rules "
+        "instead)",
+}
+
+
+def check_sharding_plan(spec, verdicts=None, kind="serve"):
+    """Check one plan spec against the padded-axis ``verdicts`` an
+    engine's preflight produced (``{"batch": ..., "seq": ...}`` for the
+    one-shot engine, ``{"slot": ...}`` for decode).
+
+    Acceptance rule: every padded data axis the plan partitions must
+    carry a ``"row-local"`` verdict.  ``"cross-position"`` rejects with
+    a reason; a partitioned axis with NO verdict (analysis disabled, or
+    the axis is not padded under the engine's policy) also rejects —
+    the gate must fail closed, an unproven partition is not a sound
+    one.  Param/state rules are recorded but never gated (placement-
+    only).  Raises :class:`MXNetError` on a malformed spec."""
+    from ..parallel.mesh import normalize_plan_spec
+    spec = normalize_plan_spec(spec)
+    if kind not in _AXIS_LABELS:
+        raise MXNetError("check_sharding_plan: unknown engine kind %r"
+                         % (kind,))
+    verdicts = dict(verdicts or {})
+    reasons, partitioned = [], []
+    for field, dim in (("batch_axis", 0), ("seq_axis", 1)):
+        mesh_axis = spec.get(field)
+        if mesh_axis is None:
+            continue
+        label = _AXIS_LABELS[kind][field]
+        if label is None:
+            reasons.append(_NO_AXIS_REASON[(kind, field)])
+            continue
+        verdict = verdicts.get(label)
+        row = {"input": "<data>", "axis": dim, "mesh_axis": mesh_axis,
+               "padded_axis": label, "verdict": verdict}
+        partitioned.append(row)
+        if verdict == "row-local":
+            continue
+        if verdict == "cross-position":
+            reasons.append(
+                "%s=%r partitions the padded %s axis, whose verdict is "
+                "cross-position: positions already mix across it, and "
+                "splitting pad and live slots over devices has no "
+                "degrade path — run graph_lint for the offending node"
+                % (field, mesh_axis, label))
+        else:
+            reasons.append(
+                "%s=%r partitions the padded %s axis but no row-local "
+                "verdict covers it (verdict: %r) — the gate fails "
+                "closed: an unproven partition is not a sound one"
+                % (field, mesh_axis, label, verdict))
+    for field in ("param_rules", "state_rules"):
+        for pat, axspec in spec[field]:
+            if not any(ax is not None for ax in axspec):
+                continue
+            # a decode state_rule that shards axis 0 partitions the
+            # SLOT axis of the pool — the same padded axis batch_axis
+            # names — so it rides the same verdict gate; every other
+            # rule axis (and every param rule) is placement-only
+            if kind == "decode" and field == "state_rules" \
+                    and axspec and axspec[0] is not None:
+                verdict = verdicts.get("slot")
+                partitioned.append(
+                    {"input": pat, "rule": field, "spec": list(axspec),
+                     "padded_axis": "slot", "verdict": verdict})
+                if verdict != "row-local":
+                    reasons.append(
+                        "state rule %r shards axis 0 — the slot axis "
+                        "of the pool — but the step verdict is %r, "
+                        "not row-local" % (pat, verdict))
+                continue
+            partitioned.append(
+                {"input": pat, "rule": field,
+                 "spec": list(axspec), "verdict": "placement-only"})
+    return ShardingCheck(not reasons, reasons, partitioned, spec)
+
+
+def gate_plan_spec(sharding, verdicts, kind, owner):
+    """The engine-construction gate both serving engines share: resolve
+    the ``sharding`` argument (spec / JSON / file path; falls back to
+    ``MXNET_SERVE_SHARDING``), run :func:`check_sharding_plan` against
+    the preflight ``verdicts``, and raise :class:`MXNetError` naming
+    ``owner`` with the reasons on rejection.  Returns ``(check, spec)``
+    — ``(None, None)`` when no plan is configured."""
+    from .. import config
+    from ..parallel.mesh import load_plan_spec
+    if sharding is None:
+        sharding = config.get("MXNET_SERVE_SHARDING").strip() or None
+    if sharding is None:
+        return None, None
+    check = check_sharding_plan(load_plan_spec(sharding),
+                                verdicts=verdicts, kind=kind)
+    if not check.accepted:
+        raise MXNetError("%s: sharding plan rejected:\n  %s"
+                         % (owner, "\n  ".join(check.reasons)))
+    return check, check.spec
+
+
+def _downstream_nodes(symbol, seed_names):
+    """Every op node reachable from the named input variables under
+    the computation-follows-data placement model — the nodes a plan
+    that partitions those inputs actually partitions."""
+    from .graph import GraphView
+    view = GraphView(symbol)
+    tainted = set()
+    out = []
+    for n in view.topo:
+        if n.op is None:
+            if n.name in seed_names:
+                tainted.add(id(n))
+            continue
+        if any(id(inp) in tainted for inp, _ in n.inputs):
+            tainted.add(id(n))
+            out.append(n.name)
+    return out
+
+
+def audit_sharding_plan(symbol, spec, data_shapes=None, policy=None,
+                        kind="serve", state_names=(), valid_name=None,
+                        verdicts=None):
+    """The offline (``graph_lint --sharding-plan``) audit: compute the
+    padded-axis verdicts for ``symbol`` when the caller has none, run
+    :func:`check_sharding_plan`, and annotate the outcome with the
+    graph nodes each partitioned input reaches.
+
+    ``kind="serve"`` analyzes via ``check_serving_graph`` (needs
+    per-example ``data_shapes`` + a BucketPolicy); ``kind="decode"``
+    via ``check_decode_step`` (full slot-pool shapes + state names).
+    Returns ``(ShardingCheck, {"nodes": {...}, "verdicts": {...}})``.
+    """
+    from ..parallel.mesh import normalize_plan_spec
+    spec = normalize_plan_spec(spec)
+    if verdicts is None:
+        if kind == "serve":
+            from . import check_serving_graph
+            verdicts, _report = check_serving_graph(
+                symbol, data_shapes, policy)
+        else:
+            from . import check_decode_step
+            verdict, _report = check_decode_step(
+                symbol, data_shapes, state_names=state_names,
+                valid_name=valid_name)
+            verdicts = {"slot": verdict}
+    check = check_sharding_plan(spec, verdicts=verdicts, kind=kind)
+    # node attribution: data-axis partitions taint every data input;
+    # param/state rules taint the variables they match
+    import re
+    arg_names = set(symbol.list_arguments())
+    nodes = {}
+    data_names = set(data_shapes or ())
+    if spec.get("batch_axis") or spec.get("seq_axis"):
+        seeds = data_names & arg_names
+        if seeds:
+            nodes["<data>"] = _downstream_nodes(symbol, seeds)
+    for field in ("param_rules", "state_rules"):
+        for pat, axspec in spec[field]:
+            if not any(ax is not None for ax in axspec):
+                continue
+            rx = re.compile(pat)
+            matched = {n for n in arg_names if rx.search(n)}
+            if matched:
+                nodes[pat] = _downstream_nodes(symbol, matched)
+    return check, {"nodes": nodes, "verdicts": dict(verdicts)}

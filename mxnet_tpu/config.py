@@ -131,6 +131,24 @@ VARIABLES = {v.name: v for v in [
          "devices exist the engine clamps with a warning.  1 = the "
          "single-device fast path, byte-for-byte the pre-replica "
          "engine."),
+    _Var("MXNET_SERVE_SHARDING", str, "",
+         "Model-parallel serving plan (serving + parallel/mesh.py, "
+         "ROADMAP item 1): a ShardingPlan spec — inline JSON or a "
+         "path to a JSON file — e.g. '{\"axes\": {\"tp\": 2}, "
+         "\"param_rules\": [[\"fc.*weight$\", [null, \"tp\"]]]}'.  "
+         "Each engine replica then owns a prod(axes)-device group in "
+         "dp order and compiles every program (bucket programs, "
+         "decode step, prefill buckets) under the plan: params upload "
+         "as sharded device_put, per-slot decode state lays out per "
+         "state_rules, and XLA inserts the collectives.  Composes "
+         "with MXNET_SERVE_REPLICAS (N replicas x G-device plans "
+         "needs N*G devices; never clamped).  Plans partitioning a "
+         "padded data axis (batch_axis/seq_axis) are verdict-gated: "
+         "cross-position or unproven axes REJECT at construction "
+         "with a reason (analysis.check_sharding_plan; audit offline "
+         "with tools/graph_lint.py --sharding-plan).  Empty = "
+         "single-device replicas, byte-for-byte the unsharded "
+         "engines."),
     _Var("MXNET_SERVE_SEQ_BUCKETS", str, "",
          "Comma-separated sequence-length buckets (e.g. '32,64,128') "
          "for the serving engine.  When set, per-example axis 0 is "
@@ -327,15 +345,24 @@ VARIABLES = {v.name: v for v in [
          "Master switch for the persistent AOT program cache: 0 "
          "disables it even when MXNET_AOT_CACHE_DIR is set (kill "
          "switch for a corrupt or slow shared cache volume)."),
-    _Var("MXNET_AOT_XLA_CACHE", bool, False,
+    _Var("MXNET_AOT_XLA_CACHE", str, "auto",
          "Also point jax's persistent compilation cache at "
          "MXNET_AOT_CACHE_DIR/xla (first engine wins; process-global)."
          "  The AOT entries skip Python tracing; this knob "
          "additionally skips XLA's compile of the deserialized "
          "module, so a warm restart loads executables instead of "
-         "building them.  Off by default: it flips process-wide jax "
-         "config (cache thresholds included), which a library should "
-         "only do when asked."),
+         "building them.  'auto' (default): enabled only when the "
+         "serving entrypoint owns process bring-up — the first "
+         "AOT-enabled engine is constructed before any of this "
+         "library's graph programs has traced (executor."
+         "xla_traces_ever() == 0), so flipping the process-wide jax "
+         "config cannot surprise an application that compiled first "
+         "(ROADMAP residual b1).  '1' forces it on regardless (the "
+         "late-enable latch re-initializes jax's cache via "
+         "compilation_cache.reset_cache, so programs compiled before "
+         "the engine existed do not pin it off); '0' is the explicit "
+         "opt-out.  An operator-set jax_compilation_cache_dir is "
+         "never overridden."),
     _Var("MXNET_FAULT_PLAN", str, "",
          "Deterministic fault-injection plan (serving/faults.py).  "
          "Either a JSON list of clause dicts or the compact grammar "

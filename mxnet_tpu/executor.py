@@ -35,10 +35,23 @@ __all__ = ["Executor", "build_graph_fn"]
 _TM_CACHE = {}          # memoized instrument children (see telemetry.bound)
 
 
+_XLA_TRACES_EVER = 0
+
+
+def xla_traces_ever():
+    """Process-lifetime XLA trace count across every jitted graph
+    program, counted regardless of telemetry state.  Zero means no
+    program has compiled yet — the 'serving entrypoint owns process
+    bring-up' signal MXNET_AOT_XLA_CACHE='auto' keys on."""
+    return _XLA_TRACES_EVER
+
+
 def _count_xla_trace():
     """Trace-time side effect shared by the executor's jitted programs
     (same contract as CachedOp's counter: fires once per XLA compile,
     never on cached dispatches)."""
+    global _XLA_TRACES_EVER
+    _XLA_TRACES_EVER += 1
     from . import telemetry
     if telemetry.enabled():
         telemetry.bound(

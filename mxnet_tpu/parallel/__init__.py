@@ -11,10 +11,14 @@ Reference mapping (SURVEY §2.3):
 - absent-in-reference SP/CP → ring attention (ring_attention.py).
 - absent-in-reference PP → microbatched pipeline (pipeline.py).
 """
-from .mesh import make_mesh, ShardingPlan, data_parallel_plan
+from .mesh import (make_mesh, ShardingPlan, data_parallel_plan,
+                   normalize_plan_spec, plan_group_size,
+                   replica_device_groups)
 from .ring_attention import ring_attention, blockwise_attention
 from .pipeline import (pipeline_shard_map, pipeline_train_step,
                        hetero_pipeline_train_step, PipelineModule)
 
 __all__ = ["make_mesh", "ShardingPlan", "data_parallel_plan",
+           "normalize_plan_spec", "plan_group_size",
+           "replica_device_groups",
            "ring_attention", "blockwise_attention", "pipeline_shard_map"]

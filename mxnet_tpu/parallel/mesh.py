@@ -8,12 +8,15 @@ place arrays accordingly — computation then follows data under jit.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import re
 
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "ShardingPlan", "data_parallel_plan",
-           "data_parallel_devices"]
+           "data_parallel_devices", "replica_device_groups",
+           "normalize_plan_spec", "plan_group_size", "load_plan_spec"]
 
 _AXIS_ORDER = ("dp", "pp", "tp", "sp", "ep")
 
@@ -62,15 +65,40 @@ class ShardingPlan:
     - `param_rules`: [(regex, PartitionSpec-like tuple)] matched against
       parameter names, first hit wins; unmatched params are replicated.
       This generalizes the reference's group2ctx attr to named-axis specs.
+    - `state_rules`: same shape, matched against decode slot-STATE buffer
+      names ((slots,) + per-slot shape coordinates) — how a KV cache's
+      feature axis shards over tp so continuous batching runs
+      tensor-parallel.  Unmatched state is replicated.
+
+    A plan is also expressible as a pure-JSON **spec** (no live mesh) —
+    ``spec()`` / ``from_spec()`` round-trip it — which is what the
+    serving tier persists into AOT-cache keys, what
+    ``MXNET_SERVE_SHARDING`` carries, and what
+    ``tools/graph_lint.py --sharding-plan`` audits offline::
+
+        {"axes": {"tp": 2},                  # mesh {axis: size}
+         "batch_axis": null,                 # mesh axis for data dim 0
+         "seq_axis": null,                   # mesh axis for data dim 1
+         "param_rules": [["fc.*weight$", [null, "tp"]]],
+         "state_rules": [["kv", [null, null, "tp"]]]}
     """
 
-    def __init__(self, mesh, batch_axis="dp", seq_axis=None, param_rules=None):
+    def __init__(self, mesh, batch_axis="dp", seq_axis=None, param_rules=None,
+                 state_rules=None):
         self.mesh = mesh
         self.batch_axis = batch_axis if batch_axis in mesh.axis_names else None
         self.seq_axis = seq_axis if (seq_axis and seq_axis in mesh.axis_names) \
             else None
         self.param_rules = [(re.compile(p), tuple(spec))
                             for p, spec in (param_rules or [])]
+        self.state_rules = [(re.compile(p), tuple(spec))
+                            for p, spec in (state_rules or [])]
+        # per-shape NamedSharding memo for the dispatch hot path:
+        # serving shapes come off a small fixed bucket grid, so this
+        # stays tiny, and put_data stops rebuilding a PartitionSpec +
+        # NamedSharding pair per input per dispatch (benign dict race
+        # under concurrent replica threads: same key, same value)
+        self._data_memo = {}
 
     # ------------------------------------------------------------------
     def _named(self, spec):
@@ -81,7 +109,13 @@ class ShardingPlan:
         return self._named(())
 
     def data_sharding(self, shape):
-        """Batch inputs: dim0 over dp (+ dim1 over sp when configured)."""
+        """Batch inputs: dim0 over dp (+ dim1 over sp when configured).
+        Memoized per shape — identical NamedSharding, no per-dispatch
+        construction."""
+        key = tuple(shape)
+        hit = self._data_memo.get(key)
+        if hit is not None:
+            return hit
         spec = [None] * len(shape)
         if len(shape) >= 1 and self.batch_axis:
             if shape[0] % self.mesh.shape[self.batch_axis] == 0:
@@ -91,10 +125,12 @@ class ShardingPlan:
                 spec[1] = self.seq_axis
         while spec and spec[-1] is None:
             spec.pop()
-        return self._named(tuple(spec))
+        out = self._named(tuple(spec))
+        self._data_memo[key] = out
+        return out
 
-    def param_sharding(self, name, shape):
-        for rx, spec in self.param_rules:
+    def _rule_sharding(self, rules, name, shape):
+        for rx, spec in rules:
             if rx.search(name):
                 spec = tuple(spec[:len(shape)])
                 # drop axes that don't divide evenly (falls back to replicate
@@ -109,9 +145,205 @@ class ShardingPlan:
                 return self._named(tuple(cleaned))
         return self.replicated()
 
+    def param_sharding(self, name, shape):
+        return self._rule_sharding(self.param_rules, name, shape)
+
+    def state_sharding(self, name, shape):
+        """Placement of one decode slot-state buffer ((slots,) + per-slot
+        shape): ``state_rules`` first hit wins, replicated otherwise."""
+        return self._rule_sharding(self.state_rules, name, shape)
+
     def place(self, jax_array, sharding):
         import jax
         return jax.device_put(jax_array, sharding)
+
+    def put_param(self, name, array):
+        """Upload one parameter honoring the plan: a single sharded
+        ``device_put`` straight from the source array — jax splits the
+        transfer per shard, so the full weight is never staged once per
+        device (the no-full-weight-host-staging contract)."""
+        import jax
+        return jax.device_put(array,
+                              self.param_sharding(name, array.shape))
+
+    def put_data(self, array):
+        """Commit one dispatch input (batch-leading host array) to the
+        plan's data sharding — computation then follows data under jit."""
+        import jax
+        return jax.device_put(array, self.data_sharding(array.shape))
+
+    def put_state(self, name, array):
+        import jax
+        return jax.device_put(array,
+                              self.state_sharding(name, array.shape))
+
+    def devices(self):
+        """The plan's device group, flat, in mesh order."""
+        return [d for d in self.mesh.devices.reshape(-1)]
+
+    # ------------------------------------------------------ spec round trip
+    def spec(self):
+        """The pure-JSON spec of this plan (mesh geometry + rules, no
+        device identities): what AOT-cache keys, ``stats()`` blocks and
+        the offline lint consume.  Canonical — two plans with the same
+        placement semantics serialize identically."""
+        return {
+            "axes": {a: int(self.mesh.shape[a])
+                     for a in self.mesh.axis_names},
+            "batch_axis": self.batch_axis,
+            "seq_axis": self.seq_axis,
+            "param_rules": [[rx.pattern, list(spec)]
+                            for rx, spec in self.param_rules],
+            "state_rules": [[rx.pattern, list(spec)]
+                            for rx, spec in self.state_rules],
+        }
+
+    def digest(self):
+        """Short content digest of the spec (telemetry labels)."""
+        return hashlib.sha256(
+            json.dumps(self.spec(), sort_keys=True,
+                       separators=(",", ":")).encode()).hexdigest()[:12]
+
+    def describe(self):
+        return dict(self.spec(),
+                    devices=[str(d) for d in self.devices()])
+
+    @classmethod
+    def from_spec(cls, spec, devices=None):
+        """Build a live plan from a spec dict (see class docstring) over
+        ``devices`` (default: every addressable device).  The spec's
+        axis sizes must multiply to exactly ``len(devices)`` — a plan is
+        an explicit placement decision, never silently clamped."""
+        spec = normalize_plan_spec(spec)
+        mesh = make_mesh(dict(spec["axes"]), devices)
+        return cls(mesh, batch_axis=spec["batch_axis"],
+                   seq_axis=spec["seq_axis"],
+                   param_rules=spec["param_rules"],
+                   state_rules=spec["state_rules"])
+
+
+def normalize_plan_spec(spec):
+    """Validate + canonicalize one ShardingPlan spec (dict or JSON
+    string).  Raises :class:`MXNetError` naming the offending field —
+    the serving engines and the offline lint share this one validator
+    so a spec they disagree about cannot exist."""
+    if isinstance(spec, ShardingPlan):
+        return spec.spec()
+    if isinstance(spec, (str, bytes)):
+        try:
+            spec = json.loads(spec)
+        except ValueError as e:
+            raise MXNetError("sharding spec is not valid JSON: %s" % e)
+    if not isinstance(spec, dict):
+        raise MXNetError("sharding spec must be a JSON object, got %r"
+                         % type(spec).__name__)
+    unknown = set(spec) - {"axes", "batch_axis", "seq_axis",
+                           "param_rules", "state_rules"}
+    if unknown:
+        raise MXNetError("sharding spec has unknown field(s) %s"
+                         % sorted(unknown))
+    axes = spec.get("axes")
+    if not isinstance(axes, dict) or not axes:
+        raise MXNetError("sharding spec needs a non-empty 'axes' "
+                         "object ({mesh_axis: size})")
+    out_axes = {}
+    for a, s in axes.items():
+        try:
+            ok = (float(s) == int(s))   # 2.5 must not truncate to 2
+            s = int(s)
+        except (TypeError, ValueError):
+            ok, s = False, 0
+        if not ok or s < 1:
+            raise MXNetError("sharding spec axis %r needs an explicit "
+                             "integer size >= 1 (got %r) — a serving "
+                             "plan is never inferred" % (a, axes[a]))
+        out_axes[str(a)] = s
+    out = {"axes": out_axes, "batch_axis": None, "seq_axis": None,
+           "param_rules": [], "state_rules": []}
+    for field in ("batch_axis", "seq_axis"):
+        v = spec.get(field)
+        if v is not None:
+            if v not in out_axes:
+                raise MXNetError("sharding spec %s=%r is not a mesh "
+                                 "axis (axes: %s)"
+                                 % (field, v, sorted(out_axes)))
+            out[field] = str(v)
+    for field in ("param_rules", "state_rules"):
+        rules = spec.get(field) or []
+        if not isinstance(rules, (list, tuple)):
+            raise MXNetError("sharding spec %s must be a list of "
+                             "[pattern, axis-spec] pairs" % field)
+        for rule in rules:
+            if not (isinstance(rule, (list, tuple)) and len(rule) == 2):
+                raise MXNetError("sharding spec %s entry %r is not a "
+                                 "[pattern, axis-spec] pair"
+                                 % (field, rule))
+            pat, axspec = rule
+            try:
+                re.compile(pat)
+            except re.error as e:
+                raise MXNetError("sharding spec %s pattern %r does not "
+                                 "compile: %s" % (field, pat, e))
+            if not isinstance(axspec, (list, tuple)):
+                raise MXNetError("sharding spec %s %r: axis spec must "
+                                 "be a list" % (field, pat))
+            for ax in axspec:
+                if ax is not None and ax not in out_axes:
+                    raise MXNetError(
+                        "sharding spec %s %r names mesh axis %r which "
+                        "is not in axes %s"
+                        % (field, pat, ax, sorted(out_axes)))
+            out[field].append([str(pat),
+                               [None if ax is None else str(ax)
+                                for ax in axspec]])
+    return out
+
+
+def load_plan_spec(source):
+    """Resolve a plan-spec *source* — a spec dict, a ShardingPlan, an
+    inline JSON string, or a path to a JSON file (how
+    ``MXNET_SERVE_SHARDING`` ships a fleet-wide plan) — into a
+    normalized spec dict."""
+    if isinstance(source, str) and not source.lstrip().startswith("{"):
+        try:
+            with open(source, "r") as f:
+                source = f.read()
+        except OSError as e:
+            raise MXNetError("cannot read sharding spec file %r: %s"
+                             % (source, e))
+    return normalize_plan_spec(source)
+
+
+def plan_group_size(spec):
+    """Devices one replica's plan spans: the product of its mesh axes."""
+    spec = normalize_plan_spec(spec)
+    n = 1
+    for s in spec["axes"].values():
+        n *= s
+    return n
+
+
+def replica_device_groups(replicas, group_size, devices=None):
+    """Partition the dp-ordered device list into ``replicas`` contiguous
+    groups of ``group_size`` — replica i's plan owns group i, so a
+    serving tier composes data-parallel (across groups) with
+    model-parallel (within a group) on the same slice layout a
+    ``{"dp": replicas, "tp": group_size}`` training mesh would use.
+    Asking for more devices than exist raises — a sharded fleet must
+    never silently serve fewer shards than its plan names."""
+    import jax
+    devices = list(devices if devices is not None else jax.devices())
+    need = int(replicas) * int(group_size)
+    if need > len(devices):
+        raise MXNetError(
+            "sharded serving needs %d device(s) (%d replica(s) x "
+            "%d-device plan) but only %d present "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=N "
+            "forces a CPU host to expose N)"
+            % (need, replicas, group_size, len(devices)))
+    ordered = data_parallel_devices(need, devices)
+    g = int(group_size)
+    return [ordered[i * g:(i + 1) * g] for i in range(int(replicas))]
 
 
 def data_parallel_plan(mesh=None, devices=None):

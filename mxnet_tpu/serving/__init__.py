@@ -44,7 +44,8 @@ from .admission import (AdmissionController, Request, QueueFullError,
 from .buckets import BucketPolicy, ProgramCache, pad_valid_lengths
 from .aot_cache import AOTCache
 from .faults import FaultPlan, FaultInjected
-from .replica import (ServeReplica, DecodeReplica, replica_contexts)
+from .replica import (ServeReplica, DecodeReplica, replica_contexts,
+                      resolve_replica_placements)
 from .engine import ServingEngine
 from .decode import (DecodeEngine, DecodeResult, StepProgram,
                      greedy_decode, Sampler, GreedySampler,
@@ -58,6 +59,7 @@ __all__ = ["ServingEngine", "BucketPolicy", "ProgramCache",
            "greedy_decode",
            "Sampler", "GreedySampler", "TemperatureSampler",
            "ServeReplica", "DecodeReplica", "replica_contexts",
+           "resolve_replica_placements",
            "FaultPlan", "FaultInjected", "Supervisor", "Regulator",
            "AdmissionController", "Request", "QueueFullError",
            "DeadlineExceededError", "ServerOverloadError",

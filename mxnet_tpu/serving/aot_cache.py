@@ -167,7 +167,13 @@ class AOTCache(object):
         self.enabled = True
         self.artifact = artifact or {}
         self.key_extra = key_extra or {}
-        self.sharding = str(sharding)
+        # "none" for single-device programs, the ShardingPlan spec dict
+        # for pjit-sharded ones (ROADMAP residual b2): it rides every
+        # entry KEY (canonical JSON) — two plans, or a plan and its
+        # unsharded twin, can never hit each other's entries — and the
+        # metadata verbatim, so `tools/aot_cache.py list` renders it
+        self.sharding = sharding if isinstance(sharding, dict) \
+            else str(sharding)
         self._fp = None                 # computed lazily (needs jax)
         self._lock = threading.Lock()
         self.hits = 0
@@ -207,8 +213,20 @@ class AOTCache(object):
                     sharding=sharding)
         if not cache.enabled:
             return None
-        if config.get("MXNET_AOT_XLA_CACHE"):
+        # MXNET_AOT_XLA_CACHE: 'auto' (default) turns jax's persistent
+        # compilation cache on ONLY when the serving entrypoint owns
+        # process bring-up — this engine is being constructed before
+        # any program traced, so flipping process-global jax config
+        # cannot surprise an application that compiled first (ROADMAP
+        # residual b1).  '1' forces it on (the reset_cache latch makes
+        # late enabling effective anyway); '0' is the explicit opt-out.
+        xla = str(config.get("MXNET_AOT_XLA_CACHE")).strip().lower()
+        if xla in ("1", "true", "yes", "on"):
             _enable_xla_cache(os.path.join(cache.dir, "xla"))
+        elif xla in ("auto", ""):
+            from ..executor import xla_traces_ever
+            if xla_traces_ever() == 0:
+                _enable_xla_cache(os.path.join(cache.dir, "xla"))
         return cache
 
     # ------------------------------------------------------------ metrics
@@ -473,12 +491,30 @@ def _enable_xla_cache(directory):
 
 def _avals(args):
     """Arguments -> ShapeDtypeStructs for export tracing (concrete
-    arrays pass through: jax.export takes either)."""
+    arrays pass through: jax.export takes either).
+
+    Mesh shardings propagate: an argument committed under a
+    ``NamedSharding`` (a ShardingPlan's param/state placement, or a
+    data aval the program cache built with the plan's spec) keeps it,
+    so the exported program records the pjit partitioning and a warm
+    load serves the identical partitioned StableHLO.  Single-device
+    commits deliberately do NOT propagate — an unsharded entry must
+    stay device-anonymous so any replica (or a restarted process on a
+    different device ordinal) can load it."""
     import jax
+    from jax.sharding import NamedSharding
     out = []
     for a in args:
         if a is None:
             raise ValueError("unresolved argument slot")
+        sharding = getattr(a, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            out.append(jax.ShapeDtypeStruct(
+                tuple(np.shape(a)),
+                np.dtype(getattr(a, "dtype", None)
+                         or np.asarray(a).dtype),
+                sharding=sharding))
+            continue
         out.append(jax.ShapeDtypeStruct(
             tuple(np.shape(a)),
             np.dtype(getattr(a, "dtype", None) or np.asarray(a).dtype)))

@@ -140,9 +140,19 @@ class ProgramCache(object):
     """
 
     def __init__(self, symbol, arg_params, aux_params, data_names,
-                 ctx=None, dtype=np.float32, aot=None, aot_kind="serve"):
+                 ctx=None, dtype=np.float32, aot=None, aot_kind="serve",
+                 plan=None):
         from ..context import cpu
         self._ctx = ctx or cpu()
+        # model-parallel serving (parallel/mesh.py ShardingPlan): with a
+        # plan, params upload as ONE sharded device_put each (jax splits
+        # the transfer per shard — the full weight is never staged once
+        # per device), dispatch inputs commit to the plan's data
+        # sharding, and every program compiles under the resulting
+        # pjit-style placement — computation follows data, XLA inserts
+        # the collectives.  plan=None is the single-device fast path,
+        # byte-for-byte the pre-sharding cache.
+        self._plan = plan
         # persistent AOT program cache (serving/aot_cache.py): when the
         # engine hands one in, every bucket program resolves through it
         # — a warm entry loads with ZERO traces, a cold one compiles
@@ -169,11 +179,18 @@ class ProgramCache(object):
         missing = [n for n in missing if n not in self._label_names]
         if missing:
             raise MXNetError("ProgramCache: params missing for %s" % missing)
-        self._params = {n: arg_params[n].as_in_context(self._ctx)
+        def _upload(src, n):
+            # device placement per parameter: single-device replicas
+            # ride the NDArray context path unchanged; a ShardingPlan
+            # commits each weight straight to its NamedSharding
+            if self._plan is not None:
+                return self._plan.put_param(n, src[n]._data)
+            return src[n].as_in_context(self._ctx)._data
+        self._params = {n: _upload(arg_params, n)
                         for n in arg_names
                         if n not in self.data_names
                         and n not in self._label_names}
-        self._aux = {n: (aux_params or {})[n].as_in_context(self._ctx)
+        self._aux = {n: _upload(aux_params or {}, n)
                      for n in aux_names}
         self._op = CachedOp(symbol)
         # flat-input template in the kernel's order (args then aux):
@@ -190,9 +207,9 @@ class ProgramCache(object):
         self._template = [None] * len(order)
         for i, n in enumerate(order):
             if n in self._params:
-                self._template[i] = self._params[n]._data
+                self._template[i] = self._params[n]
             elif n in self._aux:
-                self._template[i] = self._aux[n]._data
+                self._template[i] = self._aux[n]
         self._n_out = len(symbol._outputs)
         self._plans = {}         # full data-shape key -> prefilled flat
         self._keys = set()       # bucket signatures dispatched so far
@@ -239,7 +256,14 @@ class ProgramCache(object):
                         {k: s for k, (s, _d) in data_specs.items()},
                         self._label_names)
                     for n, pos in self._label_pos.items():
-                        flat[pos] = jnp.zeros(shapes[n], jnp.float32)
+                        z = jnp.zeros(shapes[n], jnp.float32)
+                        if self._plan is not None:
+                            # every committed input must live on the
+                            # plan's mesh — a default-device dummy
+                            # label would make the dispatch a cross-
+                            # device computation jit refuses
+                            z = self._plan.put_data(z)
+                        flat[pos] = z
                 # deterministic graphs can freeze the (dead) rng key
                 # into the plan; stochastic ones must fold a fresh
                 # key per dispatch or every batch on this bucket
@@ -269,7 +293,15 @@ class ProgramCache(object):
         args = [jax.random.PRNGKey(0)] + list(flat)
         for n, pos in self._data_pos.items():
             shape, dt = data_specs[n]
-            args[1 + pos] = jax.ShapeDtypeStruct(shape, np.dtype(dt))
+            if self._plan is not None:
+                # sharded avals: the exported program records the
+                # plan's placement, so a warm load serves the same
+                # partitioned StableHLO the cold compile did
+                args[1 + pos] = jax.ShapeDtypeStruct(
+                    shape, np.dtype(dt),
+                    sharding=self._plan.data_sharding(shape))
+            else:
+                args[1 + pos] = jax.ShapeDtypeStruct(shape, np.dtype(dt))
         kernel, _src = resolve_kernel(
             self._aot, jit_fn, self._aot_kind, self._graph_digest, args)
         return kernel
@@ -307,8 +339,15 @@ class ProgramCache(object):
         elif key is None:
             key = self._op._key()       # stochastic graph: fresh draws
         flat = list(template)
-        for n, pos in data_pos:
-            flat[pos] = feeds[n]        # jit commits host arrays itself
+        if self._plan is not None:
+            # commit each input to the plan's data sharding so the
+            # dispatch lands on the replica's device group (replicated
+            # by default; batch/seq axes shard when the plan says so)
+            for n, pos in data_pos:
+                flat[pos] = self._plan.put_data(feeds[n])
+        else:
+            for n, pos in data_pos:
+                flat[pos] = feeds[n]    # jit commits host arrays itself
         outs = kernel(key, *flat)
         return [np.asarray(o) for o in outs[:self._n_out]]
 

@@ -102,7 +102,7 @@ from .admission import (AdmissionController, Request, EngineClosedError,
 from .buckets import ProgramCache, _next_pow2
 from .engine import (_ENGINE_SEQ, _percentile, aot_metric_families,
                      _supervisor_state)
-from .replica import DecodeReplica, replica_contexts
+from .replica import DecodeReplica, resolve_replica_placements
 
 __all__ = ["DecodeEngine", "DecodeResult", "StepProgram", "greedy_decode",
            "Sampler", "GreedySampler", "TemperatureSampler"]
@@ -223,14 +223,21 @@ class DecodeRequest(Request):
     scheduler mutates as the request moves queue -> slot -> done."""
     __slots__ = ("prompt", "max_new", "tokens", "prompt_i", "slot",
                  "t_join", "n_steps", "t_first_tok", "t_last_tok",
-                 "on_token")
+                 "on_token", "sse_id")
 
     def __init__(self, prompt, max_new, future, deadline=None,
-                 trace=None, on_token=None):
+                 trace=None, on_token=None, sse_id=None):
         super().__init__({}, ("__decode__",), future, deadline=deadline,
                          trace=trace)
         self.prompt = list(prompt)
         self.max_new = int(max_new)
+        # per-request SSE stream key (ROADMAP item 4 residual): with a
+        # client-supplied request id, every generated token is ALSO
+        # published to the /events EventHub as a `decode.token` event
+        # keyed by it — the hub's bounded replay ring gives
+        # Last-Event-ID resume for free.  None = no HTTP surface, the
+        # pre-SSE engine byte-for-byte.
+        self.sse_id = sse_id
         # per-token streaming hook (ROADMAP 4a): called from the slot
         # loop with each generated token id, in generation order — the
         # exact greedy_decode prefix.  A raising callback evicts ONLY
@@ -268,13 +275,21 @@ class StepProgram(object):
     def __init__(self, step_sym, arg_params, aux_params, state_info,
                  num_slots, token_name="token", pos_name="pos",
                  valid_name="valid", ctx=None, dtype=np.float32,
-                 sampler=None, aot=None):
+                 sampler=None, aot=None, plan=None):
         import jax
         import jax.numpy as jnp
         from ..context import cpu
         from ..executor import build_graph_fn, _count_xla_trace
         from .. import symbol as sym
         self._ctx = ctx or cpu()
+        # model-parallel decode (parallel/mesh.py ShardingPlan): params
+        # upload as one sharded device_put each, per-slot state buffers
+        # lay out under the plan's state_rules (a KV cache's feature
+        # axis shards over tp), and the persistent step compiles under
+        # the resulting placement — continuous batching runs tensor-
+        # parallel across the replica's device group.  None = the
+        # single-device program byte-for-byte.
+        self._plan = plan
         self._aot = aot if (aot is not None and aot.enabled) else None
         self.num_slots = int(num_slots)
         self._dtype = np.dtype(dtype)
@@ -324,7 +339,10 @@ class StepProgram(object):
             if n in feeds:
                 continue
             src = arg_params if n in (arg_params or {}) else aux_params
-            self._template[i] = src[n].as_in_context(self._ctx)._data
+            if self._plan is not None:
+                self._template[i] = self._plan.put_param(n, src[n]._data)
+            else:
+                self._template[i] = src[n].as_in_context(self._ctx)._data
         self._feed_pos = {n: order.index(n) for n in feeds}
         gf = build_graph_fn(self._serve_sym, arg_names, aux_names)
         if gf.stochastic:
@@ -428,13 +446,22 @@ class StepProgram(object):
         would land on the default device and make the step a cross-
         device computation)."""
         import jax
-        dev = self._ctx.jax_device()
+        dev = None if self._plan is not None else self._ctx.jax_device()
         out = {}
         for info in self.state_info:
             dt = np.dtype(info.get("dtype") or self._dtype)
-            out[info["name"]] = jax.device_put(
-                self._jnp.zeros((self.num_slots,) + tuple(info["shape"]),
-                                dtype=dt), dev)
+            shape = (self.num_slots,) + tuple(info["shape"])
+            if self._plan is not None:
+                # sharded slot-pool layout: the plan's state_rules
+                # decide which per-slot axes partition over the group.
+                # Built from HOST zeros — a pool sized to fit only
+                # when sharded must never be staged whole on one
+                # device (device_put ships each shard's slice)
+                out[info["name"]] = self._plan.put_state(
+                    info["name"], np.zeros(shape, dtype=dt))
+            else:
+                out[info["name"]] = jax.device_put(
+                    self._jnp.zeros(shape, dtype=dt), dev)
         return out
 
     def _row_kernel(self, buf, idx, row):
@@ -445,16 +472,24 @@ class StepProgram(object):
         across engines and model architectures."""
         if self._aot is None:
             return self._set_row_jit
+        # the sharded layout is part of the program identity: two state
+        # buffers of one shape whose state_rules place them differently
+        # must neither share a memoized kernel nor hit each other's
+        # universal entries (the flat signature carries shapes/dtypes
+        # only, so the placement rides the graph tag)
+        shard = ("" if self._plan is None
+                 else "|%s" % (getattr(getattr(buf, "sharding", None),
+                                       "spec", None),))
         sig = (tuple(buf.shape), str(np.dtype(buf.dtype)),
                tuple(np.shape(row)),
                str(np.dtype(getattr(row, "dtype", None)
-                            or np.asarray(row).dtype)))
+                            or np.asarray(row).dtype)), shard)
         kernel = self._row_kernels.get(sig)
         if kernel is None:
             from .aot_cache import resolve_kernel
             kernel, _src = resolve_kernel(
                 self._aot, self._set_row_jit, "decode_set_row",
-                "jnp_at_set_v1", [buf, idx, row], universal=True)
+                "jnp_at_set_v1" + shard, [buf, idx, row], universal=True)
             self._row_kernels[sig] = kernel
         return kernel
 
@@ -722,7 +757,8 @@ class _DecodeTelemetry(object):
         # so /healthz renders one per-replica block over both kinds
         from .replica import replica_metric_families
         (replicas_fam, self.replica_healthy, self.replica_inflight,
-         self.replica_failures) = replica_metric_families(reg)
+         self.replica_failures,
+         self.replica_shards) = replica_metric_families(reg)
         self.replicas_g = replicas_fam.labels(engine=self.engine_label)
         self.replicas_g.set(len(engine._replicas))
         for r in engine._replicas:
@@ -730,6 +766,10 @@ class _DecodeTelemetry(object):
                 engine=self.engine_label, replica=r.label)
             r.tm_failures = self.replica_failures.labels(
                 engine=self.engine_label, replica=r.label)
+            # per-shard identity under the replica label (static)
+            self.replica_shards.labels(
+                engine=self.engine_label, replica=r.label).set(
+                len(r.plan.devices()) if r.plan is not None else 1)
         # persistent-AOT-cache traffic: same families the one-shot
         # bundle registers (engine ordinals are process-unique, so the
         # shared families aggregate into one fleet view)
@@ -739,7 +779,8 @@ class _DecodeTelemetry(object):
         self._replica_fams = (self.slots_fam, self.occupied_fam,
                               self.step_ms, self.replica_healthy,
                               self.replica_inflight,
-                              self.replica_failures) + self.aot_fams
+                              self.replica_failures,
+                              self.replica_shards) + self.aot_fams
         self._engine = weakref.ref(engine)
         reg.register_callback(self._refresh)
 
@@ -816,6 +857,14 @@ class DecodeEngine(object):
         ``MXNET_SERVE_REPLICAS``), each a full slot pool; requests land
         on the freest replica and pin there.  ``ctx`` may be a LIST of
         contexts naming the replica set verbatim.
+    sharding : model-parallel plan spec (``parallel/mesh.py``; default
+        ``MXNET_SERVE_SHARDING``).  Each replica's step program,
+        prefill buckets, and per-slot state then span a
+        ``prod(axes)``-device group — state_rules lay the KV cache out
+        sharded, so continuous batching runs tensor-parallel.  A plan
+        partitioning the SLOT axis is verdict-gated on the step
+        graph's row-locality (``analysis.check_sharding_plan``);
+        rejected plans refuse construction with a reason.
     """
 
     def __init__(self, step_sym, arg_params, aux_params, state_info,
@@ -825,7 +874,7 @@ class DecodeEngine(object):
                  prefill_len_name="plen",
                  max_queue=None, default_deadline_ms=None,
                  overload_policy=None, ctx=None, dtype=np.float32,
-                 start=True, sampler=None, replicas=None):
+                 start=True, sampler=None, replicas=None, sharding=None):
         from .. import config
         # chaos plan (serving/faults.py): see ServingEngine
         _faults.ensure_env_plan()
@@ -867,6 +916,16 @@ class DecodeEngine(object):
             step_sym = self._optimize_step(step_sym, state_info,
                                            token_name, pos_name,
                                            valid_name)
+        # model-parallel decode (ROADMAP item 1): the plan spec is
+        # verdict-gated on the step graph's slot-axis row-locality —
+        # a plan partitioning the slot axis of a cross-position (or
+        # unanalyzed) step is rejected with a reason at construction,
+        # exactly like every rewrite.  Param/state tensor-parallel
+        # rules are placement-only and never gated.
+        from ..analysis.sharding import gate_plan_spec
+        self.sharding_check, self._sharding_spec = gate_plan_spec(
+            sharding, {"slot": self.step_verdict}, "decode",
+            "DecodeEngine")
         self._prefill_data_name = prefill_data_name
         self._prefill_len_name = prefill_len_name
         # coalesced bucketed prefill (ROADMAP 4b): joiners landing in
@@ -943,7 +1002,11 @@ class DecodeEngine(object):
                           "nodes_after": (self.opt_plan.nodes_after
                                           if self.opt_plan is not None
                                           else None)}},
-            key_extra={"engine_kind": "decode", "sampler": sampler_fp})
+            key_extra={"engine_kind": "decode", "sampler": sampler_fp},
+            # plan spec = the key's sharding component (residual b2):
+            # sharded and unsharded step programs (or two plans) can
+            # never hit each other's entries; same-plan replicas share
+            sharding=self._sharding_spec or "none")
         # everything _new_replica needs, kept for probation re-warm
         # (rehabilitate): the param handles are the same NDArrays the
         # program caches already hold device copies of — no extra
@@ -956,8 +1019,10 @@ class DecodeEngine(object):
                       "prefill_sym": prefill_sym,
                       "prefill_buckets": prefill_buckets}
         self._replicas = []
-        for i, rctx in enumerate(replica_contexts(replicas, ctx)):
-            self._replicas.append(self._new_replica(i, rctx))
+        placements = resolve_replica_placements(replicas, ctx,
+                                                self._sharding_spec)
+        for i, (rctx, rplan) in enumerate(placements):
+            self._replicas.append(self._new_replica(i, rctx, rplan))
         self._multi = len(self._replicas) > 1
         self._dr_lock = threading.Lock()
         self._dr_cond = threading.Condition(self._dr_lock)
@@ -1051,13 +1116,14 @@ class DecodeEngine(object):
     def _prefill_buckets(self, value):
         self._replicas[0].prefill_buckets = tuple(value)
 
-    def _new_replica(self, index, rctx):
+    def _new_replica(self, index, rctx, plan=None):
         """Build one fully-formed DecodeReplica (step program + prefill
-        caches, params uploaded to its device) from the construction
-        state — used at engine construction AND by ``rehabilitate()``,
-        which must rebuild a retired replica's programs from scratch
-        (its donated state buffers may be consumed) but draws every
-        compile from the AOT cache when one is configured."""
+        caches, params uploaded to its device — or sharded across its
+        plan's device group) from the construction state — used at
+        engine construction AND by ``rehabilitate()``, which must
+        rebuild a retired replica's programs from scratch (its donated
+        state buffers may be consumed) but draws every compile from
+        the AOT cache when one is configured."""
         from ..symbol import Symbol as _Symbol
         c = self._ctor
         prog = StepProgram(c["step_sym"], c["arg_params"],
@@ -1067,8 +1133,9 @@ class DecodeEngine(object):
                            pos_name=c["pos_name"],
                            valid_name=c["valid_name"],
                            ctx=rctx, dtype=c["dtype"],
-                           sampler=self._sampler, aot=self._aot)
-        rep = DecodeReplica(index, rctx, prog)
+                           sampler=self._sampler, aot=self._aot,
+                           plan=plan)
+        rep = DecodeReplica(index, rctx, prog, plan=plan)
         prefill_sym = c["prefill_sym"]
         if prefill_sym is not None:
             rep.prefill_buckets = c["prefill_buckets"]
@@ -1079,17 +1146,17 @@ class DecodeEngine(object):
                 for b in rep.prefill_buckets:
                     rep.prefill_caches[b] = self._build_prefill(
                         prefill_sym(b), c["arg_params"],
-                        c["aux_params"], rctx, c["dtype"], prog)
+                        c["aux_params"], rctx, c["dtype"], prog, plan)
             else:
                 shared = self._build_prefill(
                     prefill_sym, c["arg_params"], c["aux_params"],
-                    rctx, c["dtype"], prog)
+                    rctx, c["dtype"], prog, plan)
                 for b in rep.prefill_buckets:
                     rep.prefill_caches[b] = shared
         return rep
 
     def _build_prefill(self, psym, arg_params, aux_params, ctx, dtype,
-                       program):
+                       program, plan=None):
         """Wrap one prefill graph with the sampling head and compile-
         once plumbing: outputs become [first sampled token id] + state
         rows under the greedy head, or [last-position logits] + state
@@ -1110,7 +1177,8 @@ class DecodeEngine(object):
         return ProgramCache(
             wrapped, arg_params, aux_params,
             data_names=[self._prefill_data_name, self._prefill_len_name],
-            ctx=ctx, dtype=dtype, aot=self._aot, aot_kind="prefill")
+            ctx=ctx, dtype=dtype, aot=self._aot, aot_kind="prefill",
+            plan=plan)
 
     # ---------------------------------------------------------- preflight
     def _preflight(self, step_sym, state_info, token_name, pos_name,
@@ -1296,7 +1364,7 @@ class DecodeEngine(object):
 
     # ------------------------------------------------------------- client
     def submit(self, prompt, max_new_tokens=None, deadline_ms=None,
-               on_token=None):
+               on_token=None, request_id=None):
         """Enqueue one generation request; returns a Future resolving
         to a :class:`DecodeResult`.
 
@@ -1311,7 +1379,17 @@ class DecodeEngine(object):
         scheduler thread, so it must be cheap and thread-safe.  A
         raising callback evicts only its own request: the future fails
         with the callback's exception and co-resident requests keep
-        generating."""
+        generating.
+
+        ``request_id`` additionally publishes the stream over HTTP:
+        each generated token becomes a ``decode.token`` event on the
+        ``GET /events`` SSE endpoint (``{"request_id", "index",
+        "token"}``, with a final ``{"request_id", "done": true,
+        "finish_reason"}`` frame), so any SSE client can follow one
+        request's generation by filtering on its id — and resume after
+        a disconnect via the standard ``Last-Event-ID`` replay the
+        EventHub already implements.  Requires telemetry; None (the
+        default) publishes nothing."""
         if self._adm.closed:
             raise EngineClosedError("decode engine is closed")
         prompt = [int(t) for t in prompt]
@@ -1342,7 +1420,15 @@ class DecodeEngine(object):
                                              name="decode.request")
         req = DecodeRequest(prompt, max_new_tokens, fut,
                             deadline=deadline, trace=trace,
-                            on_token=on_token)
+                            on_token=on_token,
+                            sse_id=(str(request_id)
+                                    if request_id is not None
+                                    and self._tm is not None else None))
+        if req.sse_id is not None:
+            # terminal stream frame on ANY outcome — the future is the
+            # one place every finish/failure/cancel path converges
+            fut.add_done_callback(
+                lambda f, _req=req: self._emit_done(_req, f))
         # padded-element cost for the regulator's cost-aware shed: a
         # decode request prices as its bucketed prompt plus the
         # positions its generation budget can occupy
@@ -1731,7 +1817,7 @@ class DecodeEngine(object):
                              "build a new engine")
             return out
         try:
-            fresh = self._new_replica(rep.index, rep.ctx)
+            fresh = self._new_replica(rep.index, rep.ctx, rep.plan)
             # probation warmup: exactly engine.warmup's per-replica
             # sequence (step twice for committed-sharding parity,
             # row-write kernels, prefill buckets) — with an AOT cache
@@ -1936,8 +2022,52 @@ class DecodeEngine(object):
         if self._tm is not None:
             self._tm.tokens.inc()
             self._tm.ttft.observe(now - req.t_enqueue)
+        self._emit_token(req, first)
         if req.on_token is not None:
             self._fire_on_token(rep, req, int(first))
+
+    def _emit_token(self, req, tok):
+        """Publish one generated token onto the /events EventHub as a
+        ``decode.token`` event keyed by the request's client-supplied
+        id — the SSE half of per-token streaming (ROADMAP 4a residual).
+        Requests without a ``request_id`` pay a single attribute check."""
+        if req.sse_id is None:
+            return
+        try:
+            _telemetry.server.publish_event(
+                "decode.token",
+                {"request_id": req.sse_id,
+                 "engine": (self._tm.engine_label
+                            if self._tm is not None else None),
+                 "index": len(req.tokens) - 1, "token": int(tok)})
+        except Exception:
+            pass    # the stream is observability: never fail a request
+
+    def _emit_done(self, req, fut):
+        """Terminal SSE frame, fired from the request future's done
+        callback — hooking the future (not the individual finish
+        paths) means EVERY terminal outcome publishes exactly one
+        ``{"done": true}`` frame: normal finishes, deadline partials,
+        replica failures, a raising on_token callback, engine close,
+        and client-side cancellation alike.  An SSE consumer can
+        therefore treat stream silence as in-flight, never as an
+        ambiguous death."""
+        if fut.cancelled():
+            reason = "cancelled"
+        elif fut.exception() is not None:
+            reason = "error"
+        else:
+            reason = getattr(fut.result(), "finish_reason", "eos")
+        try:
+            _telemetry.server.publish_event(
+                "decode.token",
+                {"request_id": req.sse_id,
+                 "engine": (self._tm.engine_label
+                            if self._tm is not None else None),
+                 "done": True, "finish_reason": reason,
+                 "tokens": len(req.tokens)})
+        except Exception:
+            pass
 
     def _fire_on_token(self, rep, req, tok):
         """Streaming hook: a raising callback evicts ONLY its own
@@ -2004,6 +2134,7 @@ class DecodeEngine(object):
                     if self._tm is not None:
                         self._tm.ttft.observe(t_tok - req.t_enqueue)
                 req.t_last_tok = t_tok
+                self._emit_token(req, tok)
                 if req.on_token is not None \
                         and not self._fire_on_token(rep, req, tok):
                     continue        # evicted by its own callback
@@ -2165,6 +2296,7 @@ class DecodeEngine(object):
                 "requests_served": self._requests_served,
                 "compile_count": self.compile_count,
                 "sampler": self._sampler.describe(),
+                "sharding": self._sharding_spec,
                 "aot": (self._aot.stats() if self._aot is not None
                         else {"enabled": False}),
                 "replicas": [r.describe() for r in self._replicas],

@@ -73,7 +73,7 @@ from . import faults as _faults
 from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
 from .buckets import BucketPolicy, ProgramCache, pad_valid_lengths
-from .replica import ServeReplica, replica_contexts
+from .replica import ServeReplica, resolve_replica_placements
 
 __all__ = ["ServingEngine"]
 
@@ -301,7 +301,8 @@ class _EngineTelemetry(object):
         # ordinals, so both kinds aggregate into one fleet view)
         from .replica import replica_metric_families
         (replicas_fam, self.replica_healthy, self.replica_inflight,
-         self.replica_failures) = replica_metric_families(reg)
+         self.replica_failures,
+         self.replica_shards) = replica_metric_families(reg)
         self.replicas_g = replicas_fam.labels(engine=self.engine_label)
         self.replica_batches = reg.counter(
             "mxnet_serve_replica_batches_total",
@@ -318,9 +319,18 @@ class _EngineTelemetry(object):
                                    entropy_fam, replicas_fam)
         self._replica_fams = (self.replica_healthy, self.replica_inflight,
                               self.replica_failures, self.replica_batches,
+                              self.replica_shards,
                               self.dispatch_ms, self.occupancy,
                               self.retraces)
         self.replicas_g.set(len(engine._replicas))
+        # per-shard identity under the existing replica label: shard
+        # count is construction-static, so set once here (1 for a
+        # single-device replica; the devices themselves are on
+        # describe()/healthz)
+        for r in engine._replicas:
+            self.replica_shards.labels(
+                engine=self.engine_label, replica=r.label).set(
+                len(r.plan.devices()) if r.plan is not None else 1)
         # bind per-replica children once — the dispatch hot path never
         # pays a labels() registry probe — and pre-touch the retrace
         # series under this graph's hazard label so a healthy replica
@@ -414,13 +424,25 @@ class ServingEngine(object):
         contexts, which is then the replica set verbatim (two replicas
         on one device is legal and how tests exercise routing without
         forcing a host device count).
+    sharding : model-parallel plan spec (``parallel/mesh.py``
+        ShardingPlan spec dict / JSON; default
+        ``MXNET_SERVE_SHARDING``).  Each replica then owns a
+        ``prod(axes)``-device GROUP in dp order and compiles every
+        bucket program under the plan — pjit-style partitioning with
+        params uploaded as sharded ``device_put``.  Data-parallel x
+        model-parallel composition: ``replicas=N`` with a G-device
+        plan serves N sharded replicas through the same
+        router/failover machinery.  A plan that partitions a padded
+        data axis is VERDICT-GATED like every rewrite
+        (``analysis.check_sharding_plan``): cross-position or unproven
+        axes reject at construction with a reason.
     """
 
     def __init__(self, symbol, arg_params, aux_params, data_shapes,
                  ctx=None, policy=None, max_queue=None,
                  batch_timeout_ms=None, default_deadline_ms=None,
                  overload_policy=None, dtype=np.float32, start=True,
-                 replicas=None):
+                 replicas=None, sharding=None):
         from .. import config
         # chaos plan (serving/faults.py): installs MXNET_FAULT_PLAN if
         # one is named; with none the injection sites stay a single
@@ -483,6 +505,17 @@ class ServingEngine(object):
         data_names = list(self._data_shapes)
         if self._valid_name is not None:
             data_names.append(self._valid_name)
+        # model-parallel serving (ROADMAP item 1): resolve the sharding
+        # plan spec and gate it on the preflight's padded-axis verdicts
+        # exactly like every rewrite — a plan that partitions a padded
+        # axis the analysis cannot prove row-local is REJECTED with a
+        # reason at construction (there is no degrade path for a wrong
+        # placement).  With analysis off the gate fails closed for
+        # data-axis partitions; placement-only plans (param rules) are
+        # never gated.
+        from ..analysis.sharding import gate_plan_spec
+        self.sharding_check, self._sharding_spec = gate_plan_spec(
+            sharding, self._verdicts, "serve", "ServingEngine")
         # persistent AOT program cache (serving/aot_cache.py,
         # MXNET_AOT_CACHE_DIR): shared by every replica's ProgramCache
         # — a restarted engine loads every previously-served bucket
@@ -514,18 +547,27 @@ class ServingEngine(object):
             key_extra={"engine_kind": "serve",
                        "max_batch": self._policy.max_batch,
                        "seq_axis": self._policy.seq_axis,
-                       "seq_buckets": list(self._policy.seq_buckets)})
+                       "seq_buckets": list(self._policy.seq_buckets)},
+            # the plan spec IS the key's sharding component (ROADMAP
+            # residual b2): a sharded program and its unsharded twin —
+            # or two different plans — can never hit each other's
+            # entries, while N same-plan replicas share one entry
+            # (device identities are not in the spec)
+            sharding=self._sharding_spec or "none")
         # construction state rehabilitate() rebuilds retired replicas
         # from (the param handles are the same NDArrays the program
         # caches already hold device copies of)
         self._ctor = {"arg_params": arg_params, "aux_params": aux_params,
                       "data_names": data_names}
         self._replicas = []
-        for i, rctx in enumerate(replica_contexts(replicas, ctx)):
+        placements = resolve_replica_placements(replicas, ctx,
+                                                self._sharding_spec)
+        for i, (rctx, rplan) in enumerate(placements):
             cache = ProgramCache(self._serve_sym, arg_params, aux_params,
                                  data_names, ctx=rctx, dtype=dtype,
-                                 aot=self._aot)
-            self._replicas.append(ServeReplica(i, rctx, cache))
+                                 aot=self._aot, plan=rplan)
+            self._replicas.append(ServeReplica(i, rctx, cache,
+                                               plan=rplan))
         self._cache = self._replicas[0].cache   # single-replica alias
         self._multi = len(self._replicas) > 1
         self._route_lock = threading.Lock()
@@ -1366,7 +1408,7 @@ class ServingEngine(object):
             cache = ProgramCache(self._serve_sym, c["arg_params"],
                                  c["aux_params"], c["data_names"],
                                  ctx=r.ctx, dtype=self._dtype,
-                                 aot=self._aot)
+                                 aot=self._aot, plan=r.plan)
             probe_key = None
             for key in sorted(keys):
                 feeds = {name: np.zeros(shape,
@@ -1754,6 +1796,7 @@ class ServingEngine(object):
                                   for r in self._replicas)},
                 "bucket_keys": len(self._cache.bucket_keys),
                 "max_batch": self._policy.max_batch,
+                "sharding": self._sharding_spec,
                 "replicas": [r.describe() for r in self._replicas],
                 "aot": (self._aot.stats() if self._aot is not None
                         else {"enabled": False}),

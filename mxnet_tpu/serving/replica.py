@@ -57,17 +57,17 @@ import time
 
 from ..base import MXNetError
 
-__all__ = ["replica_contexts", "ServeReplica", "DecodeReplica",
-           "replica_metric_families"]
+__all__ = ["replica_contexts", "resolve_replica_placements",
+           "ServeReplica", "DecodeReplica", "replica_metric_families"]
 
 
 def replica_metric_families(reg):
     """Register (idempotently) the replica-plane metric families BOTH
     engine kinds share — one definition, so the help text and label
     sets cannot drift between the serving and decode bundles.  Returns
-    ``(replicas, healthy, inflight, failures)`` families; engine
-    ordinals are process-unique, so the shared families aggregate into
-    one fleet view per scrape."""
+    ``(replicas, healthy, inflight, failures, shards)`` families;
+    engine ordinals are process-unique, so the shared families
+    aggregate into one fleet view per scrape."""
     replicas = reg.gauge(
         "mxnet_serve_replicas",
         "configured device replicas per engine",
@@ -88,7 +88,15 @@ def replica_metric_families(reg):
         "dispatch failures that drained a device replica and "
         "marked it unhealthy (the flight recorder dumps on each)",
         labelnames=("engine", "replica"))
-    return replicas, healthy, inflight, failures
+    shards = reg.gauge(
+        "mxnet_serve_replica_shards",
+        "mesh devices one replica's programs span (1 = single-device; "
+        ">1 = a pjit ShardingPlan partitions the replica's params/"
+        "state across its device group) — the per-shard identity "
+        "rides the existing replica label, so a straggling shard "
+        "shows up as its replica's dispatch tail",
+        labelnames=("engine", "replica"))
+    return replicas, healthy, inflight, failures, shards
 
 
 def _context_for_device(dev):
@@ -168,6 +176,42 @@ def replica_contexts(replicas=None, ctx=None):
     return [_context_for_device(d) for d in devs]
 
 
+def resolve_replica_placements(replicas, ctx, sharding):
+    """Resolve an engine's ``(replicas, ctx, sharding)`` arguments into
+    per-replica ``(Context, ShardingPlan-or-None)`` placements.
+
+    With ``sharding=None`` this is exactly :func:`replica_contexts` —
+    single-device replicas, the pre-sharding engines byte-for-byte.
+    With a plan spec (dict / JSON / :class:`ShardingPlan`), each
+    replica owns a contiguous GROUP of ``prod(axes)`` devices in the
+    dp order (``parallel.mesh.replica_device_groups``), and its plan
+    is the spec instantiated over that group: N replicas x G-device
+    plans composes data-parallel with model-parallel on the same
+    router/failover machinery.  Sharded placement is always explicit:
+    too few devices raises (never a silent clamp), and a ``ctx``
+    argument is refused — the plan owns device placement."""
+    if sharding is None:
+        return [(c, None) for c in replica_contexts(replicas, ctx)]
+    from ..parallel.mesh import (ShardingPlan, normalize_plan_spec,
+                                 plan_group_size, replica_device_groups)
+    if ctx is not None:
+        raise MXNetError(
+            "ctx and a sharding plan are mutually exclusive: the plan "
+            "owns device placement (pass replicas=N; replica i takes "
+            "the i-th device group in dp order)")
+    from .. import config
+    if replicas is None:
+        replicas = config.get("MXNET_SERVE_REPLICAS")
+    replicas = int(replicas)
+    if replicas < 1:
+        raise MXNetError("replicas must be >= 1, got %d" % replicas)
+    spec = normalize_plan_spec(sharding)
+    groups = replica_device_groups(replicas, plan_group_size(spec))
+    return [(_context_for_device(grp[0]),
+             ShardingPlan.from_spec(spec, devices=grp))
+            for grp in groups]
+
+
 class ServeReplica(object):
     """One one-shot-engine device replica: its own
     :class:`~mxnet_tpu.serving.buckets.ProgramCache` (params
@@ -180,17 +224,20 @@ class ServeReplica(object):
     dispatching on this replica (the engine worker itself on the
     single-replica fast path).
     """
-    __slots__ = ("index", "label", "ctx", "cache", "healthy",
+    __slots__ = ("index", "label", "ctx", "plan", "cache", "healthy",
                  "accepting", "pending",
                  "in_dispatch", "dispatched_keys", "batches", "failures",
                  "probations", "hb_t", "thread", "tm_dispatch",
                  "tm_occupancy", "tm_retraces", "tm_batches",
                  "tm_failures")
 
-    def __init__(self, index, ctx, cache):
+    def __init__(self, index, ctx, cache, plan=None):
         self.index = index
         self.label = str(index)
         self.ctx = ctx
+        # ShardingPlan when this replica's programs span a device
+        # GROUP (model-parallel serving); None = single-device replica
+        self.plan = plan
         self.cache = cache
         self.healthy = True
         # times this replica re-entered service through the probation
@@ -224,14 +271,26 @@ class ServeReplica(object):
         return len(self.pending) + (1 if self.in_dispatch else 0)
 
     def describe(self):
-        return {"replica": self.label,
-                "ctx": str(self.ctx) if self.ctx is not None else "cpu(0)",
-                "healthy": self.healthy,
-                "inflight": self.inflight(),
-                "batches": self.batches,
-                "failures": self.failures,
-                "probations": self.probations,
-                "compile_count": self.cache.compile_count}
+        out = {"replica": self.label,
+               "ctx": str(self.ctx) if self.ctx is not None else "cpu(0)",
+               "healthy": self.healthy,
+               "inflight": self.inflight(),
+               "batches": self.batches,
+               "failures": self.failures,
+               "probations": self.probations,
+               "compile_count": self.cache.compile_count}
+        out.update(_shard_identity(self.plan))
+        return out
+
+
+def _shard_identity(plan):
+    """The per-shard identity block a sharded replica's describe()/
+    healthz rows carry under the existing replica label."""
+    if plan is None:
+        return {"shards": 1}
+    return {"shards": len(plan.devices()),
+            "shard_devices": [str(d) for d in plan.devices()],
+            "sharding": plan.digest()}
 
 
 class DecodeReplica(object):
@@ -243,17 +302,19 @@ class DecodeReplica(object):
     single-replica fast path); ``pending``/``healthy`` are guarded by
     the engine's router lock.
     """
-    __slots__ = ("index", "label", "ctx", "program", "prefill_caches",
+    __slots__ = ("index", "label", "ctx", "plan", "program",
+                 "prefill_caches",
                  "prefill_buckets", "slots", "tokens_np", "pos_np",
                  "valid_np", "reset_np", "states", "pending", "healthy",
                  "accepting", "in_step", "probations", "hb_t", "thread",
                  "tm_step_ms", "tm_failures")
 
-    def __init__(self, index, ctx, program):
+    def __init__(self, index, ctx, program, plan=None):
         import numpy as np
         self.index = index
         self.label = str(index)
         self.ctx = ctx
+        self.plan = plan
         self.program = program
         # probation re-entries (DecodeEngine.rehabilitate)
         self.probations = 0
@@ -295,11 +356,13 @@ class DecodeReplica(object):
         return self.occupied_count() + len(self.pending)
 
     def describe(self):
-        return {"replica": self.label,
-                "ctx": str(self.ctx) if self.ctx is not None else "cpu(0)",
-                "healthy": self.healthy,
-                "slots": self.program.num_slots,
-                "slots_occupied": self.occupied_count(),
-                "pending": len(self.pending),
-                "probations": self.probations,
-                "compile_count": self.program.trace_count}
+        out = {"replica": self.label,
+               "ctx": str(self.ctx) if self.ctx is not None else "cpu(0)",
+               "healthy": self.healthy,
+               "slots": self.program.num_slots,
+               "slots_occupied": self.occupied_count(),
+               "pending": len(self.pending),
+               "probations": self.probations,
+               "compile_count": self.program.trace_count}
+        out.update(_shard_identity(self.plan))
+        return out

@@ -485,6 +485,7 @@ def _healthz(server):
         failures = _by_replica("mxnet_serve_replica_failures_total")
         batches = _by_replica("mxnet_serve_replica_batches_total")
         occupied = _by_replica("mxnet_serve_decode_slots_occupied")
+        shards = _by_replica("mxnet_serve_replica_shards")
         blocks, unhealthy = {}, 0
         for s in rep_health:
             lab = s.get("labels") or {}
@@ -499,6 +500,10 @@ def _healthz(server):
                 row["batches"] = batches[(eng, rep)]
             if (eng, rep) in occupied:
                 row["slots_occupied"] = occupied[(eng, rep)]
+            if (eng, rep) in shards:
+                # per-shard identity under the replica label: >1 =
+                # this replica's programs span a pjit device group
+                row["shards"] = int(shards[(eng, rep)] or 1)
             blocks.setdefault(eng, []).append(row)
         for rows in blocks.values():
             rows.sort(key=lambda r: str(r["replica"]))

@@ -74,6 +74,28 @@ def _size(bin_path):
         return -1
 
 
+def _fmt_sharding(meta):
+    """Compact render of the entry key's sharding component: 'none'
+    for single-device entries, the mesh axes (+ which rule families
+    partition) for pjit-sharded ones."""
+    sh = (meta or {}).get("sharding", "none")
+    if not isinstance(sh, dict):
+        return str(sh or "none")
+    axes = ",".join("%s=%s" % (a, s)
+                    for a, s in sorted((sh.get("axes") or {}).items()))
+    bits = [axes or "?"]
+    for field, tag in (("batch_axis", "batch"), ("seq_axis", "seq")):
+        if sh.get(field):
+            bits.append("%s:%s" % (tag, sh[field]))
+    for field, tag in (("param_rules", "params"),
+                       ("state_rules", "state")):
+        n = sum(1 for _p, spec in (sh.get(field) or [])
+                if any(ax is not None for ax in spec))
+        if n:
+            bits.append("%s:%d" % (tag, n))
+    return "|".join(bits)
+
+
 def cmd_list(args):
     d = _dir_from(args)
     now = time.time()
@@ -85,6 +107,8 @@ def cmd_list(args):
             "key": key,
             "kind": (meta or {}).get("kind", "?"),
             "signature": _fmt_sig(meta),
+            "sharding": _fmt_sharding(meta),
+            "sharding_spec": (meta or {}).get("sharding", "none"),
             "platform": ((meta or {}).get("fingerprint") or {})
             .get("device_kind", "?"),
             "age_s": round(now - (meta or {}).get("created", now), 1),
@@ -97,10 +121,12 @@ def cmd_list(args):
         print("(empty cache: %s)" % d)
         return 0
     w = max(len(r["kind"]) for r in rows)
+    ws = max(len(r["sharding"]) for r in rows)
     for r in rows:
-        print("%s  %-*s  %-10s  age %8.1fs  %8d B  %s"
+        print("%s  %-*s  %-10s  %-*s  age %8.1fs  %8d B  %s"
               % (r["key"][:16], w, r["kind"], r["platform"],
-                 r["age_s"], r["size"], r["signature"]))
+                 ws, r["sharding"], r["age_s"], r["size"],
+                 r["signature"]))
     print("%d entr%s, %.1f KiB payload total"
           % (len(rows), "y" if len(rows) == 1 else "ies",
              total / 1024.0))

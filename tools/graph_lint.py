@@ -209,6 +209,21 @@ def main(argv=None):
                     help="with --decode-step: name of the slot-"
                          "occupancy/valid vector input, if the step "
                          "graph masks on one")
+    ap.add_argument("--sharding-plan", default=None, metavar="JSON",
+                    help="audit a model-parallel ShardingPlan spec "
+                         "(parallel/mesh.py; inline JSON or a file "
+                         "path) against this graph's padded-axis "
+                         "verdicts: reports which nodes the plan "
+                         "partitions (everything downstream of a "
+                         "partitioned input under computation-follows-"
+                         "data) and the verdict per partitioned padded "
+                         "axis.  A REJECTED plan — one partitioning a "
+                         "cross-position or unproven padded axis — "
+                         "exits 1 even without --strict, exactly the "
+                         "gate ServingEngine/DecodeEngine apply at "
+                         "construction.  Combines with --decode-step "
+                         "(slot-axis verdict) or the serve-mode "
+                         "padded-axis verdicts")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="print one machine-readable JSON document "
                          "instead of text (hazard_rank.py input)")
@@ -226,6 +241,16 @@ def main(argv=None):
     except Exception as e:
         print("graph_lint: %s" % e, file=sys.stderr)
         return 2
+
+    plan_spec = None
+    if args.sharding_plan is not None:
+        from mxnet_tpu.parallel.mesh import load_plan_spec
+        try:
+            plan_spec = load_plan_spec(args.sharding_plan)
+        except Exception as e:
+            print("graph_lint: bad --sharding-plan: %s" % e,
+                  file=sys.stderr)
+            return 2
 
     if args.decode_step and (args.fix or args.optimize
                              or args.seq_axis is not None
@@ -274,9 +299,17 @@ def main(argv=None):
                 selections = _decode_selections(
                     analysis, graph, shapes, state_names,
                     args.decode_valid, args.training)
+            plan_audit = None
+            if plan_spec is not None and not hard:
+                plan_audit = _audit_plan(analysis, graph, plan_spec,
+                                         "decode", {"slot": verdict},
+                                         shapes)
+                if not plan_audit["accepted"]:
+                    failed = True
             doc[spec] = {"findings": report.to_list(),
                          "verdicts": {"slot": verdict}, "repairs": [],
-                         "selections": selections}
+                         "selections": selections,
+                         "sharding_plan": plan_audit}
             if not args.as_json and (failed or not args.quiet):
                 print("== %s ==" % spec)
                 print(report.format())
@@ -284,6 +317,7 @@ def main(argv=None):
                 for s in selections:
                     print("  fused-op selection: %s at %s (%s)"
                           % (s["op"], s["site"], s["verdict"]))
+                _print_plan_audit(plan_audit)
                 if unsound:
                     print("  FAIL: step graph is cross-position along "
                           "the slot axis — a dead slot's stale state "
@@ -307,6 +341,12 @@ def main(argv=None):
         hard = bool(report.errors)
         entry = {"findings": report.to_list(),
                  "verdicts": dict(ctx.pad_verdicts), "repairs": []}
+        if plan_spec is not None and not hard:
+            entry["sharding_plan"] = _audit_plan(
+                analysis, graph, plan_spec, "serve",
+                dict(ctx.pad_verdicts), shapes)
+            if not entry["sharding_plan"]["accepted"]:
+                failed = True
         fix_lines = []
         if args.fix and pad_axes is None and not hard:
             # --fix must never be a silent no-op: say WHY no repair
@@ -342,6 +382,7 @@ def main(argv=None):
             print(report.format())
             for label, verdict in sorted(ctx.pad_verdicts.items()):
                 print("  padded %s axis: %s" % (label, verdict))
+            _print_plan_audit(entry.get("sharding_plan"))
             for ln in fix_lines:
                 print(ln)
         if hard:
@@ -351,6 +392,39 @@ def main(argv=None):
     if args.as_json:
         print(json.dumps({"graphs": doc}, indent=2, default=str))
     return worst
+
+
+def _audit_plan(analysis, graph, plan_spec, kind, verdicts, shapes):
+    """Run the offline sharding-plan audit over one graph: the SAME
+    ``check_sharding_plan`` gate the engines apply at construction,
+    plus the node attribution (everything downstream of a partitioned
+    input) only an offline tool has the budget to walk."""
+    try:
+        check, detail = analysis.audit_sharding_plan(
+            graph, plan_spec, data_shapes=shapes, kind=kind,
+            verdicts=verdicts)
+    except Exception as e:
+        return {"accepted": False,
+                "reasons": ["audit crashed: %s" % e],
+                "partitioned": [], "nodes": {}}
+    return {"accepted": check.accepted, "reasons": check.reasons,
+            "partitioned": check.partitioned, "nodes": detail["nodes"]}
+
+
+def _print_plan_audit(audit):
+    if audit is None:
+        return
+    print("  sharding plan: %s"
+          % ("ACCEPTED" if audit["accepted"] else "REJECTED"))
+    for row in audit["partitioned"]:
+        where = row.get("padded_axis") or row.get("rule") or "param"
+        print("    partitions %s (%s): verdict %s"
+              % (row["input"], where, row.get("verdict")))
+    for src, nodes in sorted(audit["nodes"].items()):
+        show = ", ".join(nodes[:6]) + (", ..." if len(nodes) > 6 else "")
+        print("    %s reaches %d node(s): %s" % (src, len(nodes), show))
+    for r in audit["reasons"]:
+        print("    FAIL: %s" % r)
 
 
 def _decode_selections(analysis, graph, shapes, state_names,

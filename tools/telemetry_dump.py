@@ -481,19 +481,20 @@ def format_healthz(doc):
     if reps:
         lines.append("replicas: %d total, %d unhealthy"
                      % (reps.get("total", 0), reps.get("unhealthy", 0)))
-        lines.append("  %-8s %-8s %-9s %9s %9s %9s %9s"
+        lines.append("  %-8s %-8s %-9s %9s %9s %9s %9s %7s"
                      % ("engine", "replica", "healthy", "inflight",
-                        "batches", "occupied", "failures"))
+                        "batches", "occupied", "failures", "shards"))
         for eng in sorted(reps.get("engines", {})):
             for row in reps["engines"][eng]:
                 lines.append(
-                    "  %-8s %-8s %-9s %9s %9s %9s %9s"
+                    "  %-8s %-8s %-9s %9s %9s %9s %9s %7s"
                     % (eng, row.get("replica"),
                        "ok" if row.get("healthy") else "UNHEALTHY",
                        row.get("inflight", "-"),
                        row.get("batches", "-"),
                        row.get("slots_occupied", "-"),
-                       row.get("failures", "-")))
+                       row.get("failures", "-"),
+                       row.get("shards", 1)))
     al = doc.get("alerts")
     if al:
         lines.append("alerts: %s rule(s), %s firing%s"
