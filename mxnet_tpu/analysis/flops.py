@@ -134,6 +134,13 @@ _RULES = {
     "_cache_write_row": (
         lambda a, i, o: float(_prod(i[1])) if len(i) > 1 and i[1]
         else 0.0, 1.0),
+    # speculative multi-token commit: up to K rows of data movement
+    # per slot — priced as the rows operand's elements so swapping the
+    # K-deep masked-blend chain (K * O(slots * max_len * d) muls/adds)
+    # for the widened scatter registers as the FLOP reduction it is
+    "_cache_write_rows": (
+        lambda a, i, o: float(_prod(i[1])) if len(i) > 1 and i[1]
+        else 0.0, 1.0),
 }
 
 _DEFAULT_BWD = 1.0

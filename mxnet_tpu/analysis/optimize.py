@@ -816,6 +816,169 @@ def _match_kv_sides(state, keep_e, write_e):
     return None
 
 
+def _match_masked_blend(state, n):
+    """Match ONE count-masked one-hot blend — the speculative commit
+    builder's per-position write (serving/spec.py)::
+
+        ohe  = expand_dims(one_hot(pos + j, T) *
+                           expand_dims(count > j, 1), axis=2)
+        n    = prev * (1 - ohe)  +  slice_axis(rows, 1, j, j+1) * ohe
+
+    Returns ``(prev_entry, rows_entry, pos_entry, count_entry, j, T)``
+    or None.  ``pos + 0`` may appear as a bare ``pos`` entry (the
+    builder emits ``_plus_scalar`` uniformly, but an algebraic bypass
+    in a later fixed-point iteration may have collapsed it)."""
+    if n.op is None or n.op.name != "_add" or len(n.inputs) != 2:
+        return None
+    for ka in (0, 1):
+        keep_e, write_e = n.inputs[ka], n.inputs[1 - ka]
+        keep, write = keep_e[0], write_e[0]
+        if keep_e[1] != 0 or write_e[1] != 0:
+            continue
+        if any(x.op is None or x.op.name != "_mul"
+               or len(x.inputs) != 2 for x in (keep, write)):
+            continue
+        for wi in (0, 1):
+            ohe_e, rowx_e = write.inputs[wi], write.inputs[1 - wi]
+            ohe = ohe_e[0]
+            if ohe.op is None or ohe.op.name != "expand_dims" \
+                    or ohe_e[1] != 0:
+                continue
+            oattrs = _norm(ohe)
+            if oattrs is None:
+                continue
+            ax = int(oattrs.get("axis", 0))
+            if (ax + 3 if ax < 0 else ax) != 2:
+                continue
+            ohm_e = ohe.inputs[0]
+            ohm = ohm_e[0]
+            if ohm.op is None or ohm.op.name != "_mul" \
+                    or ohm_e[1] != 0 or len(ohm.inputs) != 2:
+                continue
+            for mi in (0, 1):
+                oh_e, mje_e = ohm.inputs[mi], ohm.inputs[1 - mi]
+                oh, mje = oh_e[0], mje_e[0]
+                if oh.op is None or oh.op.name != "one_hot" \
+                        or oh_e[1] != 0:
+                    continue
+                hattrs = _norm(oh)
+                if hattrs is None \
+                        or float(hattrs.get("on_value", 1.0)) != 1.0 \
+                        or float(hattrs.get("off_value", 0.0)) != 0.0:
+                    continue
+                depth = int(hattrs["depth"])
+                # position: pos + j (or bare pos for j == 0)
+                pj = oh.inputs[0]
+                if pj[0].op is not None \
+                        and pj[0].op.name == "_plus_scalar" \
+                        and pj[1] == 0:
+                    pattrs = _norm(pj[0])
+                    if pattrs is None:
+                        continue
+                    j_pos = float(pattrs.get("scalar", 0.0))
+                    pos_e = pj[0].inputs[0]
+                else:
+                    j_pos = 0.0
+                    pos_e = pj
+                if j_pos != int(j_pos) or j_pos < 0:
+                    continue
+                # mask: expand_dims(count > j, axis=1)
+                if mje.op is None or mje.op.name != "expand_dims" \
+                        or mje_e[1] != 0:
+                    continue
+                mattrs = _norm(mje)
+                if mattrs is None:
+                    continue
+                max_ = int(mattrs.get("axis", 0))
+                if (max_ + 2 if max_ < 0 else max_) != 1:
+                    continue
+                mj_e = mje.inputs[0]
+                mj = mj_e[0]
+                if mj.op is None or mj.op.name != "_greater_scalar" \
+                        or mj_e[1] != 0:
+                    continue
+                gattrs = _norm(mj)
+                if gattrs is None \
+                        or float(gattrs.get("scalar", 0.0)) != j_pos:
+                    continue
+                count_e = mj.inputs[0]
+                # write row: slice_axis(rows, axis=1, j, j+1)
+                rowx = rowx_e[0]
+                if rowx.op is None or rowx.op.name != "slice_axis" \
+                        or rowx_e[1] != 0:
+                    continue
+                rattrs = _norm(rowx)
+                if rattrs is None or int(rattrs.get("axis", 0)) != 1 \
+                        or int(rattrs.get("begin", 0)) != int(j_pos) \
+                        or rattrs.get("end") is None \
+                        or int(rattrs["end"]) != int(j_pos) + 1:
+                    continue
+                rows_e = rowx.inputs[0]
+                # keep side: prev * (1 - ohe), the SAME ohe entry
+                for ki in (0, 1):
+                    inv_e, prev_e = keep.inputs[ki], keep.inputs[1 - ki]
+                    inv = inv_e[0]
+                    if inv.op is None \
+                            or inv.op.name != "_rminus_scalar" \
+                            or inv_e[1] != 0:
+                        continue
+                    iattrs = _norm(inv)
+                    if iattrs is None \
+                            or float(iattrs.get("scalar", 0.0)) != 1.0:
+                        continue
+                    if _entry_key(inv.inputs[0]) != _entry_key(ohe_e):
+                        continue
+                    return (tuple(prev_e), tuple(rows_e), tuple(pos_e),
+                            tuple(count_e), int(j_pos), depth)
+    return None
+
+
+def _match_kv_write_rows(state, n):
+    """Match the FULL masked-blend commit chain rooted at ``n`` — K
+    count-masked blends at consecutive positions ``pos..pos+K-1``
+    peeling down to the cache input — the long-hand spelling of one
+    ``_cache_write_rows(cache, rows, pos, count)`` (the speculative
+    multi-token commit, ISSUE 15).  Requires the j's to descend
+    ``K-1..0`` over one shared (rows, pos, count) triple, ``K`` equal
+    to the rows operand's axis-1 extent, ``depth`` equal to the cache
+    length, and consistent shapes.  Returns ``(cache_entry,
+    rows_entry, pos_entry, count_entry)`` or None."""
+    top = _match_masked_blend(state, n)
+    if top is None:
+        return None
+    prev_e, rows_e, pos_e, count_e, j, depth = top
+    rows_shape = state.shapes.get(_entry_key(rows_e))
+    if rows_shape is None or len(rows_shape) < 3 \
+            or rows_shape[1] != j + 1:
+        return None                     # top blend must be j == K-1
+    expect = j - 1
+    while expect >= 0:
+        m = _match_masked_blend(state, prev_e[0])
+        if m is None or prev_e[1] != 0:
+            return None
+        p2, r2, po2, c2, j2, d2 = m
+        if j2 != expect or d2 != depth \
+                or _entry_key(r2) != _entry_key(rows_e) \
+                or _entry_key(po2) != _entry_key(pos_e) \
+                or _entry_key(c2) != _entry_key(count_e):
+            return None
+        prev_e = p2
+        expect -= 1
+    cache_e = prev_e
+    cshape = state.shapes.get(_entry_key(cache_e))
+    pshape = state.shapes.get(_entry_key(pos_e))
+    tshape = state.shapes.get(_entry_key(count_e))
+    if cshape is None or pshape is None or tshape is None \
+            or len(cshape) < 2:
+        return None
+    if cshape[1] != depth or pshape != (cshape[0],) \
+            or tshape != (cshape[0],) \
+            or rows_shape != (cshape[0], rows_shape[1]) \
+            + tuple(cshape[2:]):
+        return None
+    return cache_e, rows_e, pos_e, count_e
+
+
 @register_opt_pass("select")
 def _select_pass(state):
     """Swap matched one-hot-blend KV writes for ``_cache_write_row``.
@@ -837,6 +1000,36 @@ def _select_pass(state):
     applied = 0
     for n in _topo(state.symbol._outputs):
         if n.op is None or (id(n), 0) in repl:
+            continue
+        mr = _match_kv_write_rows(state, n)
+        if mr is not None:
+            cache_e, rows_e, pos_e, count_e = mr
+            out_s, out_d = state.sig((n, 0))
+            c_s, c_d = state.sig(tuple(cache_e))
+            if out_s is None or out_d is None \
+                    or out_s != c_s or out_d != c_d:
+                continue    # promotion/broadcast changed the signature
+            opdef = get_op("_cache_write_rows")
+            node = SymNode(opdef,
+                           _unique_name(state.taken,
+                                        n.name + "_scatter_rows"),
+                           opdef.normalize({}),
+                           [tuple(cache_e), tuple(rows_e),
+                            tuple(pos_e), tuple(count_e)])
+            state.track(node, shape=out_s, dtype=out_d)
+            repl[(id(n), 0)] = (node, 0)
+            state.attr.setdefault(id(n), "select")
+            state.record(
+                "select", "select", n,
+                "masked one-hot-blend commit chain -> "
+                "_cache_write_rows(%s, %s, %s, %s): one widened "
+                "scatter commits the accepted speculative rows in "
+                "place of %d chained O(max_len*d) blends"
+                % (cache_e[0].name, rows_e[0].name, pos_e[0].name,
+                   count_e[0].name,
+                   (state.shapes.get(_entry_key(rows_e))
+                    or (0, 0))[1]))
+            applied += 1
             continue
         m = _match_kv_write(state, n)
         if m is None:

@@ -904,6 +904,31 @@ class PaddingSoundnessPass(AnalysisPass):
         return [_Pad(axes, False,
                      cache.diffuse or row.diffuse or pos.diffuse)]
 
+    def _op_cache_write_rows(self, h):
+        """``_cache_write_rows(cache, rows, pos, count)``: output row i
+        is cache row i with up to ``count[i]`` elements starting at
+        ``pos[i]`` overwritten by ``rows[i]`` — the speculative
+        multi-token widening of ``_cache_write_row``.  Each output row
+        reads ONLY its own row of every operand, so the op is
+        row-local along the slot axis (axis 0) by construction, with
+        no zero-pad credit (committed positions make pad rows nonzero
+        and stale cache rows pass through untouched)."""
+        cache = h.ins[0]
+        rest = [h.ins[i] if len(h.ins) > i else _EMPTY
+                for i in (1, 2, 3)]
+        if any(r.axes - {0} for r in rest):
+            # padding carried on a non-slot axis of rows/pos/count
+            # lands at shifted output coordinates — stand down
+            h.emit("_cache_write_rows: rows/pos/count operand carries "
+                   "padding on a non-slot axis — position tracking "
+                   "lost")
+            return [_Pad(diffuse=True, zero=False)]
+        axes = set(cache.axes)
+        if any(0 in r.axes for r in rest):
+            axes.add(0)
+        return [_Pad(axes, False,
+                     cache.diffuse or any(r.diffuse for r in rest))]
+
     def _op_sequence_mask(self, h):
         data = h.ins[0]
         if not h.attrs.get("use_sequence_length"):
@@ -1063,6 +1088,7 @@ _HANDLERS = {
     "pick": "gather",
     "one_hot": "one_hot",
     "_cache_write_row": "cache_write",
+    "_cache_write_rows": "cache_write_rows",
     "SequenceMask": "sequence_mask",
     "RNN": "rnn",
     "broadcast_to": "broadcast", "broadcast_axis": "broadcast",

@@ -169,6 +169,21 @@ VARIABLES = {v.name: v for v in [
          "this many positions per slot up front; prompt length + "
          "generated tokens may not exceed it (requests finish with "
          "reason 'length' at the cap)."),
+    _Var("MXNET_DECODE_SPEC_K", int, 0,
+         "Speculative draft-k-verify decoding (serving/decode.py + "
+         "serving/spec.py): with k > 0 and a draft model "
+         "(DecodeEngine draft_sym=), every replica compiles ONE wider "
+         "step program that drafts k continuation tokens in-graph, "
+         "scores all k+1 positions with the target model in the same "
+         "dispatch, and commits only the accepted prefix (exact "
+         "greedy prefix match for GreedySampler — bitwise-identical "
+         "to greedy_decode; standard rejection sampling for "
+         "TemperatureSampler — seeded replays bitwise).  Accepted "
+         "rows commit through the _cache_write_rows multi-token "
+         "scatter when the verdict-gated selection adopts it "
+         "(MXNET_CACHE_SCATTER_IMPL picks its backend impl).  0 (the "
+         "default) is the single-token engine byte-identical to the "
+         "pre-spec code.  DecodeEngine(spec_k=) overrides."),
     _Var("MXNET_DECODE_COALESCE_PREFILL", bool, True,
          "Coalesce concurrent decode joiners through the bucketed "
          "prefill path (serving/decode.py): requests joining in the "

@@ -133,6 +133,128 @@ def _scatter_pallas(cache, row, idx, interpret):
     )(idx, cache, row3)
 
 
+def _scatter_rows_xla(cache, rows, idx, cnt):
+    """Fallback for the multi-row commit: per slot, K sequential
+    conditional row writes.  Row ``j`` is written only when
+    ``j < count[i]`` — expressed as a select between the new row and
+    the row currently at the target position, followed by an
+    unconditional ``dynamic_update_slice`` (a masked write stays one
+    shape-stable compiled program whatever the counts are).  Writes
+    ascend ``j`` so clamped-position collisions resolve last-writer-
+    wins, matching the kernel's grid order."""
+    import jax
+    import jax.numpy as jnp
+    K = rows.shape[1]
+
+    def write_one(c, rs, p, n):
+        T = c.shape[0]
+        for j in range(K):
+            pj = jnp.clip(p + j, 0, T - 1)
+            ok = jnp.logical_and(j < n,
+                                 jnp.logical_and(p + j >= 0,
+                                                 p + j < T))
+            cur = jax.lax.dynamic_slice_in_dim(c, pj, 1, axis=0)
+            new = jnp.where(ok, rs[j][None], cur)
+            c = jax.lax.dynamic_update_slice_in_dim(c, new, pj, axis=0)
+        return c
+    return jax.vmap(write_one)(cache, rows, idx, cnt)
+
+
+def _scatter_rows_pallas(cache, rows, idx, cnt, interpret):
+    """The widened Pallas TPU kernel: grid over (slots, K), the write
+    positions AND accepted counts scalar-prefetched, the cache kept
+    UNBLOCKED in HBM and aliased input->output (exactly the single-row
+    kernel's discipline).  Grid step (i, j) issues one async DMA of
+    row j into ``out[i, pos[i]+j]`` — predicated with ``pl.when`` on
+    ``j < count[i]``, so rejected speculative rows move zero bytes.
+    O(count * d) data movement per slot per speculative window, never
+    O(K * max_len * d)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, K = rows.shape[0], rows.shape[1]
+    max_pos = cache.shape[1] - 1
+
+    def kernel(pos_ref, cnt_ref, cache_ref, rows_ref, out_ref, sem):
+        # cache_ref is the aliased input view of out_ref; never touched
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        pj = pos_ref[i] + j
+        p = jnp.minimum(jnp.maximum(pj, 0), max_pos)
+
+        @pl.when(jnp.logical_and(j < cnt_ref[i],
+                                 jnp.logical_and(pj >= 0,
+                                                 pj <= max_pos)))
+        def _():
+            copy = pltpu.make_async_copy(
+                rows_ref.at[pl.ds(i, 1), pl.ds(j, 1)],
+                out_ref.at[pl.ds(i, 1), pl.ds(p, 1)],
+                sem)
+            copy.start()
+            copy.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n, K),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(cache.shape, cache.dtype),
+        # operand order with scalar prefetch: (idx, cnt, cache, rows) —
+        # the cache (operand 2) aliases the output for in-place update
+        input_output_aliases={2: 0},
+        interpret=bool(interpret),
+    )(idx, cnt, cache, rows)
+
+
+@register("_cache_write_rows", nin=4,
+          input_names=["cache", "rows", "pos", "count"],
+          mode_dependent=True)
+def _cache_write_rows(attrs, cache, rows, pos, count):
+    """Multi-token commit — the speculative-decode widening of
+    ``_cache_write_row`` (ISSUE 15)::
+
+        out[i, pos[i] + j, ...] = rows[i, j, ...]   for j < count[i]
+
+    all other elements of ``cache`` pass through untouched.  ``cache``
+    is ``(slots, max_len) + tail``, ``rows`` is ``(slots, K) + tail``
+    (K = spec window width, a compile-time constant baked per engine),
+    ``pos`` a ``(slots,)`` vector of window start positions and
+    ``count`` a ``(slots,)`` vector of ACCEPTED row counts in
+    ``[0, K]`` — a draft-k-verify step commits only the tokens the
+    target model accepted, in one kernel, instead of K round-trips.
+
+    A row whose position falls OUTSIDE ``[0, max_len)`` is DROPPED
+    (not clamped, unlike the single-row op): that is exactly what the
+    count-masked one-hot blend chain this op replaces computes (an
+    out-of-range one-hot row is all zero), so the select pass's
+    "bitwise-identical long-hand spelling" contract holds even when a
+    speculative window straddles the cache end — and a finishing
+    slot's overshoot can never overwrite the last real row.  Same
+    impl selection (``MXNET_CACHE_SCATTER_IMPL``), same training-mode
+    fallback, same bitwise kernel-vs-fallback contract pinned by
+    interpret mode on CPU CI (tests/test_decode_spec.py)."""
+    import jax.numpy as jnp
+    idx = pos.astype(jnp.int32)
+    cnt = jnp.clip(count.astype(jnp.int32), 0, rows.shape[1])
+    rows = jnp.asarray(rows, cache.dtype)
+    mode = _impl_mode()
+    if mode in ("pallas", "interpret") and attrs.get("_training"):
+        # pallas_call defines no autodiff rule (see _cache_write_row)
+        mode = "xla"
+    if mode in ("pallas", "interpret"):
+        return _scatter_rows_pallas(cache, rows, idx, cnt,
+                                    interpret=(mode == "interpret"))
+    return _scatter_rows_xla(cache, rows, idx, cnt)
+
+
 @register("_cache_write_row", nin=3,
           input_names=["cache", "row", "pos"],
           mode_dependent=True,

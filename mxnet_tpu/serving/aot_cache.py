@@ -580,9 +580,15 @@ def resolve_kernel(cache, jit_fn, kind, graph, args, meta_extra=None,
                    "AOT cache: jax.export cannot serialize a %s "
                    "program (%r); serving it uncached" % (kind, e))
         return jit_fn, "off"
-    cache.store(key, payload,
-                dict(meta_extra or {}, kind=kind, graph=graph,
-                     signature=_signature(args)))
+    extra = dict(meta_extra or {}, kind=kind, graph=graph,
+                 signature=_signature(args))
+    if universal:
+        # the entry's KEY was built with no engine policy — record
+        # that truthfully (store() would otherwise stamp the cache's
+        # key_extra, and tools/aot_cache.py list would render a
+        # policy the key never contained)
+        extra["policy"] = {}
+    cache.store(key, payload, extra)
     return jax.jit(exp.call, donate_argnums=donate_argnums), "miss"
 
 

@@ -134,6 +134,20 @@ class Sampler(object):
         rides the same float pipeline the argmax head fed)."""
         raise NotImplementedError
 
+    def spec_logits(self, logits):
+        """jax-land: raw logits -> the sampler's log-space
+        distribution (temperature scaling, top-k masking) — what
+        speculative rejection sampling verifies draft proposals
+        against.  Must be the same transform :meth:`sample` draws
+        from, applied identically to target and draft logits, or the
+        emitted distribution drifts from the single-token engine's.
+        Greedy samplers never call this (acceptance is exact argmax
+        prefix match)."""
+        raise MXNetError(
+            "%s does not support speculative decode: implement "
+            "spec_logits() (the distribution rejection sampling "
+            "verifies against)" % type(self).__name__)
+
     def describe(self):
         return {"kind": type(self).__name__}
 
@@ -172,13 +186,17 @@ class TemperatureSampler(Sampler):
 
     def sample(self, key, logits):
         import jax
+        return jax.random.categorical(key, self.spec_logits(logits),
+                                      axis=-1).astype(logits.dtype)
+
+    def spec_logits(self, logits):
+        import jax
         import jax.numpy as jnp
         z = logits / self.temperature
         if self.top_k is not None and self.top_k < z.shape[-1]:
             kth = jax.lax.top_k(z, self.top_k)[0][..., -1:]
             z = jnp.where(z < kth, -jnp.inf, z)
-        return jax.random.categorical(key, z, axis=-1) \
-                  .astype(logits.dtype)
+        return z
 
     def describe(self):
         return {"kind": "temperature", "temperature": self.temperature,
@@ -275,13 +293,22 @@ class StepProgram(object):
     def __init__(self, step_sym, arg_params, aux_params, state_info,
                  num_slots, token_name="token", pos_name="pos",
                  valid_name="valid", ctx=None, dtype=np.float32,
-                 sampler=None, aot=None, plan=None):
+                 sampler=None, aot=None, plan=None, spec=None):
         import jax
         import jax.numpy as jnp
         from ..context import cpu
         from ..executor import build_graph_fn, _count_xla_trace
         from .. import symbol as sym
+        from . import spec as _spec_mod
         self._ctx = ctx or cpu()
+        # speculative draft-k-verify (serving/spec.py, ISSUE 15): with
+        # a SpecConfig the ONE compiled program per replica widens —
+        # k+1 draft steps and k+1 target steps unroll in-graph, the
+        # accept logic picks the committed prefix, and the commit
+        # graph (blend chain or the selected _cache_write_rows
+        # scatter) writes only accepted rows into the ORIGINAL cache.
+        # None = the single-token program byte-for-byte.
+        self._spec = spec
         # model-parallel decode (parallel/mesh.py ShardingPlan): params
         # upload as one sharded device_put each, per-slot state buffers
         # lay out under the plan's state_rules (a KV cache's feature
@@ -302,7 +329,13 @@ class StepProgram(object):
                 "decode step graph has %d outputs; expected 1 (logits) "
                 "+ %d next-state outputs (state_info order)"
                 % (len(step_sym), len(self.state_names)))
-        if self.sampler.greedy:
+        if self._spec is not None:
+            # the spec program needs per-position RAW logits (the
+            # greedy head becomes a jnp.argmax with identical
+            # semantics inside the accept logic — same impl, same
+            # tie-breaking, same dtype cast as the argmax op)
+            head = step_sym[0]
+        elif self.sampler.greedy:
             # greedy keeps the in-graph argmax head: bitwise-pinned
             # against greedy_decode, identical compiled program
             head = sym.argmax(step_sym[0], axis=1,
@@ -353,8 +386,242 @@ class StepProgram(object):
                 "reproducibility both depend on it")
         self._trace_count = 0
         na = len(arg_names)
+        n_t = len(order)
         state_pos = tuple(order.index(n) for n in self.state_names)
         _sampler = self.sampler
+        # -------------------------------------------------- draft half
+        # the draft model is a full second graph riding the same flat
+        # argument vector: its params append to the template (uploaded
+        # to this replica's device / sharded under its plan exactly
+        # like the target's), its per-slot state buffers live in the
+        # same states dict under prefixed keys, and its token/pos/
+        # valid inputs are fed the SAME host vectors as the target's.
+        self.draft_state_keys = []
+        self._spec_cache_t = []         # (name, T) target cache states
+        self._spec_cache_d = []         # (key, T) draft cache states
+        if self._spec is not None:
+            dspec = self._spec
+            # idempotent: the engine builds the shared commit graph
+            # once before any replica constructs; a directly-built
+            # StepProgram(spec=...) gets the same build here instead
+            # of a KeyError inside its first traced dispatch
+            dspec.build(self.num_slots, self.state_info, self._dtype)
+            dsym = sym.Group(list(dspec.draft_sym))
+            d_args = dsym.list_arguments()
+            d_auxs = dsym.list_auxiliary_states()
+            if dspec.token_name not in d_args:
+                raise MXNetError("draft graph has no %r input; "
+                                 "arguments: %s"
+                                 % (dspec.token_name, d_args))
+            d_states = dspec.draft_state_names()
+            missing = [n for n in d_states if n not in d_args]
+            if missing:
+                raise MXNetError("draft graph is missing state "
+                                 "input(s) %s" % missing)
+            if len(dsym) != 1 + len(d_states):
+                raise MXNetError(
+                    "draft graph has %d outputs; expected 1 (logits) "
+                    "+ %d next-state outputs" % (len(dsym),
+                                                 len(d_states)))
+            self._d_tok = dspec.token_name
+            self._d_pos = (dspec.pos_name
+                           if dspec.pos_name in d_args else None)
+            self._d_valid = (dspec.valid_name
+                             if dspec.valid_name in d_args else None)
+            d_feeds = set([self._d_tok] + d_states)
+            d_feeds.update(n for n in (self._d_pos, self._d_valid) if n)
+            d_order = list(d_args) + list(d_auxs)
+            lacking = [n for n in d_order
+                       if n not in d_feeds
+                       and n not in dspec.draft_arg_params
+                       and n not in dspec.draft_aux_params]
+            if lacking:
+                raise MXNetError("SpecConfig: draft params missing "
+                                 "for %s" % lacking)
+            self._template += [None] * len(d_order)
+            for i, n in enumerate(d_order):
+                if n in d_feeds:
+                    continue
+                src = (dspec.draft_arg_params
+                       if n in dspec.draft_arg_params
+                       else dspec.draft_aux_params)
+                if self._plan is not None:
+                    self._template[n_t + i] = self._plan.put_param(
+                        n, src[n]._data)
+                else:
+                    self._template[n_t + i] = \
+                        src[n].as_in_context(self._ctx)._data
+            # absolute feed positions in the merged flat vector,
+            # keyed by the engine-side draft state keys
+            from .spec import _draft_key
+            self._d_feed_pos = {}
+            for n in d_feeds:
+                key_n = _draft_key(n) if n in d_states else n
+                self._d_feed_pos[key_n] = n_t + d_order.index(n)
+            self.draft_state_keys = dspec.draft_keys()
+            gf_d = build_graph_fn(dsym, d_args, d_auxs)
+            if gf_d.stochastic:
+                raise MXNetError("draft graph contains stochastic "
+                                 "ops: the speculative step must be "
+                                 "deterministic given its rng key")
+            nda = len(d_args)
+            d_state_pos = tuple(n_t + d_order.index(n)
+                                for n in d_states)
+            # commit structure: cache-declared states commit accepted
+            # rows through the (possibly _cache_write_rows-selected)
+            # commit graph; everything else selects the chain state
+            # at the accepted count
+            for info in self.state_info:
+                if info.get("cache"):
+                    if self.pos_name is None:
+                        raise MXNetError(
+                            "state %r is cache-declared but the step "
+                            "graph has no %r input — a positional "
+                            "cache commit needs the write position"
+                            % (info["name"], pos_name))
+                    self._spec_cache_t.append(
+                        (info["name"], int(info["shape"][0])))
+            for info in dspec.draft_state_info:
+                if info.get("cache"):
+                    if self._d_pos is None:
+                        raise MXNetError(
+                            "draft state %r is cache-declared but the "
+                            "draft graph has no %r input"
+                            % (info["name"], dspec.pos_name))
+                    self._spec_cache_d.append(
+                        (_draft_key(info["name"]),
+                         int(info["shape"][0])))
+            gf_commit = commit_args = None
+            if dspec.commit_sym is not None:
+                commit_args = dspec.commit_sym.list_arguments()
+                gf_commit = build_graph_fn(dspec.commit_sym,
+                                           commit_args, [])
+            K = dspec.K
+            cache_keys = set(k for k, _t in
+                             self._spec_cache_t + self._spec_cache_d)
+
+            def call_spec(key, tick, reset, spec_m, *flat):
+                self._trace_count += 1
+                _count_xla_trace()
+                flat = list(flat)
+                # join-time zeroing covers BOTH models' state rows
+                for i in state_pos + d_state_pos:
+                    s = flat[i]
+                    r = reset.reshape((-1,) + (1,) * (s.ndim - 1))
+                    flat[i] = jnp.where(r > 0, jnp.zeros((), s.dtype),
+                                        s)
+                token0 = flat[self._feed_pos[self.token_name]]
+                pos0 = (flat[self._feed_pos[self.pos_name]]
+                        if self.pos_name is not None else None)
+                kstep = jax.random.fold_in(key, tick)
+                # ---- draft chain: k proposals + one state-advancing
+                # extra step (its proposal is discarded; it exists so
+                # an all-accept window leaves the draft having
+                # consumed every committed token)
+                xs = [token0]
+                d_chain = []
+                cur = {kk: flat[self._d_feed_pos[kk]]
+                       for kk in self.draft_state_keys}
+                dlogits = []
+                for j in range(K):
+                    df = list(flat[n_t:])
+                    df[self._d_feed_pos[self._d_tok] - n_t] = xs[j]
+                    if self._d_pos is not None:
+                        df[self._d_feed_pos[self._d_pos] - n_t] = \
+                            flat[self._d_feed_pos[self._d_pos]] \
+                            + jnp.float32(j)
+                    for ix, kk in enumerate(self.draft_state_keys):
+                        df[d_state_pos[ix] - n_t] = cur[kk]
+                    outs_d, _ = gf_d(df[:nda], df[nda:], key, False)
+                    dlogits.append(outs_d[0])
+                    cur = {kk: outs_d[1 + ix] for ix, kk in
+                           enumerate(self.draft_state_keys)}
+                    d_chain.append(cur)
+                    if j < K - 1:
+                        if _sampler.greedy:
+                            prop = jnp.argmax(outs_d[0], axis=1) \
+                                .astype(outs_d[0].dtype)
+                        else:
+                            zq = _sampler.spec_logits(outs_d[0])
+                            prop = jax.random.categorical(
+                                jax.random.fold_in(kstep, 2 * j),
+                                zq, axis=-1).astype(outs_d[0].dtype)
+                        xs.append(prop)
+                # ---- target chain: score all K positions
+                t_chain = []
+                tlogits = []
+                cur_t = {n2: flat[self._feed_pos[n2]]
+                         for n2 in self.state_names}
+                for j in range(K):
+                    tf = list(flat[:n_t])
+                    tf[self._feed_pos[self.token_name]] = xs[j]
+                    if self.pos_name is not None:
+                        tf[self._feed_pos[self.pos_name]] = \
+                            pos0 + jnp.float32(j)
+                    for n2 in self.state_names:
+                        tf[self._feed_pos[n2]] = cur_t[n2]
+                    outs_t, _ = gf(tf[:na], tf[na:], key, False)
+                    tlogits.append(outs_t[0])
+                    cur_t = {n2: outs_t[1 + ix] for ix, n2 in
+                             enumerate(self.state_names)}
+                    t_chain.append(cur_t)
+                # ---- accept
+                if _sampler.greedy:
+                    toks, a = _spec_mod.greedy_accept(xs, tlogits)
+                else:
+                    toks, a = _spec_mod.rejection_accept(
+                        kstep, xs, tlogits, dlogits,
+                        _sampler.spec_logits)
+                count = jnp.where(spec_m > 0, a + 1.0, 1.0)
+                idx = (count - 1.0).astype(jnp.int32)
+                # ---- commit: caches write accepted rows into the
+                # ORIGINAL buffers (post-reset), everything else
+                # selects the chain candidate at the accepted count
+                committed = {}
+                for n2 in self.state_names:
+                    if n2 not in cache_keys:
+                        committed[n2] = _spec_mod.commit_select(
+                            [st[n2] for st in t_chain], idx)
+                for kk in self.draft_state_keys:
+                    if kk not in cache_keys:
+                        committed[kk] = _spec_mod.commit_select(
+                            [st[kk] for st in d_chain], idx)
+                if gf_commit is not None:
+                    # both models' caches share one window start: the
+                    # engine feeds the same host pos vector to both
+                    # graphs' pos inputs
+                    base_pos = pos0 if pos0 is not None \
+                        else flat[self._d_feed_pos[self._d_pos]]
+                    cvals = {"__spec_pos__": base_pos,
+                             "__spec_count__": count}
+                    for n2, T in self._spec_cache_t:
+                        cvals["__spec_cache__%s" % n2] = \
+                            flat[self._feed_pos[n2]]
+                        cvals["__spec_rows__%s" % n2] = \
+                            _spec_mod.gather_rows(
+                                [st[n2] for st in t_chain],
+                                base_pos, T)
+                    for kk, T in self._spec_cache_d:
+                        cvals["__spec_cache__%s" % kk] = \
+                            flat[self._d_feed_pos[kk]]
+                        cvals["__spec_rows__%s" % kk] = \
+                            _spec_mod.gather_rows(
+                                [st[kk] for st in d_chain],
+                                base_pos, T)
+                    outs_c, _ = gf_commit(
+                        [cvals[a2] for a2 in commit_args], [], key,
+                        False)
+                    ci = 0
+                    for n2, _T in self._spec_cache_t:
+                        committed[n2] = outs_c[ci]
+                        ci += 1
+                    for kk, _T in self._spec_cache_d:
+                        committed[kk] = outs_c[ci]
+                        ci += 1
+                return ([toks, count]
+                        + [committed[n2] for n2 in self.state_names]
+                        + [committed[kk]
+                           for kk in self.draft_state_keys])
 
         def call(key, tick, reset, *flat):
             self._trace_count += 1      # runs once per XLA trace
@@ -380,13 +647,20 @@ class StepProgram(object):
                 outs = [_sampler.sample(k, outs[0])] + list(outs[1:])
             return outs
 
+        if self._spec is not None:
+            call = call_spec
         donate = ()
         if jax.default_backend() != "cpu":
             # in-place HBM update of the slot pool: the old state
             # buffers are donated to the dispatch (CPU jax cannot
             # honor donation and would warn per compile).  Offsets
-            # skip the (key, tick, reset) leading args.
-            donate = tuple(3 + order.index(n) for n in self.state_names)
+            # skip the (key, tick, reset[, spec]) leading args.
+            lead = 4 if self._spec is not None else 3
+            donate = tuple(lead + order.index(n)
+                           for n in self.state_names)
+            if self._spec is not None:
+                donate += tuple(lead + self._d_feed_pos[kk]
+                                for kk in self.draft_state_keys)
         # the persistent step kernel resolves lazily at the first step
         # when an AOT cache is configured (serving/aot_cache.py): a
         # warm entry deserializes with zero traces — the compiled
@@ -410,6 +684,15 @@ class StepProgram(object):
         if self._aot is not None:
             from .aot_cache import graph_digest
             self._graph_digest = graph_digest(self._serve_sym)
+            if self._spec is not None:
+                # the compiled program is the whole widened step:
+                # target graph x draft graph x commit graph x window
+                # width — all four are program identity (toggling k or
+                # swapping the draft must never hit a stale entry)
+                self._graph_digest = "spec.k%d.%s.%s.%s" % (
+                    self._spec.k, self._graph_digest,
+                    self._spec.draft_digest,
+                    self._spec.commit_digest or "none")
         self._tick = 0          # per-step sample counter (stochastic
         #                         samplers fold it into the key; dead
         #                         and DCE'd under the greedy head)
@@ -448,7 +731,8 @@ class StepProgram(object):
         import jax
         dev = None if self._plan is not None else self._ctx.jax_device()
         out = {}
-        for info in self.state_info:
+        infos = list(self._state_infos())
+        for key, info in infos:
             dt = np.dtype(info.get("dtype") or self._dtype)
             shape = (self.num_slots,) + tuple(info["shape"])
             if self._plan is not None:
@@ -457,12 +741,26 @@ class StepProgram(object):
                 # Built from HOST zeros — a pool sized to fit only
                 # when sharded must never be staged whole on one
                 # device (device_put ships each shard's slice)
-                out[info["name"]] = self._plan.put_state(
+                out[key] = self._plan.put_state(
                     info["name"], np.zeros(shape, dtype=dt))
             else:
-                out[info["name"]] = jax.device_put(
+                out[key] = jax.device_put(
                     self._jnp.zeros(shape, dtype=dt), dev)
         return out
+
+    def _state_infos(self, which="all"):
+        """(engine state key, info) pairs over the requested model
+        half: ``"all"`` (the slot pool's full state set), ``"target"``
+        or ``"draft"``.  Draft states ride the merged dict under
+        prefixed keys so a draft h-state never collides with a target
+        one."""
+        if which in ("all", "target"):
+            for info in self.state_info:
+                yield info["name"], info
+        if self._spec is not None and which in ("all", "draft"):
+            from .spec import _draft_key
+            for info in self._spec.draft_state_info:
+                yield _draft_key(info["name"]), info
 
     def _row_kernel(self, buf, idx, row):
         """The row-scatter kernel for one (buffer, row) signature,
@@ -493,7 +791,7 @@ class StepProgram(object):
             self._row_kernels[sig] = kernel
         return kernel
 
-    def _ensure_kernel(self, reset, flat):
+    def _ensure_kernel(self, reset, flat, spec_m=None):
         """Resolve the persistent step kernel at the first dispatch
         (the argument avals are only concrete here): AOT-cache hit
         loads the serialized program with zero traces; a miss compiles
@@ -504,10 +802,13 @@ class StepProgram(object):
             with self._kernel_lock:
                 if self._kernel is None:
                     from .aot_cache import resolve_kernel
+                    lead = [self._key, np.int32(0), reset]
+                    if spec_m is not None:
+                        lead.append(spec_m)
                     kernel, _src = resolve_kernel(
                         self._aot, self._jit_kernel, "decode_step",
                         self._graph_digest,
-                        [self._key, np.int32(0), reset] + list(flat),
+                        lead + list(flat),
                         donate_argnums=self._donate)
                     self._kernel = kernel
         return self._kernel
@@ -524,13 +825,17 @@ class StepProgram(object):
                 out[name], idx, row)
         return out
 
-    def zero_row(self, states, slot):
+    def zero_row(self, states, slot, which="all"):
         """Zero one slot's rows in every state buffer (a joining
-        request must never inherit the previous occupant's state)."""
+        request must never inherit the previous occupant's state).
+        ``which="draft"`` zeroes only the draft model's rows — the
+        prefill commit path writes REAL target rows but the draft
+        (which never saw the prompt) must start the generation cold,
+        not from a dead request's leftovers."""
         rows = {}
-        for info in self.state_info:
+        for key, info in self._state_infos(which):
             dt = np.dtype(info.get("dtype") or self._dtype)
-            rows[info["name"]] = np.zeros(tuple(info["shape"]), dtype=dt)
+            rows[key] = np.zeros(tuple(info["shape"]), dtype=dt)
         return self.write_row(states, slot, rows)
 
     def step(self, tokens, pos, valid, states, reset=None):
@@ -543,8 +848,24 @@ class StepProgram(object):
         without a single extra device dispatch.  Returns (sampled ids
         as a host float vector, new state dict) — the only
         device->host traffic is the id vector."""
+        if self._spec is not None:
+            raise MXNetError("this StepProgram compiled a speculative "
+                             "draft-k-verify step: dispatch through "
+                             "step_spec()")
         if reset is None:
             reset = np.zeros((self.num_slots,), np.float32)
+        flat = self._build_flat(tokens, pos, valid, states)
+        kernel = self._ensure_kernel(reset, flat)
+        self._tick = (self._tick + 1) & 0x7fffffff
+        outs = kernel(self._key, np.int32(self._tick), reset, *flat)
+        new_states = {name: outs[1 + i]
+                      for i, name in enumerate(self.state_names)}
+        return np.asarray(outs[0]), new_states
+
+    def _build_flat(self, tokens, pos, valid, states):
+        """Assemble the full flat argument vector: params from the
+        template, the shared token/pos/valid host vectors into BOTH
+        models' feed slots, every state buffer at its position."""
         flat = list(self._template)
         flat[self._feed_pos[self.token_name]] = tokens
         if self.pos_name is not None:
@@ -553,12 +874,38 @@ class StepProgram(object):
             flat[self._feed_pos[self.valid_name]] = valid
         for name in self.state_names:
             flat[self._feed_pos[name]] = states[name]
-        kernel = self._ensure_kernel(reset, flat)
+        if self._spec is not None:
+            flat[self._d_feed_pos[self._d_tok]] = tokens
+            if self._d_pos is not None:
+                flat[self._d_feed_pos[self._d_pos]] = pos
+            if self._d_valid is not None:
+                flat[self._d_feed_pos[self._d_valid]] = valid
+            for key in self.draft_state_keys:
+                flat[self._d_feed_pos[key]] = states[key]
+        return flat
+
+    def step_spec(self, tokens, pos, valid, spec, states, reset=None):
+        """One speculative iteration over the whole pool: up to
+        ``k + 1`` tokens commit per slot per dispatch.  ``spec`` marks
+        the slots eligible for speculation (generating, past their
+        prompt) — ineligible slots commit exactly ONE position, the
+        plain step's semantics, so teacher forcing and dead slots ride
+        the wider program unchanged.  Returns ``(tokens, counts,
+        new_states)``: a ``(slots, k+1)`` token matrix, the per-slot
+        committed counts, and the committed state dict."""
+        if self._spec is None:
+            raise MXNetError("step_spec() needs a StepProgram built "
+                             "with a SpecConfig")
+        if reset is None:
+            reset = np.zeros((self.num_slots,), np.float32)
+        flat = self._build_flat(tokens, pos, valid, states)
+        kernel = self._ensure_kernel(reset, flat, spec_m=spec)
         self._tick = (self._tick + 1) & 0x7fffffff
-        outs = kernel(self._key, np.int32(self._tick), reset, *flat)
-        new_states = {name: outs[1 + i]
-                      for i, name in enumerate(self.state_names)}
-        return np.asarray(outs[0]), new_states
+        outs = kernel(self._key, np.int32(self._tick), reset, spec,
+                      *flat)
+        keys = list(self.state_names) + list(self.draft_state_keys)
+        new_states = {key: outs[2 + i] for i, key in enumerate(keys)}
+        return np.asarray(outs[0]), np.asarray(outs[1]), new_states
 
     def probe_step(self):
         """One fixed-key, fixed-tick dispatch over an all-zero scratch
@@ -573,16 +920,14 @@ class StepProgram(object):
         import jax
         z = np.zeros((self.num_slots,), np.float32)
         states = self.init_states()
-        flat = list(self._template)
-        flat[self._feed_pos[self.token_name]] = z
-        if self.pos_name is not None:
-            flat[self._feed_pos[self.pos_name]] = z
-        if self.valid_name is not None:
-            flat[self._feed_pos[self.valid_name]] = z
-        for name in self.state_names:
-            flat[self._feed_pos[name]] = states[name]
-        kernel = self._ensure_kernel(z, flat)
-        outs = kernel(jax.random.PRNGKey(0), np.int32(0), z, *flat)
+        flat = self._build_flat(z, z, z, states)
+        if self._spec is not None:
+            kernel = self._ensure_kernel(z, flat, spec_m=z)
+            outs = kernel(jax.random.PRNGKey(0), np.int32(0), z, z,
+                          *flat)
+        else:
+            kernel = self._ensure_kernel(z, flat)
+            outs = kernel(jax.random.PRNGKey(0), np.int32(0), z, *flat)
         return [np.asarray(o) for o in outs]
 
     def sample_tokens(self, logits):
@@ -736,6 +1081,45 @@ class _DecodeTelemetry(object):
             labelnames=("engine",),
             buckets=_telemetry.LATENCY_S_BUCKETS)
         self.tpot = tpot_fam.labels(engine=self.engine_label)
+        # speculative decode plane (ISSUE 15): counters + per-engine
+        # accept-rate histogram + tokens-per-step gauge, registered
+        # ONLY for spec engines (a k=0 engine's scrape is byte-
+        # identical to the pre-spec engine's) and reclaimed at close
+        self.spec_drafted = None
+        self._spec_fams = ()
+        if getattr(engine, "_spec_k", 0):
+            self.spec_drafted = reg.counter(
+                "mxnet_serve_decode_spec_drafted_total",
+                "draft tokens proposed by speculative decode steps "
+                "(k per spec-eligible slot per dispatch)")
+            self.spec_accepted = reg.counter(
+                "mxnet_serve_decode_spec_accepted_total",
+                "draft tokens ACCEPTED by target verification — the "
+                "tokens that cost one target dispatch for k+1 "
+                "positions instead of one dispatch each")
+            self.spec_rejected = reg.counter(
+                "mxnet_serve_decode_spec_rejected_total",
+                "draft tokens rejected by target verification "
+                "(speculative work thrown away)")
+            spec_accept_fam = reg.histogram(
+                "mxnet_serve_decode_spec_accept_rate",
+                "per-dispatch draft acceptance fraction "
+                "(accepted / drafted over the step's spec-eligible "
+                "slots), per decode engine",
+                labelnames=("engine",),
+                buckets=_telemetry.RATIO_BUCKETS)
+            self.spec_accept = spec_accept_fam.labels(
+                engine=self.engine_label)
+            spec_tps_fam = reg.gauge(
+                "mxnet_serve_decode_spec_tokens_per_step",
+                "mean committed tokens PER SLOT per speculative step "
+                "over the engine lifetime (1.0 = no speculative win; "
+                "the ceiling is k+1 — occupancy does not move this "
+                "number), per decode engine",
+                labelnames=("engine",))
+            self.spec_tps = spec_tps_fam.labels(
+                engine=self.engine_label)
+            self._spec_fams = (spec_accept_fam, spec_tps_fam)
         self.slots_fam = reg.gauge(
             "mxnet_serve_decode_slots",
             "slot-pool capacity per decode engine and device replica",
@@ -775,7 +1159,8 @@ class _DecodeTelemetry(object):
         # shared families aggregate into one fleet view)
         self.aot_fams = aot_metric_families(reg)
         self._engine_gauge_fams = (queue_depth_fam, compile_fam,
-                                   ttft_fam, tpot_fam, replicas_fam)
+                                   ttft_fam, tpot_fam, replicas_fam) \
+            + self._spec_fams
         self._replica_fams = (self.slots_fam, self.occupied_fam,
                               self.step_ms, self.replica_healthy,
                               self.replica_inflight,
@@ -809,6 +1194,14 @@ class _DecodeTelemetry(object):
             self._remove_engine_series()
             return
         self.compile_count.set(eng.compile_count)
+        if self.spec_drafted is not None:
+            # GIL-atomic int reads: a collect-time callback must not
+            # take scheduler locks
+            steps, toks = eng._spec_slot_steps, eng._spec_accepted
+            if steps:
+                # committed tokens per slot per spec step = accepted
+                # drafts + the one target token every step yields
+                self.spec_tps.set((toks + steps) / float(steps))
         el = self.engine_label
         for r in eng._replicas:
             self.slots_fam.labels(engine=el,
@@ -874,7 +1267,10 @@ class DecodeEngine(object):
                  prefill_len_name="plen",
                  max_queue=None, default_deadline_ms=None,
                  overload_policy=None, ctx=None, dtype=np.float32,
-                 start=True, sampler=None, replicas=None, sharding=None):
+                 start=True, sampler=None, replicas=None, sharding=None,
+                 draft_sym=None, draft_arg_params=None,
+                 draft_aux_params=None, draft_state_info=None,
+                 spec_k=None):
         from .. import config
         # chaos plan (serving/faults.py): see ServingEngine
         _faults.ensure_env_plan()
@@ -882,6 +1278,35 @@ class DecodeEngine(object):
             num_slots = config.get("MXNET_DECODE_SLOTS")
         if max_len is None:
             max_len = config.get("MXNET_DECODE_MAX_LEN")
+        # speculative draft-k-verify (ISSUE 15): k > 0 plus a draft
+        # model widens every replica's step program to commit up to
+        # k+1 tokens per slot per dispatch.  0 (the default) is the
+        # single-token engine BYTE-IDENTICAL to the pre-spec code —
+        # same programs, same AOT keys, same scrape — whatever draft
+        # arguments were passed.
+        if spec_k is None:
+            spec_k = config.get("MXNET_DECODE_SPEC_K")
+        spec_k = int(spec_k)
+        if spec_k < 0:
+            raise MXNetError("spec_k must be >= 0, got %d" % spec_k)
+        if spec_k > 0 and draft_sym is None:
+            raise MXNetError(
+                "spec_k=%d needs a draft model: pass draft_sym= (and "
+                "its params/state_info) — speculation verifies a "
+                "cheap draft against the target, there is no draft "
+                "to verify" % spec_k)
+        self._spec_k = spec_k if draft_sym is not None else 0
+        if self._spec_k and sampler is not None and not sampler.greedy \
+                and type(sampler).spec_logits is Sampler.spec_logits:
+            # refuse at construction, like every other spec contract
+            # violation — raising inside the first traced dispatch
+            # would ride the replica-failure path and retire healthy
+            # replicas over a config error
+            raise MXNetError(
+                "speculative decode needs the sampler's verification "
+                "distribution: %s must implement spec_logits() (see "
+                "TemperatureSampler), or use spec_k=0"
+                % type(sampler).__name__)
         if max_queue is None:
             max_queue = config.get("MXNET_SERVE_MAX_QUEUE")
         if default_deadline_ms is None:
@@ -899,23 +1324,70 @@ class DecodeEngine(object):
         self._sampler = sampler if sampler is not None else GreedySampler()
         self.analysis_report = None
         self.step_verdict = None
+        self.draft_verdict = None
         if config.get("MXNET_ANALYSIS_ON"):
-            self._preflight(step_sym, state_info, token_name, pos_name,
-                            valid_name, config.get("MXNET_ANALYSIS_STRICT"))
+            self.step_verdict, self.analysis_report = self._preflight(
+                step_sym, state_info, token_name, pos_name,
+                valid_name, config.get("MXNET_ANALYSIS_STRICT"),
+                what="step")
+            if self._spec_k:
+                # the draft's states ride the SAME slot pool: a cross-
+                # position draft would leak one request's (or a dead
+                # slot's stale) values into a co-resident's proposals
+                # — and through acceptance, into its LATENCY; greedy
+                # content stays exact, but the soundness bar is the
+                # same as the target's
+                self.draft_verdict, _ = self._preflight(
+                    draft_sym, draft_state_info or [], token_name,
+                    pos_name, valid_name,
+                    config.get("MXNET_ANALYSIS_STRICT"), what="draft")
+        if self._spec_k:
+            # head compatibility is NOT an analysis-suite opinion —
+            # it only needs infer_shape, and a mismatched pair emits
+            # garbage tokens silently (take_along_axis clamps under
+            # jit) — so it refuses construction even with
+            # MXNET_ANALYSIS_ON=0
+            self._check_draft_heads(step_sym, draft_sym, state_info,
+                                    draft_state_info or [],
+                                    token_name, pos_name, valid_name)
         # fused-op selection (ISSUE 13): run the optimizer's kernel-
         # selection pipeline over the step graph BEFORE any program is
         # built, so StepProgram serves the optimized graph — the
         # one-hot-blend KV write becomes the O(d) _cache_write_row
         # scatter (ops/cache.py) when the verdict-gated plan accepts.
         # A rejected/crashed plan serves the step exactly as handed in.
+        # With speculation the DRAFT graph rides the same pipeline —
+        # its per-step KV write is as selectable as the target's.
         self.opt_plan = None
         self.selection = None
+        self.draft_opt_plan = None
         if config.get("MXNET_SERVE_OPTIMIZE") \
                 and config.get("MXNET_ANALYSIS_ON") \
                 and config.get("MXNET_OPT_SELECT_KERNELS"):
-            step_sym = self._optimize_step(step_sym, state_info,
-                                           token_name, pos_name,
-                                           valid_name)
+            step_sym, self.opt_plan, self.selection = \
+                self._optimize_step(step_sym, state_info, token_name,
+                                    pos_name, valid_name, what="step")
+            if self._spec_k:
+                draft_sym, self.draft_opt_plan, _dsel = \
+                    self._optimize_step(draft_sym,
+                                        draft_state_info or [],
+                                        token_name, pos_name,
+                                        valid_name, what="draft")
+        # the spec bundle every replica's StepProgram shares: draft
+        # graph/params plus the ONE verdict-gated commit graph (built
+        # here, not per replica — the selection decision is engine
+        # policy, and it rides the AOT validity fingerprint)
+        self._spec_cfg = None
+        if self._spec_k:
+            from .spec import SpecConfig
+            self._spec_cfg = SpecConfig(
+                self._spec_k, draft_sym,
+                draft_arg_params=draft_arg_params,
+                draft_aux_params=draft_aux_params,
+                draft_state_info=draft_state_info,
+                token_name=token_name, pos_name=pos_name,
+                valid_name=valid_name)
+            self._spec_cfg.build(self.num_slots, state_info, dtype)
         # model-parallel decode (ROADMAP item 1): the plan spec is
         # verdict-gated on the step graph's slot-axis row-locality —
         # a plan partitioning the slot axis of a cross-position (or
@@ -923,8 +1395,16 @@ class DecodeEngine(object):
         # exactly like every rewrite.  Param/state tensor-parallel
         # rules are placement-only and never gated.
         from ..analysis.sharding import gate_plan_spec
+        # sharded plans gate the WIDER step like any program: with
+        # speculation the compiled step contains both models, so a
+        # slot-partitioning plan needs BOTH slot verdicts row-local
+        # (either unproven/cross-position verdict fails the gate)
+        gate_verdict = self.step_verdict
+        if self._spec_k and gate_verdict == "row-local" \
+                and self.draft_verdict != "row-local":
+            gate_verdict = self.draft_verdict
         self.sharding_check, self._sharding_spec = gate_plan_spec(
-            sharding, {"slot": self.step_verdict}, "decode",
+            sharding, {"slot": gate_verdict}, "decode",
             "DecodeEngine")
         self._prefill_data_name = prefill_data_name
         self._prefill_len_name = prefill_len_name
@@ -978,6 +1458,35 @@ class DecodeEngine(object):
         from .aot_cache import AOTCache
         sampler_fp = {k: v for k, v in self._sampler.describe().items()
                       if k != "seed"}
+        # spec policy rides the KEY (cross-k and cross-draft hits are
+        # impossible by address) AND the validity fingerprint (below):
+        # graph-invariant entries — prefill buckets, universal
+        # row-scatter kernels — share one key across spec regimes, so
+        # only the fingerprint protects them, and it must: toggling k
+        # or swapping drafts REJECTS those entries (alertable "cold
+        # start that should have been warm"), never serves a program
+        # compiled under different spec conclusions.  Both components
+        # are OMITTED when spec is off, so a pre-spec cache volume
+        # stays warm across this upgrade.
+        artifact = {"kind": "decode",
+                    "step_verdict": self.step_verdict,
+                    "selection": self.selection,
+                    "optimizer": {
+                        "accepted": (bool(self.opt_plan.accepted)
+                                     if self.opt_plan is not None
+                                     else None),
+                        "nodes_before": (self.opt_plan.nodes_before
+                                         if self.opt_plan is not None
+                                         else None),
+                        "nodes_after": (self.opt_plan.nodes_after
+                                        if self.opt_plan is not None
+                                        else None)}}
+        key_extra = {"engine_kind": "decode", "sampler": sampler_fp}
+        if self._spec_cfg is not None:
+            artifact["spec"] = dict(self._spec_cfg.describe(),
+                                    draft_verdict=self.draft_verdict)
+            key_extra["spec"] = {"k": self._spec_cfg.k,
+                                 "draft": self._spec_cfg.draft_digest}
         # the fused-op selection outcome rides the validity FINGERPRINT
         # (not the key): flipping MXNET_OPT_SELECT_KERNELS between
         # restarts moves the fingerprint, so every entry the previous
@@ -989,20 +1498,8 @@ class DecodeEngine(object):
         # universal row-scatter kernels) are only protected by the
         # fingerprint (tests/test_decode_fastpath.py pins the reject)
         self._aot = AOTCache.from_config(
-            artifact={"kind": "decode",
-                      "step_verdict": self.step_verdict,
-                      "selection": self.selection,
-                      "optimizer": {
-                          "accepted": (bool(self.opt_plan.accepted)
-                                       if self.opt_plan is not None
-                                       else None),
-                          "nodes_before": (self.opt_plan.nodes_before
-                                           if self.opt_plan is not None
-                                           else None),
-                          "nodes_after": (self.opt_plan.nodes_after
-                                          if self.opt_plan is not None
-                                          else None)}},
-            key_extra={"engine_kind": "decode", "sampler": sampler_fp},
+            artifact=artifact,
+            key_extra=key_extra,
             # plan spec = the key's sharding component (residual b2):
             # sharded and unsharded step programs (or two plans) can
             # never hit each other's entries; same-plan replicas share
@@ -1052,6 +1549,11 @@ class DecodeEngine(object):
         self._evictions = 0
         self._tokens_out = 0
         self._requests_served = 0
+        self._spec_steps = 0        # dispatches with >=1 spec slot
+        self._spec_slot_steps = 0   # per-slot spec steps (the
+        #                             tokens-per-step denominator)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         self._abort = False
         # history/alerting plane (engine.py has the full story): the
         # scheduler loop stamps a heartbeat, the engine registers for
@@ -1134,7 +1636,7 @@ class DecodeEngine(object):
                            valid_name=c["valid_name"],
                            ctx=rctx, dtype=c["dtype"],
                            sampler=self._sampler, aot=self._aot,
-                           plan=plan)
+                           plan=plan, spec=self._spec_cfg)
         rep = DecodeReplica(index, rctx, prog, plan=plan)
         prefill_sym = c["prefill_sym"]
         if prefill_sym is not None:
@@ -1182,12 +1684,14 @@ class DecodeEngine(object):
 
     # ---------------------------------------------------------- preflight
     def _preflight(self, step_sym, state_info, token_name, pos_name,
-                   valid_name, strict):
+                   valid_name, strict, what="step"):
         """Construction-time soundness lint: the masked step must be
         row-local along the SLOT axis with state seeded pad-dirty
         (analysis.check_decode_step) — a cross-position step would let
         one request's (or a dead slot's stale) values bleed into a
-        co-resident request's tokens."""
+        co-resident request's tokens.  Runs over the target step AND
+        (speculative engines) the draft graph — both ride the same
+        slot pool.  Returns (verdict, report)."""
         from ..analysis import check_decode_step, AnalysisError
         n = self.num_slots
         arg_names = set(step_sym.list_arguments())
@@ -1202,35 +1706,70 @@ class DecodeEngine(object):
         verdict, report = check_decode_step(
             step_sym, shapes, state_names=state_names,
             valid_name=valid_name if valid_name in arg_names else None)
-        self.analysis_report = report
-        self.step_verdict = verdict
         if report.errors:
             if strict:
                 report.raise_if_errors()
-            warnings.warn("DecodeEngine: step-graph verification "
-                          "failed:\n%s" % report.format())
-            return
+            warnings.warn("DecodeEngine: %s-graph verification "
+                          "failed:\n%s" % (what, report.format()))
+            return verdict, report
         if verdict == "cross-position":
             detail = "\n".join("  " + str(d) for d in report.warnings) \
                 or "  (see report)"
-            msg = ("[padding] DecodeEngine: step graph is cross-"
+            msg = ("[padding] DecodeEngine: %s graph is cross-"
                    "position along the SLOT axis — co-resident "
                    "requests (and stale state in freed slots) would "
-                   "contaminate each other's tokens:\n%s" % detail)
+                   "contaminate each other's tokens:\n%s"
+                   % (what, detail))
             if strict:
                 raise AnalysisError(msg)
             warnings.warn(msg + "\ncontinuing because "
                           "MXNET_ANALYSIS_STRICT=0; decoded output "
                           "WILL differ from single-request decode")
+        return verdict, report
+
+    def _check_draft_heads(self, step_sym, draft_sym, state_info,
+                           draft_state_info, token_name, pos_name,
+                           valid_name):
+        """Draft-compatibility contract: the two heads must score the
+        SAME vocabulary — acceptance compares the draft's proposal
+        against the target's distribution index-for-index, so a vocab
+        (or logits-rank) mismatch produces garbage comparisons, not an
+        error, and must be refused at construction."""
+        def logits_shape(sym_, infos):
+            n = self.num_slots
+            arg_names = set(sym_.list_arguments())
+            shapes = {token_name: (n,)}
+            for info in infos:
+                shapes[info["name"]] = (n,) + tuple(info["shape"])
+            for extra in (pos_name, valid_name):
+                if extra in arg_names:
+                    shapes[extra] = (n,)
+            _a, out, _x = sym_.infer_shape(**shapes)
+            return tuple(out[0])
+        try:
+            t_shape = logits_shape(step_sym, state_info)
+            d_shape = logits_shape(draft_sym, draft_state_info)
+        except Exception as e:
+            warnings.warn("DecodeEngine: cannot infer draft/target "
+                          "head shapes (%r); the head-compatibility "
+                          "check is skipped" % (e,))
+            return
+        if t_shape != d_shape:
+            raise MXNetError(
+                "speculative decode: target head scores %s but the "
+                "draft head scores %s — draft and target must share "
+                "one vocabulary (and logits layout) for acceptance "
+                "to compare them" % (t_shape, d_shape))
 
     def _optimize_step(self, step_sym, state_info, token_name, pos_name,
-                       valid_name):
+                       valid_name, what="step"):
         """Run the kernel-selection optimizer pipeline
         (``analysis.SELECT_OPT_PASSES``) over the step graph under the
         SAME spec the preflight lint uses — slot-pool shapes, slot
         padded axis, state inputs seeded pad-DIRTY — so a selection is
         adopted only via an accepted verdict-gated OptPlan: re-analysis
-        no worse, slot-axis row-locality preserved.  Returns the graph
+        no worse, slot-axis row-locality preserved.  Returns
+        ``(graph, plan, selection)`` where the graph is what
         StepProgram should compile (the input graph verbatim on
         rejection or crash)."""
         from ..analysis import optimize_graph, SELECT_OPT_PASSES
@@ -1257,24 +1796,23 @@ class DecodeEngine(object):
                 pad_dirty=tuple(state_names),
                 passes=SELECT_OPT_PASSES)
         except Exception as e:    # optimizer crash must never block
-            warnings.warn("DecodeEngine: step-graph optimization "
-                          "crashed (%r); serving the unmodified step"
-                          % (e,))
-            return step_sym
-        self.opt_plan = plan
+            warnings.warn("DecodeEngine: %s-graph optimization "
+                          "crashed (%r); serving the unmodified graph"
+                          % (what, e))
+            return step_sym, None, None
         if plan.accepted and plan.symbol is not None and plan.rewrites:
             # the fingerprint-visible selection summary: which fused
             # kernels the accepted plan swapped in, and where
-            self.selection = [{"op": "_cache_write_row",
-                               "site": a.node}
-                              for a in plan.actions
-                              if a.kind == "select"]
-            return plan.symbol
+            selection = [{"op": "_cache_write_row",
+                          "site": a.node}
+                         for a in plan.actions
+                         if a.kind == "select"]
+            return plan.symbol, plan, selection
         if not plan.accepted:
-            warnings.warn("DecodeEngine: step-graph optimization "
-                          "rejected (%s); serving the unmodified step"
-                          % plan.reason)
-        return step_sym
+            warnings.warn("DecodeEngine: %s-graph optimization "
+                          "rejected (%s); serving the unmodified graph"
+                          % (what, plan.reason))
+        return step_sym, plan, None
 
     # ---------------------------------------------------------- lifecycle
     def start(self):
@@ -1431,8 +1969,14 @@ class DecodeEngine(object):
                 lambda f, _req=req: self._emit_done(_req, f))
         # padded-element cost for the regulator's cost-aware shed: a
         # decode request prices as its bucketed prompt plus the
-        # positions its generation budget can occupy
-        req.cost = int(_next_pow2(len(prompt)) + max_new_tokens)
+        # positions its generation budget can occupy.  Under
+        # speculative decode every generated token costs up to k+1
+        # TARGET positions (the verify window scores the whole draft
+        # whatever gets accepted), so the width multiplies the
+        # generation half — the regulator's cost ordering and the
+        # admission-time padded-element accounting stay honest.
+        req.cost = int(_next_pow2(len(prompt))
+                       + max_new_tokens * (self._spec_k + 1))
         # a deadline hit — queued or mid-generation — COMPLETES the
         # request with whatever was generated (admission._deliver
         # routes DeadlineExceededError through this instead of failing)
@@ -1546,6 +2090,7 @@ class DecodeEngine(object):
                 rep.tokens_np.fill(0.0)
                 rep.pos_np.fill(0.0)
                 rep.reset_np.fill(0.0)
+                rep.spec_np.fill(0.0)
 
     # ------------------------------------------------------------- router
     def _router_run(self):
@@ -1845,6 +2390,7 @@ class DecodeEngine(object):
             rep.pos_np = fresh.pos_np
             rep.valid_np = fresh.valid_np
             rep.reset_np = fresh.reset_np
+            rep.spec_np = fresh.spec_np
             rep.states = fresh.states
             rep.pending.clear()
             rep.in_step = False
@@ -1913,6 +2459,10 @@ class DecodeEngine(object):
                 rep.tokens_np[slot] = req.prompt[0]
                 rep.pos_np[slot] = 0.0
                 req.prompt_i = 1
+                # spec eligibility starts with the FIRST sampling step
+                # — the one that consumes the last prompt token
+                rep.spec_np[slot] = (1.0 if req.prompt_i
+                                     >= len(req.prompt) else 0.0)
         for req in seated:
             if req.slot is not None and rep.slots[req.slot] is req:
                 self._check_finish(rep, req.slot)
@@ -1934,6 +2484,7 @@ class DecodeEngine(object):
         req.t_join = time.perf_counter()
         rep.slots[slot] = req
         rep.valid_np[slot] = 1.0
+        rep.spec_np[slot] = 0.0
         with self._lock:
             self._joins += 1
         if self._tm is not None:
@@ -1949,6 +2500,7 @@ class DecodeEngine(object):
         if slot is not None and rep.slots[slot] is req:
             rep.slots[slot] = None
             rep.valid_np[slot] = 0.0
+            rep.spec_np[slot] = 0.0
         with self._lock:
             self._leaves += 1
         if self._tm is not None:
@@ -2010,6 +2562,15 @@ class DecodeEngine(object):
         traced-index kernel per state shape — never a new compile)."""
         slot = req.slot
         rep.states = rep.program.write_row(rep.states, slot, rows)
+        if self._spec_k:
+            # the prefill graph produced TARGET rows only; the draft
+            # never saw this prompt, and the previous occupant's draft
+            # rows must not leak into its proposals — start it cold.
+            # (Draft quality only moves the accept RATE; acceptance
+            # keeps the emitted stream exact regardless.)
+            rep.states = rep.program.zero_row(rep.states, slot,
+                                              which="draft")
+            rep.spec_np[slot] = 1.0
         rep.reset_np[slot] = 0.0        # prefill rows are live data
         req.prompt_i = len(req.prompt)
         req.tokens.append(int(first))
@@ -2103,42 +2664,50 @@ class DecodeEngine(object):
             # real step-failure path (partial-output eviction +
             # re-route); a hang wedges the pool for the watchdog
             _faults.trip("decode.step", replica=rep.label)
-        sampled, rep.states = rep.program.step(
-            rep.tokens_np, rep.pos_np, rep.valid_np, rep.states,
-            reset=rep.reset_np)
-        rep.reset_np.fill(0.0)          # consumed: rows are zeroed now
-        # one C-level conversion instead of num_slots ndarray-scalar
-        # __getitem__ calls: the slot loop below is the scheduler's
-        # per-step GIL cost, and with replica routing two of these
-        # loops interleave on the host — every microsecond here is
-        # paid per step per replica
-        sampled_l = sampled.tolist()
-        new_tokens = 0
-        t_tok = time.monotonic()        # one stamp serves every slot
-        for i in occ:
-            req = rep.slots[i]
-            req.n_steps += 1
-            rep.pos_np[i] += 1.0
-            if req.prompt_i < len(req.prompt):
-                # teacher forcing: the sample is discarded, the next
-                # prompt token rides the next step
-                rep.tokens_np[i] = req.prompt[req.prompt_i]
-                req.prompt_i += 1
-            else:
-                tok = sampled_l[i]
-                req.tokens.append(int(tok))
-                rep.tokens_np[i] = tok
-                new_tokens += 1
-                if req.t_first_tok is None:
-                    req.t_first_tok = t_tok
-                    if self._tm is not None:
-                        self._tm.ttft.observe(t_tok - req.t_enqueue)
-                req.t_last_tok = t_tok
-                self._emit_token(req, tok)
-                if req.on_token is not None \
-                        and not self._fire_on_token(rep, req, tok):
-                    continue        # evicted by its own callback
-            self._check_finish(rep, i)
+        if self._spec_k:
+            toks_mat, counts, rep.states = rep.program.step_spec(
+                rep.tokens_np, rep.pos_np, rep.valid_np, rep.spec_np,
+                rep.states, reset=rep.reset_np)
+            rep.reset_np.fill(0.0)
+            new_tokens = self._advance_spec(rep, occ, toks_mat, counts)
+        else:
+            sampled, rep.states = rep.program.step(
+                rep.tokens_np, rep.pos_np, rep.valid_np, rep.states,
+                reset=rep.reset_np)
+            rep.reset_np.fill(0.0)      # consumed: rows are zeroed now
+            # one C-level conversion instead of num_slots
+            # ndarray-scalar __getitem__ calls: the slot loop below is
+            # the scheduler's per-step GIL cost, and with replica
+            # routing two of these loops interleave on the host —
+            # every microsecond here is paid per step per replica
+            sampled_l = sampled.tolist()
+            new_tokens = 0
+            t_tok = time.monotonic()    # one stamp serves every slot
+            for i in occ:
+                req = rep.slots[i]
+                req.n_steps += 1
+                rep.pos_np[i] += 1.0
+                if req.prompt_i < len(req.prompt):
+                    # teacher forcing: the sample is discarded, the
+                    # next prompt token rides the next step
+                    rep.tokens_np[i] = req.prompt[req.prompt_i]
+                    req.prompt_i += 1
+                else:
+                    tok = sampled_l[i]
+                    req.tokens.append(int(tok))
+                    rep.tokens_np[i] = tok
+                    new_tokens += 1
+                    if req.t_first_tok is None:
+                        req.t_first_tok = t_tok
+                        if self._tm is not None:
+                            self._tm.ttft.observe(t_tok
+                                                  - req.t_enqueue)
+                    req.t_last_tok = t_tok
+                    self._emit_token(req, tok)
+                    if req.on_token is not None \
+                            and not self._fire_on_token(rep, req, tok):
+                        continue    # evicted by its own callback
+                self._check_finish(rep, i)
         dt_ms = (time.perf_counter() - t0) * 1e3
         with self._lock:
             self._steps += 1
@@ -2149,6 +2718,82 @@ class DecodeEngine(object):
             if new_tokens:
                 self._tm.tokens.inc(new_tokens)
             rep.tm_step_ms.observe(dt_ms)
+
+    def _advance_spec(self, rep, occ, toks_mat, counts):
+        """The variable-width slot advance (ISSUE 15): slot ``i``
+        committed ``counts[i]`` positions this dispatch and
+        ``toks_mat[i, :counts[i]]`` holds its accepted tokens in
+        generation order — the exact ``greedy_decode`` prefix under
+        the greedy sampler.  Emission truncates at eos / max_new /
+        max_len (a truncated slot always FINISHES, so positions the
+        program committed past the truncation point free with the
+        slot); ``on_token`` and the SSE stream fire once per accepted
+        token, in order, exactly like the single-token loop."""
+        toks_l = toks_mat.tolist()
+        counts_l = counts.tolist()
+        new_tokens = 0
+        drafted = accepted = spec_slots = 0
+        t_tok = time.monotonic()
+        for i in occ:
+            req = rep.slots[i]
+            req.n_steps += 1
+            if req.prompt_i < len(req.prompt):
+                # teacher forcing: the program committed ONE position
+                # (spec mask 0) — both models consumed the staged
+                # prompt token; stage the next one
+                rep.pos_np[i] += 1.0
+                rep.tokens_np[i] = req.prompt[req.prompt_i]
+                req.prompt_i += 1
+                if req.prompt_i >= len(req.prompt):
+                    rep.spec_np[i] = 1.0
+                self._check_finish(rep, i)
+                continue
+            c = int(counts_l[i])
+            spec_slots += 1
+            drafted += self._spec_k
+            accepted += c - 1
+            cap = min(c, req.max_new - len(req.tokens),
+                      self.max_len - int(rep.pos_np[i]))
+            rep.pos_np[i] += float(c)
+            evicted = False
+            last = None
+            for jj in range(cap):
+                tok = int(toks_l[i][jj])
+                req.tokens.append(tok)
+                new_tokens += 1
+                if req.t_first_tok is None:
+                    req.t_first_tok = t_tok
+                    if self._tm is not None:
+                        self._tm.ttft.observe(t_tok - req.t_enqueue)
+                req.t_last_tok = t_tok
+                self._emit_token(req, tok)
+                if req.on_token is not None \
+                        and not self._fire_on_token(rep, req, tok):
+                    evicted = True
+                    break
+                last = tok
+                if self.eos_id is not None and tok == self.eos_id:
+                    break
+            if evicted:
+                continue
+            if last is not None:
+                rep.tokens_np[i] = float(last)
+            self._check_finish(rep, i)
+        if spec_slots:
+            with self._lock:
+                self._spec_steps += 1
+                self._spec_slot_steps += spec_slots
+                self._spec_drafted += drafted
+                self._spec_accepted += accepted
+            if self._tm is not None and self._tm.spec_drafted \
+                    is not None:
+                self._tm.spec_drafted.inc(drafted)
+                self._tm.spec_accepted.inc(accepted)
+                self._tm.spec_rejected.inc(drafted - accepted)
+                if drafted:
+                    self._tm.spec_accept.observe(accepted
+                                                 / float(drafted))
+        return new_tokens
 
     def _check_finish(self, rep, slot):
         req = rep.slots[slot]
@@ -2173,6 +2818,7 @@ class DecodeEngine(object):
         rep.valid_np[slot] = 0.0
         rep.tokens_np[slot] = 0.0
         rep.pos_np[slot] = 0.0
+        rep.spec_np[slot] = 0.0
         now = time.monotonic()
         t1 = time.perf_counter()
         res = DecodeResult(req.tokens, reason, n_steps=req.n_steps,
@@ -2243,12 +2889,19 @@ class DecodeEngine(object):
         prog = rep.program
         states = prog.init_states()
         states = prog.zero_row(states, 0)
-        _, states = prog.step(z, z, z, states)
-        _, states = prog.step(z, z, z, states)
+        if self._spec_k:
+            _t, _c, states = prog.step_spec(z, z, z, z, states)
+            _t, _c, states = prog.step_spec(z, z, z, z, states)
+        else:
+            _, states = prog.step(z, z, z, states)
+            _, states = prog.step(z, z, z, states)
         rows = {}
-        for info in prog.state_info:
+        # ALL states — the prefill path also scatters draft rows
+        # (zero_row which="draft") into STEPPED buffers, and their
+        # per-sharding row kernels must be warm too
+        for key, info in prog._state_infos():
             dt = np.dtype(info.get("dtype") or prog._dtype)
-            rows[info["name"]] = np.zeros(tuple(info["shape"]), dt)
+            rows[key] = np.zeros(tuple(info["shape"]), dt)
         prog.write_row(states, 0, rows)
         for b in rep.prefill_buckets:
             # the full (batch, prompt) bucket grid: coalesced prefill
@@ -2272,6 +2925,39 @@ class DecodeEngine(object):
                     seen.add(id(cache))
                     c += cache.compile_count
         return c
+
+    def _spec_stats(self):
+        """The ``stats()["decode"]["spec"]`` block — caller holds
+        ``self._lock``.  ``accept_rate`` is lifetime accepted/drafted;
+        ``tokens_per_step`` counts committed tokens per SLOT per
+        speculative step (accepted drafts + the one target token
+        every per-slot step yields; 1.0 floor, k+1 ceiling) — the
+        same numbers the spec telemetry series carry."""
+        if not self._spec_k:
+            return {"enabled": False, "k": 0}
+        drafted = self._spec_drafted
+        steps = self._spec_steps
+        return {
+            "enabled": True,
+            "k": self._spec_k,
+            "draft_verdict": self.draft_verdict,
+            "steps": steps,
+            "drafted": drafted,
+            "accepted": self._spec_accepted,
+            "rejected": drafted - self._spec_accepted,
+            "accept_rate": (self._spec_accepted / float(drafted)
+                            if drafted else None),
+            "tokens_per_step": ((self._spec_accepted
+                                 + self._spec_slot_steps)
+                                / float(self._spec_slot_steps)
+                                if self._spec_slot_steps else None),
+            "commit_selection": self._spec_cfg.selection,
+            "commit_accepted": (bool(self._spec_cfg.commit_plan
+                                     .accepted)
+                                if self._spec_cfg.commit_plan
+                                is not None else None),
+            "draft_digest": self._spec_cfg.draft_digest,
+        }
 
     def stats(self):
         """Admission counters plus the ``decode`` block: slot-pool
@@ -2316,6 +3002,7 @@ class DecodeEngine(object):
                                if self.opt_plan is not None else None),
                     "selection": self.selection,
                 },
+                "spec": self._spec_stats(),
                 "step_ms": {
                     "count": len(step),
                     "mean": float(np.mean(step)) if step else 0.0,

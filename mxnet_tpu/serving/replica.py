@@ -305,7 +305,8 @@ class DecodeReplica(object):
     __slots__ = ("index", "label", "ctx", "plan", "program",
                  "prefill_caches",
                  "prefill_buckets", "slots", "tokens_np", "pos_np",
-                 "valid_np", "reset_np", "states", "pending", "healthy",
+                 "valid_np", "reset_np", "spec_np", "states", "pending",
+                 "healthy",
                  "accepting", "in_step", "probations", "hb_t", "thread",
                  "tm_step_ms", "tm_failures")
 
@@ -329,6 +330,11 @@ class DecodeReplica(object):
         self.pos_np = np.zeros((n,), np.float32)
         self.valid_np = np.zeros((n,), np.float32)
         self.reset_np = np.zeros((n,), np.float32)
+        # speculative eligibility mask (ISSUE 15): 1 while a slot is
+        # generating past its prompt — ineligible slots commit exactly
+        # one position per spec step.  Allocated unconditionally (one
+        # float per slot); non-spec programs never read it.
+        self.spec_np = np.zeros((n,), np.float32)
         self.states = program.init_states()
         self.pending = collections.deque()      # routed DecodeRequests
         self.healthy = True

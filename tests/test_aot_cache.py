@@ -306,6 +306,28 @@ def test_entry_key_anatomy(tmp_path):
                   artifact={"verdicts": {"seq": "row-local"}})
     assert k0 == c4.entry_key("serve", g, args)
     assert cache.fingerprint() != c4.fingerprint()
+    # the speculative policy component (ISSUE 15): k and the draft
+    # digest each move the key; its ABSENCE equals the pre-spec key,
+    # so a pre-spec cache volume stays warm across the upgrade
+    s1 = AOTCache(str(tmp_path),
+                  key_extra={"max_batch": 8,
+                             "spec": {"k": 2, "draft": "d1"}})
+    s_k = AOTCache(str(tmp_path),
+                   key_extra={"max_batch": 8,
+                              "spec": {"k": 4, "draft": "d1"}})
+    s_d = AOTCache(str(tmp_path),
+                   key_extra={"max_batch": 8,
+                              "spec": {"k": 2, "draft": "d2"}})
+    ks1 = s1.entry_key("decode_step", g, args)
+    assert ks1 != cache.entry_key("decode_step", g, args)   # present
+    assert ks1 != s_k.entry_key("decode_step", g, args)     # k
+    assert ks1 != s_d.entry_key("decode_step", g, args)     # draft
+    assert ks1 == AOTCache(
+        str(tmp_path),
+        key_extra={"max_batch": 8,
+                   "spec": {"k": 2, "draft": "d1"}},
+        artifact={"spec": {"k": 2}}).entry_key(
+            "decode_step", g, args)     # artifact still not keyed
 
 
 def test_concurrent_writers_racing_same_keys(cache_dir):

@@ -1,0 +1,692 @@
+"""Speculative draft-k-verify decode tests (ISSUE 15:
+serving/spec.py + the DecodeEngine spec mode + _cache_write_rows).
+
+Coverage per the issue contract: the multi-token scatter op bitwise
+against the masked-blend chain it replaces (XLA fallback AND the
+Pallas kernel via interpret mode, edge positions/counts, f16), the
+verdict-gated ``_cache_write_rows`` selection on the commit graph
+(adopted via an accepted OptPlan; a rejected plan serves the blends,
+still bitwise), greedy speculative decode at k in {2, 4}
+bitwise-identical to ``greedy_decode`` AND to the k=0 engine over
+staggered joins with compile counters pinned across churn, every
+accept-path edge — 0-accepted (pure target fallback), all-k-accepted,
+mid-generation deadline eviction landing inside a speculative window
+(partial output = exact greedy prefix), a raising ``on_token``
+evicting only its own request — temperature rejection sampling with
+bitwise seeded replays and the top_k=1 == greedy anchor, spec-width
+request pricing for the regulator, spec telemetry series reclaimed at
+close, the AOT spec policy (warm restart 0 compiles; toggling k
+rejects graph-invariant entries; ``tools/aot_cache.py list`` renders
+the component), the ``graph_lint --decode-step --draft`` pair audit,
+and the ``decode_bench --spec`` smoke.
+"""
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.ops import invoke_jax
+from mxnet_tpu.serving import (DecodeEngine, StepProgram, greedy_decode,
+                               TemperatureSampler)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from test_decode import _attn_step, _lstm_step, _sum_state_model  # noqa: E402
+
+
+def _import_tool(name):
+    path = os.path.join(REPO, "tools", "%s.py" % name)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_MODELS = {}
+
+
+def _cached(builder, seed=0, cache=True):
+    """Build a test model ONCE per (builder, seed) — graph node names
+    come from the process-wide NameManager counter, so engines that
+    must share AOT entries (warm-restart tests) must share the SAME
+    graph object, exactly like a real restart reloading one
+    checkpoint.  Positional KV caches (the rank-2 per-slot buffers,
+    (max_len, d)) are declared ``cache: True``; LSTM h/c recurrent
+    states stay undeclared and ride the always-correct select-commit
+    path."""
+    key = (builder, seed, cache)
+    if key not in _MODELS:
+        step, params, state_info = builder(seed=seed)
+        if cache:
+            for si in state_info:
+                if len(si["shape"]) >= 2:
+                    si["cache"] = True
+        _MODELS[key] = (step, params, state_info)
+    return _MODELS[key]
+
+
+def _spec_engine(k, draft_seed=0, builder=_attn_step, max_len=16,
+                 num_slots=4, cache=True, **kw):
+    step, params, state_info = _cached(builder, cache=cache)
+    draft, dparams, dstate = _cached(builder, seed=draft_seed,
+                                     cache=cache)
+    eng = DecodeEngine(step, params, {}, state_info,
+                       num_slots=num_slots, max_len=max_len,
+                       default_deadline_ms=kw.pop("default_deadline_ms",
+                                                  0),
+                       draft_sym=draft, draft_arg_params=dparams,
+                       draft_state_info=dstate, spec_k=k, **kw)
+    return eng, (step, params, state_info)
+
+
+# ---------------------------------------------------------------------------
+# the widened scatter op
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float16],
+                         ids=["f32", "f16"])
+def test_write_rows_bitwise_vs_masked_blend_chain(dtype):
+    """out[i, pos[i]+j] = rows[i, j] for j < count[i] must equal the
+    count-masked one-hot blend chain bitwise — including count 0 (pure
+    pass-through), full count K, and windows STRADDLING the cache end
+    (an out-of-range one-hot row is all zero, so the blend drops the
+    write; the op must drop it too, never clamp-overwrite row T-1)."""
+    import jax.numpy as jnp
+    n, T, K, d = 4, 16, 3, 8
+    rng = np.random.default_rng(11)
+    cache = rng.standard_normal((n, T, d)).astype(dtype)
+    rows = rng.standard_normal((n, K, d)).astype(dtype)
+    pos = np.asarray([0, 5, 13, 15], np.float32)   # 15+j overshoots
+    cnt = np.asarray([0, 3, 1, 3], np.float32)
+    out = np.asarray(invoke_jax(
+        "_cache_write_rows", {}, jnp.asarray(cache), jnp.asarray(rows),
+        jnp.asarray(pos), jnp.asarray(cnt))[0])
+    blend = cache.astype(np.float32)
+    for j in range(K):
+        oh = np.zeros((n, T), np.float32)
+        m = (cnt > j).astype(np.float32)
+        pj = pos.astype(int) + j
+        ok = (pj >= 0) & (pj < T)            # OOR one-hot = all zero
+        oh[np.arange(n)[ok], pj[ok]] = 1.0
+        ohm = (oh * m[:, None])[:, :, None]
+        blend = blend * (1 - ohm) + rows[:, j][:, None, :] * ohm
+    assert out.dtype == np.dtype(dtype)
+    assert out.tobytes() == blend.astype(dtype).tobytes()
+
+
+def test_write_rows_pallas_interpret_matches_xla(monkeypatch):
+    """MXNET_CACHE_SCATTER_IMPL=interpret runs the widened Pallas
+    kernel in interpreter mode on CPU — CI's bitwise pin of the TPU
+    kernel against the dynamic_update_slice fallback, including the
+    clamped-overshoot positions (ascending-j last-writer-wins on both
+    impls)."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(3)
+    cache = rng.standard_normal((5, 12, 6)).astype(np.float32)
+    rows = rng.standard_normal((5, 4, 6)).astype(np.float32)
+    pos = np.asarray([0, 9, 11, 4, 8], np.float32)   # 9+3, 11+j clamp
+    cnt = np.asarray([4, 4, 2, 0, 4], np.float32)
+    outs = {}
+    for mode in ("interpret", "xla"):
+        monkeypatch.setenv("MXNET_CACHE_SCATTER_IMPL", mode)
+        outs[mode] = np.asarray(invoke_jax(
+            "_cache_write_rows", {}, jnp.asarray(cache),
+            jnp.asarray(rows), jnp.asarray(pos), jnp.asarray(cnt))[0])
+    assert outs["interpret"].tobytes() == outs["xla"].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# verdict-gated commit selection
+# ---------------------------------------------------------------------------
+
+def test_commit_selection_accepted_and_bitwise():
+    """The select pass swaps the whole masked-blend chain for ONE
+    _cache_write_rows per cache state, the slot verdict stays
+    row-local under pad-dirty seeding, FLOPs drop, and the optimized
+    commit graph executes bitwise-identically to the blends."""
+    import jax.numpy as jnp
+    from mxnet_tpu.analysis import optimize_graph, SELECT_OPT_PASSES
+    from mxnet_tpu.executor import build_graph_fn
+    from mxnet_tpu.serving.spec import build_commit_sym
+    from mxnet_tpu.symbol.symbol import _topo
+    specs = [("kc", (4, 16, 8), np.float32),
+             ("vc", (4, 16, 8), np.float32)]
+    sym, shapes, cn, rn = build_commit_sym(specs, 3)
+    plan = optimize_graph(sym, data_shapes=shapes,
+                          pad_axes={"slot": {n: 0 for n in shapes}},
+                          pad_dirty=tuple(cn) + tuple(rn),
+                          passes=SELECT_OPT_PASSES)
+    assert plan.accepted, plan.reason
+    sels = [a for a in plan.actions if a.kind == "select"]
+    assert len(sels) == 2
+    assert plan.verdicts_after.get("slot") == "row-local"
+    ops = [x.op.name for x in _topo(plan.symbol._outputs)
+           if x.op is not None]
+    assert ops.count("_cache_write_rows") == 2
+    assert "one_hot" not in ops
+    delta = plan.flops_delta()
+    assert delta is not None and delta[1] < delta[0]
+    rng = np.random.default_rng(1)
+    # slot 2's window straddles the cache end (15 + j >= 16): the
+    # blends drop those writes and the scatter must agree bitwise
+    feed = {"__spec_pos__": np.asarray([0, 5, 15, 2], np.float32),
+            "__spec_count__": np.asarray([0, 1, 3, 2], np.float32)}
+    for nm in ("kc", "vc"):
+        feed["__spec_cache__" + nm] = rng.standard_normal(
+            (4, 16, 8)).astype(np.float32)
+        feed["__spec_rows__" + nm] = rng.standard_normal(
+            (4, 3, 8)).astype(np.float32)
+    outs = {}
+    for tag, s in (("blend", sym), ("op", plan.symbol)):
+        args = s.list_arguments()
+        gf = build_graph_fn(s, args, [])
+        o, _ = gf([jnp.asarray(feed[a]) for a in args], [], None,
+                  False)
+        outs[tag] = [np.asarray(x).tobytes() for x in o]
+    assert outs["blend"] == outs["op"]
+
+
+def test_commit_selection_rejected_serves_blends(monkeypatch):
+    """With the op's padding rule deleted the candidate re-analysis
+    cannot prove the scatter row-local: the plan REJECTS and the spec
+    engine serves the blend-chain commit — still bitwise vs
+    greedy_decode (the chain is the same math)."""
+    from mxnet_tpu.analysis import padding as _padding
+    monkeypatch.delitem(_padding._HANDLERS, "_cache_write_rows")
+    with pytest.warns(UserWarning, match="rejected"):
+        eng, (step, params, state_info) = _spec_engine(2)
+    st = eng.stats()["decode"]["spec"]
+    assert st["commit_accepted"] is False
+    assert st["commit_selection"] == []
+    eng.warmup()
+    got = eng.generate([1, 2], max_new_tokens=6, timeout=120)
+    eng.close()
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    assert np.array_equal(got.tokens,
+                          greedy_decode(ref, [1, 2], 6, max_len=16))
+
+
+# ---------------------------------------------------------------------------
+# greedy spec decode: bitwise, pinned compiles, accept-path edges
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [2, 4], ids=["k2", "k4"])
+@pytest.mark.parametrize("builder", [_attn_step, _lstm_step],
+                         ids=["attention", "lstm"])
+def test_greedy_spec_bitwise_vs_greedy_decode(builder, k):
+    """The signature acceptance protocol: whatever the draft proposes
+    (an unrelated-weights draft here — mostly rejected), speculative
+    greedy output is BITWISE-identical to greedy_decode and to the
+    k=0 engine, over staggered joins, with the compile counter pinned
+    across churn."""
+    max_len = 16 if builder is _attn_step else 32
+    eng, (step, params, state_info) = _spec_engine(
+        k, draft_seed=9, builder=builder, max_len=max_len)
+    c0 = eng.warmup()
+    assert c0 > 0
+    prompts = [[1, 2], [3], [5, 1, 4], [2, 2], [7], [1, 1, 1, 1]]
+    futs = []
+    for i, p in enumerate(prompts):      # burst + stagger mix
+        futs.append(eng.submit(p, max_new_tokens=8))
+        if i % 3 == 2:
+            time.sleep(0.003)
+    res = [f.result(timeout=180) for f in futs]
+    assert eng.compile_count == c0       # pinned across churn
+    st = eng.stats()["decode"]["spec"]
+    assert st["enabled"] and st["k"] == k
+    assert st["drafted"] > 0
+    eng.close()
+
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    base = DecodeEngine(step, params, {}, state_info, num_slots=4,
+                        max_len=max_len, default_deadline_ms=0)
+    base.warmup()
+    base_res = [base.submit(p, max_new_tokens=8).result(timeout=180)
+                for p in prompts]
+    base.close()
+    for p, r, b in zip(prompts, res, base_res):
+        want = greedy_decode(ref, p, 8, max_len=max_len)
+        assert np.array_equal(r.tokens, want), (p, r.tokens, want)
+        assert np.array_equal(r.tokens, b.tokens)
+
+
+def test_all_k_accepted_and_zero_accepted_edges():
+    """A draft with the TARGET's own weights accepts every proposal
+    (drafted == accepted, k+1 tokens per step); an unrelated draft is
+    mostly rejected (0-accept steps = pure target fallback) — both
+    bitwise vs greedy_decode."""
+    outs = {}
+    for tag, dseed in (("self", 0), ("random", 9)):
+        eng, (step, params, state_info) = _spec_engine(
+            2, draft_seed=dseed)
+        eng.warmup()
+        futs = [eng.submit(p, max_new_tokens=8)
+                for p in ([1, 2], [3], [5, 1, 4])]
+        outs[tag] = [list(f.result(timeout=180).tokens) for f in futs]
+        st = eng.stats()["decode"]["spec"]
+        if tag == "self":
+            # identical weights: exact prefix match accepts all k
+            assert st["accepted"] == st["drafted"] > 0
+            assert st["accept_rate"] == 1.0
+            assert st["tokens_per_step"] == 3.0
+        else:
+            # unrelated weights: most proposals rejected (the pure
+            # target fallback path runs), some may land by chance
+            assert st["rejected"] > 0
+            assert st["accept_rate"] < 0.5
+        eng.close()
+    step, params, state_info = _cached(_attn_step)
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    want = [list(greedy_decode(ref, p, 8, max_len=16))
+            for p in ([1, 2], [3], [5, 1, 4])]
+    assert outs["self"] == want
+    assert outs["random"] == want
+
+
+def test_deadline_eviction_inside_spec_window():
+    """A mid-generation deadline landing inside a speculative step
+    evicts with PARTIAL output that is an exact greedy prefix."""
+    eng, (step, params, state_info) = _spec_engine(
+        4, builder=_lstm_step, max_len=512, num_slots=2)
+    eng.warmup()
+    fut = eng.submit([1], max_new_tokens=400, deadline_ms=25)
+    res = fut.result(timeout=120)
+    eng.close()
+    assert res.finish_reason == "deadline" and res.expired
+    assert len(res.tokens) < 400
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    want = greedy_decode(ref, [1], 400, max_len=512)
+    assert np.array_equal(res.tokens, want[:len(res.tokens)])
+
+
+def test_raising_on_token_evicts_only_its_own_request():
+    """A raising streaming callback mid-spec-window evicts ONLY its
+    request; co-residents keep their exact greedy output."""
+    eng, (step, params, state_info) = _spec_engine(
+        2, builder=_lstm_step, max_len=64, num_slots=4)
+    eng.warmup()
+
+    class Boom(RuntimeError):
+        pass
+
+    got = []
+
+    def bad(tok):
+        got.append(tok)
+        if len(got) >= 3:
+            raise Boom("stream consumer gone")
+
+    doomed = eng.submit([1], max_new_tokens=20, on_token=bad)
+    others = [eng.submit([t], max_new_tokens=8) for t in (2, 3, 4)]
+    with pytest.raises(Boom):
+        doomed.result(timeout=120)
+    res = [f.result(timeout=120) for f in others]
+    eng.close()
+    assert len(got) == 3                  # stopped at the raise
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    for t, r in zip((2, 3, 4), res):
+        assert r.finish_reason == "length"
+        assert np.array_equal(r.tokens,
+                              greedy_decode(ref, [t], 8, max_len=64))
+
+
+def test_on_token_and_sse_order_is_exact_prefix():
+    """Per-accepted-token streaming: the callback sees each committed
+    token in generation order — the exact final DecodeResult.tokens —
+    even when a step commits several at once (self-draft: every step
+    commits k+1)."""
+    eng, _models = _spec_engine(2, draft_seed=0)
+    eng.warmup()
+    seen = {}
+    futs = []
+    for i, p in enumerate([[1, 2], [3], [4, 5, 6]]):
+        seen[i] = []
+        futs.append(eng.submit(p, max_new_tokens=6,
+                               on_token=seen[i].append))
+    res = [f.result(timeout=180) for f in futs]
+    st = eng.stats()["decode"]["spec"]
+    eng.close()
+    assert st["accept_rate"] == 1.0       # multi-token steps happened
+    for i, r in enumerate(res):
+        assert seen[i] == [int(t) for t in r.tokens]
+
+
+def test_prefill_engine_with_spec_bitwise():
+    """Bucketed (coalesced) prefill + speculation: the draft starts
+    COLD after a prefill join (it never saw the prompt) and output is
+    still exact — acceptance gates content, draft context only moves
+    the accept rate."""
+    step, prefill, params, state_info = _sum_state_model()
+    draft, _dp, dparams, dstate = _sum_state_model(seed=3)
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=4,
+                       max_len=32, prefill_sym=prefill, max_queue=32,
+                       default_deadline_ms=0, draft_sym=draft,
+                       draft_arg_params=dparams,
+                       draft_state_info=dstate, spec_k=2)
+    c0 = eng.warmup()
+    prompts = [[1, 2, 3], [4], [5, 6], [7, 8, 9, 10], [2], [3, 1]]
+    futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    res = [f.result(timeout=180) for f in futs]
+    assert eng.compile_count == c0
+    eng.close()
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    for p, r in zip(prompts, res):
+        assert np.array_equal(r.tokens,
+                              greedy_decode(ref, p, 6, max_len=32))
+
+
+# ---------------------------------------------------------------------------
+# stochastic sampling
+# ---------------------------------------------------------------------------
+
+def test_temperature_spec_seeded_replay_bitwise():
+    """Rejection sampling rides the engine's per-step key stream: the
+    same seed + same submission history replays bitwise."""
+    def run():
+        eng, _m = _spec_engine(
+            2, draft_seed=7,
+            sampler=TemperatureSampler(0.8, seed=11))
+        eng.warmup()
+        outs = [list(eng.generate(p, max_new_tokens=6,
+                                  timeout=180).tokens)
+                for p in ([1, 2], [3], [5, 1])]
+        eng.close()
+        return outs
+    assert run() == run()
+
+
+def test_temperature_topk1_equals_greedy():
+    """top_k=1 degenerates rejection sampling to exact argmax: the
+    proposal is accepted iff it IS the target argmax, and every
+    fallback draw is the argmax — the spec output equals
+    greedy_decode."""
+    eng, (step, params, state_info) = _spec_engine(
+        2, draft_seed=9, sampler=TemperatureSampler(0.7, top_k=1,
+                                                    seed=3))
+    eng.warmup()
+    outs = [list(eng.generate(p, max_new_tokens=6, timeout=180).tokens)
+            for p in ([1, 2], [3], [5, 1])]
+    eng.close()
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    want = [list(greedy_decode(ref, p, 6, max_len=16))
+            for p in ([1, 2], [3], [5, 1])]
+    assert outs == want
+
+
+# ---------------------------------------------------------------------------
+# engine contract: off-is-identical, validation, cost, telemetry
+# ---------------------------------------------------------------------------
+
+def test_spec_off_is_byte_identical_and_env_knob(monkeypatch):
+    """spec_k=0 (or unset) ignores the draft arguments entirely: same
+    programs, same AOT policy, no spec stats, no spec series; the env
+    knob wires DecodeEngine construction."""
+    step, params, state_info = _cached(_attn_step)
+    draft, dparams, dstate = _cached(_attn_step, seed=9)
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                       max_len=16, default_deadline_ms=0,
+                       draft_sym=draft, draft_arg_params=dparams,
+                       draft_state_info=dstate, spec_k=0, start=False)
+    assert eng._spec_k == 0
+    assert eng.stats()["decode"]["spec"] == {"enabled": False, "k": 0}
+    assert eng._replicas[0].program._spec is None
+    eng.close(drain=False)
+    monkeypatch.setenv("MXNET_DECODE_SPEC_K", "3")
+    eng2 = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                        max_len=16, default_deadline_ms=0,
+                        draft_sym=draft, draft_arg_params=dparams,
+                        draft_state_info=dstate, start=False)
+    assert eng2._spec_k == 3
+    eng2.close(drain=False)
+    # k > 0 without a draft is a hard error, not silent non-speculation
+    with pytest.raises(mx.MXNetError, match="draft"):
+        DecodeEngine(step, params, {}, state_info, num_slots=2,
+                     max_len=16, spec_k=2, start=False)
+
+
+def test_incompatible_draft_head_refused():
+    """A draft scoring a different vocabulary must refuse
+    construction: acceptance would compare garbage indices.  So must
+    a stochastic sampler with no verification distribution — raising
+    inside the first traced dispatch would ride the replica-failure
+    path and retire healthy replicas over a config error."""
+    step, params, state_info = _cached(_attn_step)
+    draft, dparams, dstate = _attn_step(vocab=8, seed=1)
+    with pytest.raises(mx.MXNetError, match="vocab"):
+        DecodeEngine(step, params, {}, state_info, num_slots=2,
+                     max_len=16, draft_sym=draft,
+                     draft_arg_params=dparams, draft_state_info=dstate,
+                     spec_k=2, start=False)
+
+    from mxnet_tpu.serving import Sampler
+
+    class NoDist(Sampler):
+        def sample(self, key, logits):      # pragma: no cover
+            return logits[:, 0]
+
+    good, gparams, gstate = _cached(_attn_step, seed=1)
+    with pytest.raises(mx.MXNetError, match="spec_logits"):
+        DecodeEngine(step, params, {}, state_info, num_slots=2,
+                     max_len=16, draft_sym=good,
+                     draft_arg_params=gparams, draft_state_info=gstate,
+                     spec_k=2, sampler=NoDist(), start=False)
+
+
+def test_request_cost_priced_with_spec_width():
+    """Satellite: Request.cost prices the k+1 target positions per
+    generated token, so the regulator's cost-aware shed ordering sees
+    speculative requests at their true padded-element weight."""
+    from mxnet_tpu.serving.buckets import _next_pow2
+    costs = {}
+    for k in (0, 2):
+        if k:
+            eng, _m = _spec_engine(k, draft_seed=0, start=False)
+        else:
+            step, params, state_info = _cached(_attn_step)
+            eng = DecodeEngine(step, params, {}, state_info,
+                               num_slots=2, max_len=16,
+                               default_deadline_ms=0, start=False)
+        fut = eng.submit([1, 2, 3], max_new_tokens=6)
+        req = eng._adm._queue[0]
+        costs[k] = req.cost
+        fut.cancel()
+        eng.close(drain=False)
+    assert costs[0] == _next_pow2(3) + 6
+    assert costs[2] == _next_pow2(3) + 6 * 3
+
+
+def test_spec_telemetry_series_and_reclaim():
+    """The spec plane — drafted/accepted/rejected counters, the
+    accept-rate histogram, the tokens-per-step gauge — carries the
+    stats() numbers, is engine-labeled, and is reclaimed at close()
+    (reload loops cannot grow scrapes); a k=0 engine registers NONE
+    of it."""
+    base_names = {"mxnet_serve_decode_spec_drafted_total",
+                  "mxnet_serve_decode_spec_accept_rate",
+                  "mxnet_serve_decode_spec_tokens_per_step"}
+
+    def snap():
+        doc = telemetry.registry().collect()
+        return {n: doc[n]["series"] for n in base_names if n in doc}
+
+    doc0 = snap()       # the counters are shared across engines:
+    drafted0 = (doc0["mxnet_serve_decode_spec_drafted_total"][0]
+                ["value"]
+                if "mxnet_serve_decode_spec_drafted_total" in doc0
+                else 0)
+    eng, _m = _spec_engine(2, draft_seed=0)
+    eng.warmup()
+    for p in ([1, 2], [3]):
+        eng.generate(p, max_new_tokens=6, timeout=180)
+    st = eng.stats()["decode"]["spec"]
+    label = eng._tm.engine_label
+    doc = snap()
+    drafted = doc["mxnet_serve_decode_spec_drafted_total"][0]["value"]
+    assert drafted - drafted0 == st["drafted"] > 0
+    tps = [s["value"]
+           for s in doc["mxnet_serve_decode_spec_tokens_per_step"]
+           if s["labels"].get("engine") == label]
+    assert tps and tps[0] == pytest.approx(st["tokens_per_step"])
+    hist = [s for s in doc["mxnet_serve_decode_spec_accept_rate"]
+            if s["labels"].get("engine") == label]
+    assert hist and hist[0]["count"] == st["steps"]
+    eng.close()
+    after = snap()
+    for name in ("mxnet_serve_decode_spec_accept_rate",
+                 "mxnet_serve_decode_spec_tokens_per_step"):
+        assert not [s for s in after.get(name, ())
+                    if s["labels"].get("engine") == label], after
+
+
+# ---------------------------------------------------------------------------
+# AOT: spec policy in the key, draft digest in the fingerprint
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "aot")
+    monkeypatch.setenv("MXNET_AOT_CACHE_DIR", d)
+    monkeypatch.setenv("MXNET_AOT_CACHE", "1")
+    return d
+
+
+def test_spec_warm_restart_zero_compiles(cache_dir):
+    """A restarted spec engine draws the wider step AND the row
+    kernels from the AOT cache: ZERO compiles, bitwise tokens."""
+    eng, _m = _spec_engine(2, draft_seed=7)
+    eng.warmup()
+    ref = list(eng.generate([1, 2], max_new_tokens=6,
+                            timeout=180).tokens)
+    assert eng.compile_count > 0
+    eng.close()
+    e2, _m2 = _spec_engine(2, draft_seed=7)
+    e2.warmup()
+    got = list(e2.generate([1, 2], max_new_tokens=6,
+                           timeout=180).tokens)
+    st = e2.stats()["decode"]["aot"]
+    assert e2.compile_count == 0
+    assert st["hits"] > 0 and st["rejects"] == 0
+    e2.close()
+    assert got == ref
+
+
+def test_spec_toggle_rejects_graph_invariant_entries(cache_dir):
+    """Toggling k (or swapping drafts) moves the validity
+    fingerprint: graph-invariant entries (universal row kernels) are
+    REJECTED — never loaded as hits — and spec-keyed programs miss by
+    address, so nothing stale ever serves."""
+    eng, _m = _spec_engine(2, draft_seed=7)
+    eng.warmup()
+    assert eng.stats()["decode"]["aot"]["writes"] > 0
+    eng.close()
+    with pytest.warns(UserWarning, match="unusable"):
+        e2, (step, params, state_info) = _spec_engine(4, draft_seed=7)
+        e2.warmup()
+    st = e2.stats()["decode"]["aot"]
+    assert st["rejects"] > 0
+    assert st["hits"] == 0
+    assert e2.compile_count > 0           # recompiled fresh
+    got = e2.generate([1, 2], max_new_tokens=6, timeout=180)
+    e2.close()
+    ref = StepProgram(step, params, {}, state_info, num_slots=1)
+    assert np.array_equal(got.tokens,
+                          greedy_decode(ref, [1, 2], 6, max_len=16))
+
+
+def test_aot_cache_list_renders_spec_component(cache_dir, capsys):
+    """Satellite: ``tools/aot_cache.py list`` shows the spec policy
+    (k + draft digest prefix) in text and --json; non-spec entries
+    render '-' (the component is absent from their keys)."""
+    eng, _m = _spec_engine(2, draft_seed=7)
+    eng.warmup()
+    digest = eng._spec_cfg.draft_digest
+    eng.close()
+    tool = _import_tool("aot_cache")
+    assert tool.main(["--dir", cache_dir, "list", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    specs = [e["spec"] for e in doc["entries"]]
+    tagged = [s for s in specs if s != "-"]
+    assert tagged and all(
+        s == "k=2|draft=%s" % digest[:8] for s in tagged)
+    # universal row kernels key WITHOUT engine policy: rendered "-"
+    assert "-" in specs
+    assert tool.main(["--dir", cache_dir, "list"]) == 0
+    txt = capsys.readouterr().out
+    assert "k=2|draft=%s" % digest[:8] in txt
+
+
+# ---------------------------------------------------------------------------
+# CLI + bench smokes
+# ---------------------------------------------------------------------------
+
+def test_graph_lint_audits_draft_pair(tmp_path, capsys):
+    step, _p, _s = _attn_step()
+    draft, _p2, _s2 = _attn_step(seed=9)
+    bad_draft, _p3, _s3 = _attn_step(vocab=8, seed=1)
+    tpath = str(tmp_path / "target.json")
+    dpath = str(tmp_path / "draft.json")
+    bpath = str(tmp_path / "bad.json")
+    step.save(tpath)
+    draft.save(dpath)
+    bad_draft.save(bpath)
+    lint = _import_tool("graph_lint")
+    shapes = ["--shapes", "token=4", "--shapes", "pos=4",
+              "--shapes", "k_cache=4,16,8", "--shapes",
+              "v_cache=4,16,8"]
+    dshapes = ["--draft-shapes", "token=4", "--draft-shapes", "pos=4",
+               "--draft-shapes", "k_cache=4,16,8", "--draft-shapes",
+               "v_cache=4,16,8"]
+    rc = lint.main([tpath, "--decode-step", "--json",
+                    *shapes, "--decode-state", "k_cache,v_cache",
+                    "--draft", dpath, *dshapes,
+                    "--draft-state", "k_cache,v_cache",
+                    "--decode-cache", "k_cache,v_cache",
+                    "--draft-cache", "k_cache,v_cache",
+                    "--spec-k", "2"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    audit = doc["graphs"][tpath]["spec"]
+    assert audit["draft_verdicts"]["slot"] == "row-local"
+    assert audit["head"]["compatible"] is True
+    sels = audit["selections"]
+    assert len(sels) == 4                 # 2 target + 2 draft caches
+    assert all(s["op"] == "_cache_write_rows"
+               and s["verdict"] == "accepted" for s in sels)
+    # an incompatible head FAILS the run (the engine would refuse);
+    # shrinking to one cache also shows selection stays advisory
+    rc2 = lint.main([tpath, "--decode-step", "--json",
+                     *shapes, "--decode-state", "k_cache,v_cache",
+                     "--draft", bpath,
+                     "--draft-shapes", "token=4",
+                     "--draft-shapes", "pos=4",
+                     "--draft-shapes", "k_cache=4,16,8",
+                     "--draft-shapes", "v_cache=4,16,8",
+                     "--draft-state", "k_cache,v_cache"])
+    doc2 = json.loads(capsys.readouterr().out)
+    assert rc2 == 1
+    assert doc2["graphs"][tpath]["spec"]["head"]["compatible"] is False
+
+
+def test_spec_bench_smoke():
+    """Fast smoke of decode_bench --spec: the HARD gates (bitwise vs
+    greedy_decode and the k=0 engine, 0 retraces, warm AOT restart 0
+    compiles) asserted here; recorded BENCH_spec timings stay
+    advisory per the host-noise protocol."""
+    sys.path.insert(0, os.path.join(REPO, "perf"))
+    import decode_bench
+    row = decode_bench.run_spec_sweep(
+        requests=6, slots=4, max_len=32, mean_new=5, layers=2,
+        spec_ks=(2,), repeats=1, tail_scale=0.01)
+    assert row["bitwise_identical"]
+    assert sum(row["retraces"].values()) == 0
+    assert row["aot_warm_compiles"] == 0
+    s = row["spec"]["k2"]
+    assert s["accept_rate"] is not None and s["tokens_per_step"] >= 1.0
+    assert s["commit_selection"] and \
+        set(s["commit_selection"]) == {"_cache_write_rows"}

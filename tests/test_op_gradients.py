@@ -181,6 +181,9 @@ SPECS["_scatter_set_nd"] = S(lambda: [_u(2), np.array([[0, 3]])],
                              {"shape": (6,)}, wrt=[0])
 SPECS["_cache_write_row"] = S(
     lambda: [_u(3, 5, 2), _u(3, 2), np.array([0., 4., 2.])], wrt=[0, 1])
+SPECS["_cache_write_rows"] = S(
+    lambda: [_u(3, 5, 2), _u(3, 2, 2), np.array([0., 3., 2.]),
+             np.array([0., 2., 1.])], wrt=[0, 1])
 SPECS["Embedding"] = S(lambda: [np.array([0., 2., 1.]), _u(4, 3)],
                        {"input_dim": 4, "output_dim": 3}, wrt=[1])
 

@@ -96,6 +96,19 @@ def _fmt_sharding(meta):
     return "|".join(bits)
 
 
+def _fmt_spec(meta):
+    """Compact render of the entry key's speculative-decode policy
+    component (ISSUE 15): ``k=<k>|draft=<digest prefix>`` for entries
+    written by a draft-k-verify engine, ``-`` otherwise (the
+    component is OMITTED from non-spec keys, so pre-spec volumes stay
+    warm — an absent component and a k=0 engine are the same key)."""
+    sp = ((meta or {}).get("policy") or {}).get("spec")
+    if not isinstance(sp, dict):
+        return "-"
+    draft = str(sp.get("draft") or "?")[:8]
+    return "k=%s|draft=%s" % (sp.get("k", "?"), draft)
+
+
 def cmd_list(args):
     d = _dir_from(args)
     now = time.time()
@@ -109,6 +122,9 @@ def cmd_list(args):
             "signature": _fmt_sig(meta),
             "sharding": _fmt_sharding(meta),
             "sharding_spec": (meta or {}).get("sharding", "none"),
+            "spec": _fmt_spec(meta),
+            "spec_policy": ((meta or {}).get("policy") or {})
+            .get("spec"),
             "platform": ((meta or {}).get("fingerprint") or {})
             .get("device_kind", "?"),
             "age_s": round(now - (meta or {}).get("created", now), 1),
@@ -122,11 +138,12 @@ def cmd_list(args):
         return 0
     w = max(len(r["kind"]) for r in rows)
     ws = max(len(r["sharding"]) for r in rows)
+    wp = max(len(r["spec"]) for r in rows)
     for r in rows:
-        print("%s  %-*s  %-10s  %-*s  age %8.1fs  %8d B  %s"
+        print("%s  %-*s  %-10s  %-*s  %-*s  age %8.1fs  %8d B  %s"
               % (r["key"][:16], w, r["kind"], r["platform"],
-                 ws, r["sharding"], r["age_s"], r["size"],
-                 r["signature"]))
+                 ws, r["sharding"], wp, r["spec"], r["age_s"],
+                 r["size"], r["signature"]))
     print("%d entr%s, %.1f KiB payload total"
           % (len(rows), "y" if len(rows) == 1 else "ies",
              total / 1024.0))

@@ -209,6 +209,43 @@ def main(argv=None):
                     help="with --decode-step: name of the slot-"
                          "occupancy/valid vector input, if the step "
                          "graph masks on one")
+    ap.add_argument("--draft", default=None, metavar="JSON",
+                    help="with --decode-step: audit a speculative "
+                         "draft/target PAIR (serving/spec.py, "
+                         "MXNET_DECODE_SPEC_K): the draft symbol "
+                         "JSON is linted through the same slot-axis "
+                         "classifier (its states ride the same pool), "
+                         "the two heads are checked for vocabulary/"
+                         "layout compatibility (a mismatch means "
+                         "DecodeEngine would refuse construction: "
+                         "exit 1, like a cross-position draft "
+                         "verdict), and the report carries the "
+                         "would-be _cache_write_rows commit selection "
+                         "for the declared cache states — the "
+                         "selection half is ADVISORY and never moves "
+                         "the exit code, exactly like the single-row "
+                         "selection report")
+    ap.add_argument("--draft-shapes", action="append",
+                    metavar="NAME=D0,D1,..",
+                    help="with --draft: the draft graph's input "
+                         "shapes (full slot-pool shapes, like "
+                         "--shapes; repeatable)")
+    ap.add_argument("--draft-state", default="", metavar="N1,N2,..",
+                    help="with --draft: comma list of the draft "
+                         "graph's slot-state input names")
+    ap.add_argument("--spec-k", type=int, default=2, metavar="K",
+                    help="with --draft: speculative window width the "
+                         "commit-selection audit assumes (default 2; "
+                         "the engine knob is MXNET_DECODE_SPEC_K)")
+    ap.add_argument("--decode-cache", default="", metavar="N1,N2,..",
+                    help="with --draft: target state names declared "
+                         "cache-like ({'cache': True} in state_info: "
+                         "the step writes exactly row pos[i] per "
+                         "token) — the states the multi-token commit "
+                         "audit builds its graph over")
+    ap.add_argument("--draft-cache", default="", metavar="N1,N2,..",
+                    help="with --draft: the draft graph's cache-like "
+                         "state names")
     ap.add_argument("--sharding-plan", default=None, metavar="JSON",
                     help="audit a model-parallel ShardingPlan spec "
                          "(parallel/mesh.py; inline JSON or a file "
@@ -306,9 +343,16 @@ def main(argv=None):
                                          shapes)
                 if not plan_audit["accepted"]:
                     failed = True
+            draft_audit = None
+            if args.draft is not None and not hard:
+                draft_audit, draft_bad = _audit_draft_pair(
+                    analysis, graph, shapes, args)
+                if draft_bad:
+                    failed = True
             doc[spec] = {"findings": report.to_list(),
                          "verdicts": {"slot": verdict}, "repairs": [],
                          "selections": selections,
+                         "spec": draft_audit,
                          "sharding_plan": plan_audit}
             if not args.as_json and (failed or not args.quiet):
                 print("== %s ==" % spec)
@@ -317,6 +361,7 @@ def main(argv=None):
                 for s in selections:
                     print("  fused-op selection: %s at %s (%s)"
                           % (s["op"], s["site"], s["verdict"]))
+                _print_draft_audit(draft_audit)
                 _print_plan_audit(plan_audit)
                 if unsound:
                     print("  FAIL: step graph is cross-position along "
@@ -425,6 +470,139 @@ def _print_plan_audit(audit):
         print("    %s reaches %d node(s): %s" % (src, len(nodes), show))
     for r in audit["reasons"]:
         print("    FAIL: %s" % r)
+
+
+def _head_dtype(analysis, graph, shapes):
+    """The inferred dtype of a graph's first output (the logits
+    head), via the shape/dtype abstract interpreter."""
+    _report, ctx = analysis.analyze(graph, data_shapes=shapes,
+                                    passes=("verify", "shapes"))
+    n0, i0 = graph._outputs[0]
+    dt = ctx.node_dtypes.get((id(n0), i0))
+    return str(dt) if dt is not None else None
+
+
+def _audit_draft_pair(analysis, target, shapes, args):
+    """--draft: the offline audit of a speculative draft/target pair
+    (serving/spec.py).  Checks the things DecodeEngine checks at
+    construction — the draft's own slot-axis verdict (its states ride
+    the same pool) and head compatibility (same vocabulary, same
+    logits layout, same dtype) — plus the ADVISORY would-be
+    ``_cache_write_rows`` commit selection over the declared cache
+    states.  Returns ``(audit dict, failed)``: a cross-position/
+    unverifiable draft or an incompatible head fails the run (the
+    engine would refuse or mis-serve), the selection report never
+    does."""
+    out = {"draft": args.draft, "k": args.spec_k}
+    bad = False
+    from mxnet_tpu import symbol as sym_mod
+    try:
+        draft = sym_mod.load(args.draft)
+    except Exception as e:
+        return {"draft": args.draft,
+                "error": "cannot load draft: %s" % e}, True
+    try:
+        dshapes = _parse_shapes(args.draft_shapes)
+    except Exception as e:
+        return {"draft": args.draft, "error": str(e)}, True
+    d_states = [s.strip() for s in args.draft_state.split(",")
+                if s.strip()]
+    dverdict, dreport = analysis.check_decode_step(
+        draft, dshapes, state_names=d_states,
+        valid_name=args.decode_valid
+        if args.decode_valid in draft.list_arguments() else None,
+        training=args.training)
+    out["draft_verdicts"] = {"slot": dverdict}
+    out["draft_findings"] = dreport.to_list()
+    if dreport.errors or dverdict != "row-local":
+        bad = True
+    # head compatibility: acceptance compares draft proposals against
+    # the target distribution index-for-index.  The shape (vocab +
+    # layout) check mirrors the engine's construction gate and FAILS
+    # the run; the dtype comparison is reported but ADVISORY — the
+    # accept logic casts both heads, so mixed precision serves (the
+    # engine accepts it), it just merits an operator's look.
+    head = {}
+    try:
+        _a, t_out, _x = target.infer_shape(**shapes)
+        _a2, d_out, _x2 = draft.infer_shape(**dshapes)
+        head["target"] = list(t_out[0])
+        head["draft"] = list(d_out[0])
+        head["target_dtype"] = _head_dtype(analysis, target, shapes)
+        head["draft_dtype"] = _head_dtype(analysis, draft, dshapes)
+        head["dtype_match"] = (head["target_dtype"]
+                               == head["draft_dtype"])
+        head["compatible"] = tuple(t_out[0]) == tuple(d_out[0])
+    except Exception as e:
+        head["error"] = str(e)
+        head["compatible"] = None
+    out["head"] = head
+    if head.get("compatible") is False:
+        bad = True
+    # would-be multi-token commit selection (ADVISORY by the same
+    # contract as the single-row selection report)
+    t_cache = [s.strip() for s in args.decode_cache.split(",")
+               if s.strip()]
+    d_cache = [s.strip() for s in args.draft_cache.split(",")
+               if s.strip()]
+    unshaped = [n for n in t_cache if n not in shapes] \
+        + ["draft:" + n for n in d_cache if n not in dshapes]
+    if unshaped:
+        # a typo'd cache name must not silently shrink the audit to
+        # an empty selection report ("the optimizer selects nothing"
+        # is a conclusion, not a shrug)
+        out["error"] = ("cache state(s) %s have no --shapes/"
+                        "--draft-shapes entry" % unshaped)
+        return out, True
+    specs = [(n, tuple(shapes[n]), "float32") for n in t_cache]
+    specs += [("draft:" + n, tuple(dshapes[n]), "float32")
+              for n in d_cache]
+    sels = []
+    if specs:
+        try:
+            from mxnet_tpu.serving.spec import (build_commit_sym,
+                                                select_commit)
+            commit, cshapes, cn, rn = build_commit_sym(
+                specs, args.spec_k + 1)
+            # the SAME gated selection the engine runs (one
+            # implementation, serving/spec.py)
+            _served, _sel, plan = select_commit(commit, cshapes, cn,
+                                                rn)
+            v = "accepted" if plan.accepted \
+                else "rejected: %s" % plan.reason
+            sels = [{"op": "_cache_write_rows", "site": a.node,
+                     "verdict": v, "detail": a.detail}
+                    for a in plan.actions if a.kind == "select"]
+        except Exception as e:
+            sels = [{"op": None, "site": None,
+                     "verdict": "error: %s" % e}]
+    out["selections"] = sels
+    return out, bad
+
+
+def _print_draft_audit(audit):
+    if audit is None:
+        return
+    if audit.get("error"):
+        print("  draft audit FAILED: %s" % audit["error"])
+        return
+    print("  draft %s (k=%d): slot axis %s"
+          % (audit["draft"], audit["k"],
+             audit["draft_verdicts"]["slot"]))
+    head = audit.get("head") or {}
+    if head.get("compatible") is None:
+        print("    head compatibility: unknown (%s)"
+              % head.get("error"))
+    else:
+        print("    head compatibility: %s (target %s %s, draft %s %s%s)"
+              % ("OK" if head["compatible"] else "FAIL",
+                 head.get("target"), head.get("target_dtype"),
+                 head.get("draft"), head.get("draft_dtype"),
+                 "" if head.get("dtype_match")
+                 else "; dtype differs — served with casts, advisory"))
+    for s in audit.get("selections") or ():
+        print("    would-be commit selection: %s at %s (%s)"
+              % (s["op"], s["site"], s["verdict"]))
 
 
 def _decode_selections(analysis, graph, shapes, state_names,
