@@ -23,7 +23,12 @@ Pass families (``DEFAULT_PASSES`` order):
   constant each axis's pad slots are known to hold (padding.py);
 - ``flops``   — analytic per-op FLOP counting over the abstract
   interpreter's per-node concrete shapes: the live MFU gauge's
-  numerator, cross-checked against XLA ``cost_analysis`` (flops.py).
+  numerator, cross-checked against XLA ``cost_analysis`` (flops.py);
+- ``memory``  — static memory planner: liveness/last-use per entry,
+  linear-scan peak-HBM watermark (sharding-aware), donation/aliasing
+  soundness gate, in-place opportunity report — the engines' OOM
+  preflight, cross-checked against XLA ``memory_analysis``
+  (memory.py).
 
 Verdicts drive rewrites, not just diagnostics: ``rewrite.py`` consumes
 the padding pass's structured violations and splices valid-length-
@@ -61,6 +66,9 @@ from .shapes import ShapeDtypePass
 from .retrace import RetraceHazardPass
 from .padding import PaddingSoundnessPass, classify_padding, PadViolation
 from .flops import FlopsPass, count_flops
+from .memory import (MemoryPass, DonationCheck, plan_memory,
+                     predict_peak_bytes, check_donation,
+                     device_memory_budget)
 from .rewrite import RepairPlan, plan_repair, repair_serving_graph
 from .optimize import (OptPlan, OptAction, optimize_graph,
                        register_opt_pass, DEFAULT_OPT_PASSES,
@@ -77,6 +85,8 @@ __all__ = [
     "VerifierPass", "ShapeDtypePass", "RetraceHazardPass",
     "PaddingSoundnessPass", "classify_padding", "PadViolation",
     "FlopsPass", "count_flops",
+    "MemoryPass", "DonationCheck", "plan_memory", "predict_peak_bytes",
+    "check_donation", "device_memory_budget",
     "RepairPlan", "plan_repair", "repair_serving_graph",
     "OptPlan", "OptAction", "optimize_graph", "register_opt_pass",
     "DEFAULT_OPT_PASSES", "SELECT_OPT_PASSES",

@@ -38,7 +38,7 @@ class AnalysisContext(object):
 
     def __init__(self, symbol, data_shapes=None, dtypes=None, policy=None,
                  pad_axes=None, training=False, valid_lengths=None,
-                 pad_dirty=None):
+                 pad_dirty=None, shard_spec=None, donate=None):
         self.symbol = symbol
         self.data_shapes = {k: (tuple(v) if v is not None else None)
                             for k, v in (data_shapes or {}).items()}
@@ -58,6 +58,12 @@ class AnalysisContext(object):
         # the padding pass must not credit zero-absorption (sum over
         # "zero" pads) to those inputs.  Seeds _Pad(zero=False).
         self.pad_dirty = frozenset(pad_dirty or ())
+        # memory-planner inputs: a normalized PR 14 sharding plan spec
+        # (buffer bytes divide along plan-partitioned axes) and a donate
+        # spec {input name -> aliased output index} for the aliasing
+        # soundness gate (memory.py)
+        self.shard_spec = shard_spec
+        self.donate = dict(donate or {})
         self.view = None          # GraphView, set once certified acyclic
         self.structural_ok = None # verifier verdict; gates later passes
         # products of the shape/dtype abstract interpreter, keyed
@@ -109,7 +115,8 @@ def list_passes():
 
 def analyze(symbol, data_shapes=None, dtypes=None, policy=None,
             pad_axes=None, training=False, passes=None,
-            valid_lengths=None, pad_dirty=None):
+            valid_lengths=None, pad_dirty=None, shard_spec=None,
+            donate=None):
     """Run a pass pipeline over ``symbol``; returns (Report, ctx).
 
     ``passes`` is an ordered iterable of pass names (default: the full
@@ -124,6 +131,9 @@ def analyze(symbol, data_shapes=None, dtypes=None, policy=None,
     if "flops" in names and "shapes" not in names:
         # the FLOP formulas read per-node concrete shapes
         names.insert(names.index("flops"), "shapes")
+    if "memory" in names and "shapes" not in names:
+        # liveness prices buffers off the same shape environment
+        names.insert(names.index("memory"), "shapes")
     if "verify" not in names:
         names.insert(0, "verify")
     elif names[0] != "verify":
@@ -132,7 +142,8 @@ def analyze(symbol, data_shapes=None, dtypes=None, policy=None,
     ctx = AnalysisContext(symbol, data_shapes=data_shapes, dtypes=dtypes,
                           policy=policy, pad_axes=pad_axes,
                           training=training, valid_lengths=valid_lengths,
-                          pad_dirty=pad_dirty)
+                          pad_dirty=pad_dirty, shard_spec=shard_spec,
+                          donate=donate)
     report = Report()
     for name in names:
         if name != "verify" and ctx.structural_ok is False:

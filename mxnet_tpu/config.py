@@ -225,6 +225,23 @@ VARIABLES = {v.name: v for v in [
          "MXNetError: malformed graphs refuse to build, and a serving "
          "graph classified cross-position along a padded axis refuses "
          "the unsound bucketing instead of degrading it."),
+    _Var("MXNET_MEMORY_PLAN", bool, True,
+         "Run the static memory planner (analysis/memory.py) at "
+         "ServingEngine/DecodeEngine construction: liveness-based "
+         "peak-HBM prediction over the full warm program set, the "
+         "donation/aliasing soundness gate over the decode slot pool, "
+         "and the OOM preflight against the device budget — all "
+         "BEFORE any compile.  Requires MXNET_ANALYSIS_ON.  Findings "
+         "warn by default (MXNET_ANALYSIS_STRICT=1 raises); the "
+         "planner only diagnoses, so served outputs are "
+         "bitwise-identical with it on or off."),
+    _Var("MXNET_MEMORY_BUDGET_BYTES", int, 0,
+         "Per-device HBM budget in bytes for the memory planner's OOM "
+         "preflight.  0 = auto-detect from "
+         "device.memory_stats()['bytes_limit'] where the backend "
+         "supports it (CPU does not: prediction still runs, capacity "
+         "refusal is skipped).  Set explicitly to preflight against a "
+         "target accelerator from any host."),
     _Var("MXNET_SERVE_REPAIR", bool, True,
          "Attempt an automatic masking repair (analysis/rewrite.py) "
          "before degrading a serving graph the padding pass classifies "

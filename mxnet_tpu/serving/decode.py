@@ -101,7 +101,8 @@ from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
 from .buckets import ProgramCache, _next_pow2
 from .engine import (_ENGINE_SEQ, _percentile, aot_metric_families,
-                     _supervisor_state)
+                     _supervisor_state, memory_metric_families,
+                     _memory_stats_block, refresh_memory_gauges)
 from .replica import DecodeReplica, resolve_replica_placements
 
 __all__ = ["DecodeEngine", "DecodeResult", "StepProgram", "greedy_decode",
@@ -1158,8 +1159,19 @@ class _DecodeTelemetry(object):
         # bundle registers (engine ordinals are process-unique, so the
         # shared families aggregate into one fleet view)
         self.aot_fams = aot_metric_families(reg)
+        # static memory planner pair (families shared with the
+        # one-shot bundle): predicted set eagerly, measured created
+        # lazily on the first successful allocator probe so CPU hosts
+        # never publish a dead series
+        mem_pred_fam, mem_meas_fam = memory_metric_families(reg)
+        self.mem_predicted = mem_pred_fam.labels(
+            engine=self.engine_label)
+        self._mem_meas_fam = mem_meas_fam
+        self._mem_measured = None
+        self._mem_probe_ok = True
         self._engine_gauge_fams = (queue_depth_fam, compile_fam,
-                                   ttft_fam, tpot_fam, replicas_fam) \
+                                   ttft_fam, tpot_fam, replicas_fam,
+                                   mem_pred_fam, mem_meas_fam) \
             + self._spec_fams
         self._replica_fams = (self.slots_fam, self.occupied_fam,
                               self.step_ms, self.replica_healthy,
@@ -1194,6 +1206,7 @@ class _DecodeTelemetry(object):
             self._remove_engine_series()
             return
         self.compile_count.set(eng.compile_count)
+        refresh_memory_gauges(self, eng)
         if self.spec_drafted is not None:
             # GIL-atomic int reads: a collect-time callback must not
             # take scheduler locks
@@ -1447,6 +1460,22 @@ class DecodeEngine(object):
             batches.append(bb)
             bb <<= 1
         self._prefill_batches = tuple(batches) if self._coalesce else (1,)
+        # static memory planner (analysis/memory.py): liveness-price
+        # the whole warm set — step program at slot-pool shapes with
+        # the pool's state-for-state donation spec gated for
+        # soundness, draft step additively under spec, largest
+        # prefill bucket plus the resident pool — against the device
+        # budget BEFORE any compile.  Purely diagnostic: the engine
+        # serves bitwise-identically with the planner off.
+        self.memory_plan = None
+        if config.get("MXNET_MEMORY_PLAN") \
+                and config.get("MXNET_ANALYSIS_ON"):
+            self._memory_preflight(
+                step_sym, state_info, arg_params, aux_params,
+                token_name, pos_name, valid_name, prefill_sym,
+                prefill_buckets, draft_sym, draft_state_info,
+                draft_arg_params, draft_aux_params,
+                config.get("MXNET_ANALYSIS_STRICT"))
         # persistent AOT program cache (serving/aot_cache.py,
         # MXNET_AOT_CACHE_DIR): one per engine, shared by every
         # replica's step program, prefill buckets, and row-scatter
@@ -1480,7 +1509,13 @@ class DecodeEngine(object):
                                          else None),
                         "nodes_after": (self.opt_plan.nodes_after
                                         if self.opt_plan is not None
-                                        else None)}}
+                                        else None)},
+                    # the memory plan's digest rides the validity
+                    # fingerprint: a planner upgrade that moves the
+                    # prediction re-prices warm entries instead of
+                    # serving under stale capacity conclusions
+                    "memory": (self.memory_plan.get("digest")
+                               if self.memory_plan else None)}
         key_extra = {"engine_kind": "decode", "sampler": sampler_fp}
         if self._spec_cfg is not None:
             artifact["spec"] = dict(self._spec_cfg.describe(),
@@ -1726,6 +1761,191 @@ class DecodeEngine(object):
                           "MXNET_ANALYSIS_STRICT=0; decoded output "
                           "WILL differ from single-request decode")
         return verdict, report
+
+    def _memory_preflight(self, step_sym, state_info, arg_params,
+                          aux_params, token_name, pos_name, valid_name,
+                          prefill_sym, prefill_buckets, draft_sym,
+                          draft_state_info, draft_arg_params,
+                          draft_aux_params, strict):
+        """OOM preflight + donation gate (analysis/memory.py).
+
+        The step program is priced at slot-pool shapes with the pool's
+        state-for-state donation spec — state ``i`` aliases output
+        ``1+i``, exactly what StepProgram donates — and an UNSOUND
+        donation (a state read by a node not ordered before its
+        aliasing next-state write) is refused here with the node
+        pinned, because the in-place update would clobber the buffer
+        before its last read.  Speculative engines price the draft
+        step additively: both models and both state pools are resident
+        during a dispatch.  Prefill is priced at its largest
+        (batch, prompt) bucket PLUS the resident slot pool (prefill
+        runs while the pool lives; the pool is not among its inputs).
+        Bytes divide along plan-partitioned axes.  Over budget warns
+        naming the offending program and bytes — plus a max-slots-
+        that-fit advisory — and ``MXNET_ANALYSIS_STRICT=1`` raises;
+        either way the verdict lands before any compile."""
+        from ..analysis import AnalysisError
+        from ..analysis.memory import (plan_memory, plan_digest,
+                                       device_memory_budget,
+                                       format_bytes, shard_divisor)
+        from ..symbol import Symbol as _Symbol
+        try:
+            n = self.num_slots
+            spec = self._sharding_spec
+
+            def price_step(sym_, infos, a_params, x_params):
+                arg_names = set(sym_.list_arguments())
+                shapes = {token_name: (n,)}
+                donate, names = {}, []
+                for i, info in enumerate(infos):
+                    shapes[info["name"]] = (n,) + tuple(info["shape"])
+                    names.append(info["name"])
+                    donate[info["name"]] = 1 + i
+                for extra in (pos_name, valid_name):
+                    if extra in arg_names:
+                        shapes[extra] = (n,)
+                dtypes = {k: self._dtype for k in shapes}
+                for src in (a_params or {}), (x_params or {}):
+                    for k, v in src.items():
+                        dt = getattr(v, "dtype", None)
+                        if dt is not None:
+                            dtypes.setdefault(k, np.dtype(dt))
+                plan, _rep = plan_memory(sym_, shapes, dtypes=dtypes,
+                                         sharding=spec, donate=donate,
+                                         state_names=names)
+                return plan
+
+            plan = price_step(step_sym, state_info, arg_params,
+                              aux_params)
+            if not plan:
+                return
+            dplan = None
+            if self._spec_k and draft_sym is not None:
+                dplan = price_step(draft_sym, draft_state_info or [],
+                                   draft_arg_params, draft_aux_params)
+            # the slot pool the step's inputs already include —
+            # (num_slots,) + state shape per state, divided along plan
+            # state rules — stays resident under prefill too
+            pool = 0
+            for info in state_info:
+                shp = (n,) + tuple(info["shape"])
+                nbytes = int(np.prod(shp)) * self._dtype.itemsize
+                pool += nbytes // shard_divisor(spec, info["name"],
+                                                shp, kind="state")
+            per_slot = pool // n
+
+            def row(label, p):
+                return {"program": label,
+                        "peak_bytes": p["peak_bytes"],
+                        "param_bytes": p["param_bytes"],
+                        "transient_peak_bytes":
+                            p["transient_peak_bytes"],
+                        "inplace_savings_bytes":
+                            p["inplace_savings_bytes"]}
+
+            programs = [row("step", plan)]
+            need = plan["peak_bytes"]
+            offender = "step"
+            donation = {"step": plan["donation"]}
+            if dplan:
+                programs.append(row("draft", dplan))
+                need += dplan["peak_bytes"]
+                offender = "step+draft"
+                donation["draft"] = dplan["donation"]
+            if prefill_sym is not None and prefill_buckets:
+                b_top = max(prefill_buckets)
+                bb = max(self._prefill_batches)
+                psym = prefill_sym
+                if not isinstance(psym, _Symbol) and callable(psym):
+                    psym = psym(b_top)
+                parg = set(psym.list_arguments())
+                pshapes = {}
+                if self._prefill_data_name in parg:
+                    pshapes[self._prefill_data_name] = (bb, b_top)
+                if self._prefill_len_name in parg:
+                    pshapes[self._prefill_len_name] = (bb,)
+                pdtypes = {}
+                for src in (arg_params or {}), (aux_params or {}):
+                    for k, v in src.items():
+                        dt = getattr(v, "dtype", None)
+                        if dt is not None:
+                            pdtypes.setdefault(k, np.dtype(dt))
+                pplan, _rep = plan_memory(psym, pshapes,
+                                          dtypes=pdtypes,
+                                          sharding=spec)
+                if pplan:
+                    label = "prefill[b%dxT%d]" % (bb, b_top)
+                    r = row(label, pplan)
+                    r["peak_bytes"] = pplan["peak_bytes"] + pool
+                    programs.append(r)
+                    if r["peak_bytes"] > need:
+                        need = r["peak_bytes"]
+                        offender = label
+            mem = {
+                "enabled": True,
+                "programs": programs,
+                "predicted_peak_bytes": need,
+                "param_bytes": plan["param_bytes"],
+                "pool_bytes": pool,
+                "per_slot_bytes": per_slot,
+                "offender": offender,
+                "sharded": bool(spec),
+                "donation": donation,
+            }
+            # budget is a property of THIS host, not of the plan:
+            # digest only the deterministic prediction, or the same
+            # program would fingerprint-drift across machines
+            mem["digest"] = plan_digest(
+                {k: mem[k] for k in ("programs", "predicted_peak_bytes",
+                                     "sharded", "donation")})
+            budget = device_memory_budget()
+            mem["budget_bytes"] = budget
+            mem["budget_ok"] = (None if budget is None
+                                else need <= budget)
+            mem["max_slots_fit"] = (
+                max(0, int((budget - (need - pool)) // per_slot))
+                if budget is not None and per_slot > 0 else None)
+            self.memory_plan = mem
+            bad = [(label, d) for label, d in sorted(donation.items())
+                   if d is not None and not d["accepted"]]
+            if bad:
+                detail = "\n".join(
+                    "  [%s] %s" % (label, reason)
+                    for label, d in bad for reason in d["reasons"])
+                msg = ("[memory] DecodeEngine slot-pool donation is "
+                       "UNSOUND — an in-place next-state write would "
+                       "clobber a state buffer before its last read:"
+                       "\n%s" % detail)
+                if strict:
+                    raise AnalysisError(msg)
+                warnings.warn(msg + "\ncontinuing because "
+                              "MXNET_ANALYSIS_STRICT=0; the engine "
+                              "does NOT donate these buffers safely")
+            if mem["budget_ok"] is False:
+                fit = mem["max_slots_fit"]
+                msg = ("DecodeEngine memory preflight: program %r "
+                       "predicts peak %s (slot pool %s for %d slots "
+                       "+ params %s) but the device budget is %s — "
+                       "the warm set cannot fit%s; shrink num_slots/"
+                       "max_len, shard the plan, or raise "
+                       "MXNET_MEMORY_BUDGET_BYTES (priced before any "
+                       "compile)"
+                       % (offender, format_bytes(need),
+                          format_bytes(pool), n,
+                          format_bytes(plan["param_bytes"]),
+                          format_bytes(budget),
+                          (" (at most %d slots fit)" % fit
+                           if fit is not None else "")))
+                if strict:
+                    raise AnalysisError("[memory] " + msg)
+                warnings.warn(msg)
+        except AnalysisError:
+            raise
+        except Exception as e:      # planner crash must never block
+            #                         construction: advisory pass
+            warnings.warn("DecodeEngine: memory preflight crashed "
+                          "(%r); continuing without a memory plan"
+                          % (e,))
 
     def _check_draft_heads(self, step_sym, draft_sym, state_info,
                            draft_state_info, token_name, pos_name,
@@ -2985,6 +3205,7 @@ class DecodeEngine(object):
                 "sharding": self._sharding_spec,
                 "aot": (self._aot.stats() if self._aot is not None
                         else {"enabled": False}),
+                "memory": _memory_stats_block(self.memory_plan),
                 "replicas": [r.describe() for r in self._replicas],
                 "prefill": ("bucket" if self._prefill_caches
                             else "step"),

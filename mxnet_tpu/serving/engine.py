@@ -133,6 +133,64 @@ def aot_metric_families(reg):
                        "the store() write path")))
 
 
+def memory_metric_families(reg):
+    """Register (idempotently) the static-memory-planner gauge pair
+    both engine kinds share, per engine: the planner's construction-time
+    liveness prediction and the backend allocator's measured high-water
+    mark (where ``memory_stats`` exists — CPU hosts publish only the
+    prediction).  Returns ``(predicted_fam, measured_fam)``."""
+    return (reg.gauge(
+        "mxnet_serve_memory_predicted_peak_bytes",
+        "predicted peak HBM bytes for this engine's warm program set "
+        "(params resident + activation high-water over the worst "
+        "bucket program, divided along plan-partitioned axes) — the "
+        "static memory planner's construction-time liveness watermark, "
+        "computed before any compile",
+        labelnames=("engine",)),
+        reg.gauge(
+            "mxnet_serve_memory_measured_peak_bytes",
+            "backend allocator peak_bytes_in_use measured at scrape "
+            "time (telemetry/devicemem.py probe) — the honest runtime "
+            "side of the planner's predicted-vs-measured pair; absent "
+            "on backends without memory_stats (CPU)",
+            labelnames=("engine",)))
+
+
+def refresh_memory_gauges(bundle, eng):
+    """Scrape-time update of the predicted-vs-measured memory pair
+    (shared by both engine bundles): the planner's watermark from the
+    engine's construction-time plan, and the allocator's measured peak
+    via the shared devicemem probe — probe-once, so a backend without
+    ``memory_stats`` never grows a dead series."""
+    mem = getattr(eng, "memory_plan", None)
+    if mem:
+        bundle.mem_predicted.set(float(mem.get(
+            "predicted_peak_bytes", 0) or 0))
+    if bundle._mem_probe_ok:
+        from ..telemetry.devicemem import device_memory_peak
+        peak = device_memory_peak()
+        if peak is None:
+            bundle._mem_probe_ok = False
+        else:
+            if bundle._mem_measured is None:
+                bundle._mem_measured = bundle._mem_meas_fam.labels(
+                    engine=bundle.engine_label)
+            bundle._mem_measured.set(float(peak))
+
+
+def _memory_stats_block(memory_plan):
+    """One engine's ``stats()["memory"]`` block (shared by both engine
+    kinds): the construction-time plan — predicted peak, per-program
+    rows, budget verdict, donation outcome — plus the allocator's
+    measured peak where the backend supports it (the same
+    predicted-vs-measured pair the gauges carry)."""
+    if not memory_plan:
+        return {"enabled": False}
+    from ..telemetry.devicemem import device_memory_peak
+    return dict(memory_plan,
+                measured_peak_bytes=device_memory_peak())
+
+
 def _supervisor_state(engine):
     """One engine's ``stats()["supervisor"]`` block: the live process
     supervisor's per-engine slice, ``{"enabled": False}`` otherwise.
@@ -314,9 +372,19 @@ class _EngineTelemetry(object):
         # aot_metric_families — per-engine children bound by the engine
         # right after the bundle exists, reclaimed at close
         self.aot_fams = aot_metric_families(reg)
+        # static memory planner (analysis/memory.py): predicted peak
+        # set from the engine's plan at every scrape; measured peak
+        # probed via the shared devicemem helper with the probe-once
+        # discipline (CPU backends never publish the series)
+        mem_pred_fam, mem_meas_fam = memory_metric_families(reg)
+        self.mem_predicted = mem_pred_fam.labels(engine=self.engine_label)
+        self._mem_meas_fam = mem_meas_fam
+        self._mem_measured = None
+        self._mem_probe_ok = True
         self._engine_gauge_fams = (queue_depth_fam, cache_hits_fam,
                                    cache_misses_fam, compile_count_fam,
-                                   entropy_fam, replicas_fam)
+                                   entropy_fam, replicas_fam,
+                                   mem_pred_fam, mem_meas_fam)
         self._replica_fams = (self.replica_healthy, self.replica_inflight,
                               self.replica_failures, self.replica_batches,
                               self.replica_shards,
@@ -387,6 +455,7 @@ class _EngineTelemetry(object):
         self.cache_misses.set(sum(r.cache.plan_misses
                                   for r in eng._replicas))
         self.compile_count.set(eng.compile_count)
+        refresh_memory_gauges(self, eng)
         for r in eng._replicas:
             self.replica_healthy.labels(
                 engine=self.engine_label,
@@ -516,6 +585,17 @@ class ServingEngine(object):
         from ..analysis.sharding import gate_plan_spec
         self.sharding_check, self._sharding_spec = gate_plan_spec(
             sharding, self._verdicts, "serve", "ServingEngine")
+        # static memory planner (analysis/memory.py): liveness-price
+        # the full warm bucket grid — params resident + activation
+        # high-water, divided along plan-partitioned axes — and
+        # preflight it against the device budget BEFORE any compile.
+        # Diagnosis only: the planner never mutates graph or policy,
+        # so served outputs are bitwise-identical with it on or off.
+        self.memory_plan = None
+        if config.get("MXNET_MEMORY_PLAN") \
+                and config.get("MXNET_ANALYSIS_ON"):
+            self._memory_preflight(arg_params, aux_params,
+                                   config.get("MXNET_ANALYSIS_STRICT"))
         # persistent AOT program cache (serving/aot_cache.py,
         # MXNET_AOT_CACHE_DIR): shared by every replica's ProgramCache
         # — a restarted engine loads every previously-served bucket
@@ -543,7 +623,13 @@ class ServingEngine(object):
                                      else None),
                     "nodes_after": (self.opt_plan.nodes_after
                                     if self.opt_plan is not None
-                                    else None)}},
+                                    else None)},
+                # the memory plan digest rides the validity
+                # fingerprint like the padding/optimizer artifacts: a
+                # persisted program priced under a different plan (or
+                # with the planner toggled) re-validates before load
+                "memory": (self.memory_plan.get("digest")
+                           if self.memory_plan else None)},
             key_extra={"engine_kind": "serve",
                        "max_batch": self._policy.max_batch,
                        "seq_axis": self._policy.seq_axis,
@@ -846,6 +932,105 @@ class ServingEngine(object):
             warnings.warn("ServingEngine: graph optimization rejected "
                           "(%s); serving the unoptimized graph"
                           % plan.reason)
+
+    def _memory_preflight(self, arg_params, aux_params, strict):
+        """OOM preflight (analysis/memory.py): liveness-price the warm
+        program set — one program per seq bucket at the largest batch
+        bucket (byte cost is monotone in every padded extent, so the
+        grid maximum IS the warm set's watermark) — with bytes divided
+        along plan-partitioned axes, then compare against the device
+        budget BEFORE any compile.  Over budget warns naming the
+        offending program and bytes (``MXNET_ANALYSIS_STRICT=1``
+        raises).  Every replica prices identically (same graph, same
+        plan), so the watermark is per replica device group."""
+        from ..analysis import AnalysisError
+        from ..analysis.memory import (plan_memory, plan_digest,
+                                       device_memory_budget,
+                                       format_bytes)
+        try:
+            dtypes = {n: self._dtype for n in self._data_shapes}
+            for src in (arg_params or {}), (aux_params or {}):
+                for k, v in src.items():
+                    dt = getattr(v, "dtype", None)
+                    if dt is not None:
+                        dtypes.setdefault(k, np.dtype(dt))
+            seq_shapes = [(None, self._data_shapes)]
+            if self._policy.seq_axis is not None \
+                    and self._policy.seq_buckets:
+                seq_shapes = []
+                for sb in self._policy.seq_buckets:
+                    shapes = {}
+                    for name, ex in self._data_shapes.items():
+                        s = list(ex)
+                        s[self._policy.seq_axis] = sb
+                        shapes[name] = tuple(s)
+                    seq_shapes.append((sb, shapes))
+            bb = max(self._policy.batch_buckets())
+            programs = []
+            for sb, shapes in seq_shapes:
+                full = {name: (bb,) + tuple(ex)
+                        for name, ex in shapes.items()}
+                if self._valid_name is not None:
+                    full[self._valid_name] = (bb,)
+                plan, _rep = plan_memory(self._serve_sym, full,
+                                         dtypes=dtypes,
+                                         sharding=self._sharding_spec)
+                if not plan:
+                    continue
+                programs.append({
+                    "program": ("b%d" % bb) + ("s%d" % sb
+                                               if sb is not None else ""),
+                    "peak_bytes": plan["peak_bytes"],
+                    "param_bytes": plan["param_bytes"],
+                    "transient_peak_bytes": plan["transient_peak_bytes"],
+                    "inplace_savings_bytes":
+                        plan["inplace_savings_bytes"]})
+            if not programs:
+                return
+            worst = max(programs, key=lambda p: p["peak_bytes"])
+            mem = {
+                "enabled": True,
+                "programs": programs,
+                "predicted_peak_bytes": worst["peak_bytes"],
+                "param_bytes": worst["param_bytes"],
+                "offender": worst["program"],
+                "sharded": bool(self._sharding_spec),
+                "donation": None,
+            }
+            # budget is a property of THIS host, not of the plan:
+            # digest only the deterministic prediction, or the same
+            # program would fingerprint-drift across machines
+            mem["digest"] = plan_digest(
+                {k: mem[k] for k in ("programs", "predicted_peak_bytes",
+                                     "sharded", "donation")})
+            budget = device_memory_budget()
+            mem["budget_bytes"] = budget
+            mem["budget_ok"] = (None if budget is None
+                                else worst["peak_bytes"] <= budget)
+            self.memory_plan = mem
+            if mem["budget_ok"] is False:
+                msg = ("ServingEngine memory preflight: program %r "
+                       "predicts peak %s (params %s + transient %s) "
+                       "but the device budget is %s — the warm set "
+                       "cannot fit; shrink max_batch/seq buckets, "
+                       "shard the plan, or raise "
+                       "MXNET_MEMORY_BUDGET_BYTES (priced before any "
+                       "compile)"
+                       % (worst["program"],
+                          format_bytes(worst["peak_bytes"]),
+                          format_bytes(worst["param_bytes"]),
+                          format_bytes(worst["transient_peak_bytes"]),
+                          format_bytes(budget)))
+                if strict:
+                    raise AnalysisError("[memory] " + msg)
+                warnings.warn(msg)
+        except AnalysisError:
+            raise
+        except Exception as e:      # planner crash must never block
+            #                         construction: advisory pass
+            warnings.warn("ServingEngine: memory preflight crashed "
+                          "(%r); continuing without a memory plan"
+                          % (e,))
 
     def _record_opt_telemetry(self):
         """Mirror the construction-time optimizer outcome into the
@@ -1828,6 +2013,7 @@ class ServingEngine(object):
                     "reason": (self.opt_plan.reason
                                if self.opt_plan is not None else None),
                 },
+                "memory": _memory_stats_block(self.memory_plan),
                 "latency_ms": {
                     "count": len(lat),
                     "mean": float(np.mean(lat)) if lat else 0.0,

@@ -1,0 +1,35 @@
+"""Shared device-memory probe.
+
+One place that knows how to ask a backend allocator for its high-water
+mark: ``device.memory_stats()["peak_bytes_in_use"]`` where the backend
+keeps one (TPU/GPU), falling back to ``bytes_in_use``, and ``None``
+where the backend has no allocator stats at all (CPU).  Consumed by
+``telemetry/step.py``'s StepTimer (``mxnet_train_device_mem_peak_bytes``)
+and both serving engines' ``mxnet_serve_memory_measured_peak_bytes``
+gauges — the measured side of the static memory planner's
+predicted-vs-measured pair.
+
+Callers treat a ``None`` return as "this backend cannot say" and stop
+probing (the probe-once discipline): the call itself is cheap, but a
+gauge that can never move should not be scraped as a live zero.
+"""
+from __future__ import annotations
+
+__all__ = ["device_memory_peak"]
+
+
+def device_memory_peak(device=None):
+    """Peak bytes in use on ``device`` (default: the first jax device)
+    per the backend allocator — or ``None`` when the backend does not
+    support ``memory_stats`` (CPU hosts).  Never raises."""
+    try:
+        if device is None:
+            import jax
+            device = jax.devices()[0]
+        stats = device.memory_stats()
+        if not stats:
+            return None
+        return int(stats.get("peak_bytes_in_use",
+                             stats.get("bytes_in_use", 0)) or 0)
+    except Exception:
+        return None

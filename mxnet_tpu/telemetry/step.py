@@ -423,19 +423,12 @@ class StepTimer(object):
     def _observe_device_mem(self):
         if not self._mem_ok:
             return
-        try:
-            dev = self.device
-            if dev is None:
-                import jax
-                dev = jax.devices()[0]
-            stats = dev.memory_stats()
-            if not stats:
-                raise ValueError("no memory_stats")
-            peak = stats.get("peak_bytes_in_use",
-                             stats.get("bytes_in_use", 0))
-            self._g_mem.set(float(peak or 0))
-        except Exception:
+        from .devicemem import device_memory_peak
+        peak = device_memory_peak(self.device)
+        if peak is None:
             self._mem_ok = False    # probe once; CPU backends lack it
+            return
+        self._g_mem.set(float(peak))
 
     def close(self):
         """Reclaim this timer's labeled series (mirrors

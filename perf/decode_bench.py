@@ -667,6 +667,10 @@ def run_bench(requests=64, slots=8, max_len=128, mean_new=16, vocab=32,
         "retraces": retraces,
         "step_p50_ms": stats["step_ms"]["p50"],
         "step_p99_ms": stats["step_ms"]["p99"],
+        # advisory: the static planner's warm-set watermark (step +
+        # slot pool + prefill; analysis/memory.py)
+        "predicted_peak_bytes":
+            stats["memory"].get("predicted_peak_bytes"),
     }
     return row
 
@@ -887,6 +891,9 @@ def run_replica_sweep(requests=64, slots=8, max_len=128, mean_new=16,
             "retraces": retraces,
             "steps": st["steps"],
             "step_p50_ms": st["step_ms"]["p50"],
+            # advisory: planner watermark per replica device group
+            "predicted_peak_bytes":
+                st["memory"].get("predicted_peak_bytes"),
         }
         if k != replica_counts[0]:
             row["speedup_vs_1"] = round(speedups[k], 2)

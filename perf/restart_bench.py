@@ -100,11 +100,15 @@ def _serve_phase(net, params, feature, requests):
     t3 = time.perf_counter()
     outs = [first] + [eng.predict(x, timeout=300) for x in X[1:]]
     compiles = eng.compile_count
-    aot = eng.stats()["aot"]
+    st = eng.stats()
+    aot = st["aot"]
+    # advisory: static planner watermark (analysis/memory.py)
+    peak = st["memory"].get("predicted_peak_bytes")
     eng.close()
     return {"construct_s": t1 - t0, "warmup_s": t2 - t1,
             "first_request_s": t3 - t2,
             "ready_s": t3 - t0, "compiles": compiles,
+            "predicted_peak_bytes": peak,
             "aot": aot, "outputs": outs}
 
 
@@ -124,11 +128,15 @@ def _decode_phase(step, sparams, state_info, prompts, max_new):
         eng.generate(p, max_new_tokens=max_new, timeout=600).tokens
         for p in prompts[1:]]
     compiles = eng.compile_count
-    aot = eng.stats()["decode"]["aot"]
+    st = eng.stats()["decode"]
+    aot = st["aot"]
+    # advisory: static planner watermark (analysis/memory.py)
+    peak = st["memory"].get("predicted_peak_bytes")
     eng.close()
     return {"construct_s": t1 - t0, "warmup_s": t2 - t1,
             "first_request_s": t3 - t2,
             "ready_s": t3 - t0, "compiles": compiles,
+            "predicted_peak_bytes": peak,
             "aot": aot, "outputs": toks}
 
 

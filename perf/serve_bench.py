@@ -153,6 +153,10 @@ def run_bench(requests=512, offered_batch=8, feature=512, hidden=1024,
         "p99_ms": round(stats["latency_ms"]["p99"], 2),
         "warmup_compiles": warm_compiles,
         "retraces": retraces,
+        # advisory: the static planner's watermark for the warm set
+        # (analysis/memory.py), for joining against measured peaks
+        "predicted_peak_bytes":
+            stats["memory"].get("predicted_peak_bytes"),
     }
 
 
@@ -434,6 +438,10 @@ def run_replica_sweep(requests=512, offered_batch=8, feature=512,
             "batches_per_replica": [r["batches"]
                                     for r in st["replicas"]],
             "p99_ms": round(st["latency_ms"]["p99"], 2),
+            # advisory: static planner watermark per replica device
+            # group (analysis/memory.py)
+            "predicted_peak_bytes":
+                st["memory"].get("predicted_peak_bytes"),
         }
         if k != base_k:
             row["speedup_vs_1"] = round(speedups[k], 2)

@@ -427,6 +427,8 @@ def test_reload_loop_leak_gate_with_replicas(_fresh_telemetry):
                      "mxnet_serve_decode_slots",
                      "mxnet_serve_decode_slots_occupied",
                      "mxnet_serve_decode_step_ms",
+                     "mxnet_serve_memory_predicted_peak_bytes",
+                     "mxnet_serve_memory_measured_peak_bytes",
                      "mxnet_serve_queue_depth"):
         fam = reg.get(fam_name)
         assert fam is None or fam.series() == [], fam_name

@@ -44,6 +44,16 @@ Usage:
         --shapes token=8 --shapes h=8,32 --shapes c=8,32 \
         --decode-state h,c
 
+    # memory plan: per-program predicted peak HBM + top contributors,
+    # donation soundness, in-place candidates (analysis/memory.py) —
+    # the offline view of the engines' OOM preflight.  Composes with
+    # --decode-step (slot-pool shapes; --decode-state names donate
+    # into outputs 1+i, the engine's in-place pool contract) and
+    # --sharding-plan (bytes divide along plan-partitioned axes)
+    python tools/graph_lint.py step-symbol.json --decode-step --memory \
+        --shapes token=8 --shapes h=8,32 --shapes c=8,32 \
+        --decode-state h,c
+
 Dynamic dims are written as 0 (or '?') in --shapes; the retrace linter
 keys on them.  --strict exits nonzero on warnings too (CI bar: the
 model-zoo exemplars must lint clean — tests/test_graph_lint.py).
@@ -52,6 +62,12 @@ Exit codes (documented contract, tests/test_graph_lint.py):
   0  clean at the chosen bar
   1  warnings only, failing the bar (--strict; or a rejected --fix)
   2  hard failure: verifier/shape ERRORS, or a graph could not load
+--memory interacts with the bar like --fix does: an UNSOUND donation
+spec exits 1 even without --strict — it means the declared in-place
+aliasing would clobber a buffer before its last read, exactly the
+verdict the engines warn (or refuse, under MXNET_ANALYSIS_STRICT=1)
+on at construction.  The peak/contributor/in-place report itself is
+ADVISORY and never moves the exit code.
 --optimize interacts with the bar like --fix does: a REJECTED
 optimization plan (the candidate's re-analysis verdicts came back
 worse — an optimizer bug, never a user error) exits 1 even without
@@ -261,6 +277,25 @@ def main(argv=None):
                          "construction.  Combines with --decode-step "
                          "(slot-axis verdict) or the serve-mode "
                          "padded-axis verdicts")
+    ap.add_argument("--memory", action="store_true",
+                    help="run the static memory planner "
+                         "(analysis/memory.py) over each graph: "
+                         "predicted peak HBM (params resident + "
+                         "liveness high-water), top per-node "
+                         "contributors, in-place candidates, and the "
+                         "donation soundness verdict.  With "
+                         "--decode-step the --decode-state inputs are "
+                         "priced as the engine's donated slot pool "
+                         "(state i aliases output 1+i) unless --donate "
+                         "overrides; with --sharding-plan the bytes "
+                         "divide along plan-partitioned axes.  An "
+                         "UNSOUND donation exits 1 even without "
+                         "--strict; the rest is advisory")
+    ap.add_argument("--donate", default="", metavar="N1=O1,N2=O2,..",
+                    help="with --memory: explicit donation spec — "
+                         "input NAME aliases output index O (the "
+                         "buffer is reused in place).  Overrides the "
+                         "--decode-state-derived spec")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="print one machine-readable JSON document "
                          "instead of text (hazard_rank.py input)")
@@ -349,11 +384,24 @@ def main(argv=None):
                     analysis, graph, shapes, args)
                 if draft_bad:
                     failed = True
+            mem_audit = None
+            if args.memory and not hard:
+                # the engine's slot-pool donation contract by default:
+                # state i aliases output 1+i
+                donate = _parse_donate(args.donate) or {
+                    nm: 1 + i for i, nm in enumerate(state_names)}
+                mem_audit, mem_bad = _audit_memory(
+                    graph, shapes, donate=donate,
+                    state_names=state_names, plan_spec=plan_spec,
+                    training=args.training)
+                if mem_bad:
+                    failed = True
             doc[spec] = {"findings": report.to_list(),
                          "verdicts": {"slot": verdict}, "repairs": [],
                          "selections": selections,
                          "spec": draft_audit,
-                         "sharding_plan": plan_audit}
+                         "sharding_plan": plan_audit,
+                         "memory": mem_audit}
             if not args.as_json and (failed or not args.quiet):
                 print("== %s ==" % spec)
                 print(report.format())
@@ -363,6 +411,7 @@ def main(argv=None):
                           % (s["op"], s["site"], s["verdict"]))
                 _print_draft_audit(draft_audit)
                 _print_plan_audit(plan_audit)
+                _print_memory_audit(mem_audit)
                 if unsound:
                     print("  FAIL: step graph is cross-position along "
                           "the slot axis — a dead slot's stale state "
@@ -391,6 +440,13 @@ def main(argv=None):
                 analysis, graph, plan_spec, "serve",
                 dict(ctx.pad_verdicts), shapes)
             if not entry["sharding_plan"]["accepted"]:
+                failed = True
+        if args.memory and not hard:
+            entry["memory"], mem_bad = _audit_memory(
+                graph, shapes, donate=_parse_donate(args.donate),
+                state_names=(), plan_spec=plan_spec,
+                training=args.training)
+            if mem_bad:
                 failed = True
         fix_lines = []
         if args.fix and pad_axes is None and not hard:
@@ -428,6 +484,7 @@ def main(argv=None):
             for label, verdict in sorted(ctx.pad_verdicts.items()):
                 print("  padded %s axis: %s" % (label, verdict))
             _print_plan_audit(entry.get("sharding_plan"))
+            _print_memory_audit(entry.get("memory"))
             for ln in fix_lines:
                 print(ln)
         if hard:
@@ -470,6 +527,79 @@ def _print_plan_audit(audit):
         print("    %s reaches %d node(s): %s" % (src, len(nodes), show))
     for r in audit["reasons"]:
         print("    FAIL: %s" % r)
+
+
+def _parse_donate(entry):
+    """--donate "h=1,c=2" -> {"h": 1, "c": 2} (empty -> None)."""
+    donate = {}
+    for e in (entry or "").split(","):
+        if not e.strip():
+            continue
+        if "=" not in e:
+            raise ValueError("--donate entries look like name=out_idx"
+                             " (got %r)" % e)
+        name, idx = e.split("=", 1)
+        donate[name.strip()] = int(idx)
+    return donate or None
+
+
+def _audit_memory(graph, shapes, donate, state_names, plan_spec,
+                  training):
+    """--memory: the offline view of the engines' OOM preflight —
+    one program's liveness plan (predicted peak, top contributors,
+    in-place candidates) plus the donation soundness verdict.
+    Returns ``(audit dict, failed)``: only an UNSOUND donation fails
+    the run (the engines' construction-time bar); everything else is
+    advisory."""
+    from mxnet_tpu.analysis.memory import plan_memory
+    try:
+        plan, report = plan_memory(
+            graph, shapes, training=training, sharding=plan_spec,
+            donate=donate or None, state_names=tuple(state_names))
+    except Exception as e:
+        return {"error": "memory planner crashed: %s" % e}, False
+    if not plan:
+        return {"error": "memory pass produced no plan",
+                "findings": report.to_list()}, False
+    out = {k: plan[k] for k in
+           ("peak_bytes", "param_bytes", "input_bytes", "output_bytes",
+            "transient_peak_bytes", "per_node_top", "inplace",
+            "inplace_savings_bytes", "donation", "sharded",
+            "skipped_nodes")}
+    bad = (plan["donation"] is not None
+           and not plan["donation"]["accepted"])
+    return out, bad
+
+
+def _print_memory_audit(mem):
+    if mem is None:
+        return
+    from mxnet_tpu.analysis.memory import format_bytes
+    if mem.get("error"):
+        print("  memory: %s" % mem["error"])
+        return
+    print("  memory: predicted peak %s (params %s + transient %s%s%s)"
+          % (format_bytes(mem["peak_bytes"]),
+             format_bytes(mem["param_bytes"]),
+             format_bytes(mem["transient_peak_bytes"]),
+             ", sharded" if mem["sharded"] else "",
+             (", %d skipped — lower bound" % mem["skipped_nodes"])
+             if mem["skipped_nodes"] else ""))
+    for row in mem["per_node_top"]:
+        print("    top contributor: %s (%s) out %s, live-set %s"
+              % (row["node"], row["op"], format_bytes(row["out_bytes"]),
+                 format_bytes(row["live_bytes"])))
+    if mem["inplace"]:
+        print("    in-place candidates: %d op(s), %s reclaimable"
+              % (len(mem["inplace"]),
+                 format_bytes(mem["inplace_savings_bytes"])))
+    d = mem["donation"]
+    if d is not None:
+        print("    donation: %s (%d input(s))"
+              % ("SOUND" if d["accepted"] else "UNSOUND",
+                 len(d["per_input"])))
+        for r in d["reasons"]:
+            print("    FAIL: %s" % r)
 
 
 def _head_dtype(analysis, graph, shapes):
