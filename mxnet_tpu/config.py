@@ -357,6 +357,25 @@ VARIABLES = {v.name: v for v in [
          "a dispatch in flight) yet stamped no progress for this many "
          "seconds fires <kind>_engine<N>_stalled — a wedged dispatch "
          "or starved queue, named, not inferred."),
+    _Var("MXNET_SERVE_EFFICIENCY", bool, True,
+         "Serving efficiency plane (telemetry/goodput.py): per-"
+         "compiled-program FLOPs ledger priced once at compile/AOT-"
+         "load time (analysis/flops.py over the concrete padded "
+         "shapes), per-dispatch counters decomposed into useful / "
+         "padding / dead-slot / spec-rejected classes that sum "
+         "exactly to total, live mxnet_serve_mfu and goodput_ratio "
+         "gauges, and per-tenant accounting.  Requires "
+         "MXNET_TELEMETRY_ON; 0 = no pricing, no ledger series, zero "
+         "instrument calls on the dispatch path, serving "
+         "bitwise-identical to the plane never existing."),
+    _Var("MXNET_TELEMETRY_TENANTS_MAX", int, 32,
+         "Bounded-cardinality guard on the per-tenant accounting "
+         "series (telemetry/goodput.py): the first N distinct tenant "
+         "ids an engine sees get their own {tenant=...} label; "
+         "later tenants aggregate into tenant=\"other\" and each "
+         "overflowed request increments "
+         "mxnet_serve_tenant_overflow_total so the collapse is "
+         "visible, not silent."),
     _Var("MXNET_AOT_CACHE_DIR", str, "",
          "Persistent AOT program-cache directory (serving/aot_cache.py)."
          "  When set, every serving program — one-shot bucket programs, "

@@ -100,12 +100,19 @@ class Request(object):
     ``expired`` flag) instead of setting ``DeadlineExceededError``;
     returning ``None`` falls back to the exception.  One-shot requests
     leave it unset and keep the original fail-fast contract.
+
+    ``tenant`` carries the RESOLVED per-tenant accounting label
+    (telemetry/goodput.py: submit resolves the caller's tenant id onto
+    the bounded label set once, so every downstream inc reuses the
+    resolution).  None = unattributed (no tenant given, or the
+    efficiency plane is off).
     """
     __slots__ = ("inputs", "group", "future", "t_enqueue", "deadline",
-                 "out_rows", "trace", "on_expire", "cost")
+                 "out_rows", "trace", "on_expire", "cost", "tenant")
 
     def __init__(self, inputs, group, future, deadline=None,
-                 out_rows=None, trace=None, on_expire=None, cost=None):
+                 out_rows=None, trace=None, on_expire=None, cost=None,
+                 tenant=None):
         self.inputs = inputs
         self.group = group
         self.future = future
@@ -115,6 +122,7 @@ class Request(object):
         self.trace = trace
         self.on_expire = on_expire
         self.cost = cost                    # padded elements (regulator)
+        self.tenant = tenant                # resolved accounting label
 
     def expired(self, now=None):
         return self.deadline is not None and \

@@ -221,6 +221,11 @@ class ProgramCache(object):
         # reported by ServingEngine.stats()
         self.plan_hits = 0
         self.plan_misses = 0
+        # serving efficiency plane (telemetry/goodput.py): advisory
+        # integer FLOPs price per bucket signature, computed ONCE in
+        # _plan_for alongside the program build (None = the FLOPs pass
+        # could not price it; dispatches then count as unpriced)
+        self.flops_by_key = {}
 
     # ------------------------------------------------------------------
     @property
@@ -233,6 +238,12 @@ class ProgramCache(object):
     def bucket_keys(self):
         with self._lock:
             return sorted(self._keys)
+
+    def flops_for(self, shape_key):
+        """Advisory FLOPs price of one bucket program (the run()-side
+        shape key: sorted (name, padded shape) tuples).  None =
+        unpriced, or priced before the efficiency plane was on."""
+        return self.flops_by_key.get(shape_key)
 
     def _plan_for(self, shape_key, data_specs):
         """Prefilled flat-input list + kernel + rng key for one bucket
@@ -271,6 +282,16 @@ class ProgramCache(object):
                 key = (None if self._op._graph_fn.stochastic
                        else self._op._key())
                 kernel = self._resolve_kernel(data_specs, flat)
+                from ..telemetry import goodput as _goodput
+                if _goodput.enabled():
+                    # price the program once per signature, on the
+                    # cold path only — warm dispatches read the dict
+                    self.flops_by_key[shape_key] = _goodput.price_graph(
+                        self._sym,
+                        {k: s for k, (s, _d) in data_specs.items()},
+                        dtypes={k: d for k, (_s, d) in
+                                data_specs.items()},
+                        label_names=self._label_names)
                 plan = (flat, kernel, key,
                         sorted(self._data_pos.items()))
                 with self._lock:

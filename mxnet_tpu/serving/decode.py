@@ -96,6 +96,7 @@ import numpy as np
 
 from ..base import MXNetError
 from .. import telemetry as _telemetry
+from ..telemetry import goodput as _goodput
 from . import faults as _faults
 from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
@@ -242,7 +243,7 @@ class DecodeRequest(Request):
     scheduler mutates as the request moves queue -> slot -> done."""
     __slots__ = ("prompt", "max_new", "tokens", "prompt_i", "slot",
                  "t_join", "n_steps", "t_first_tok", "t_last_tok",
-                 "on_token", "sse_id")
+                 "on_token", "sse_id", "uflops")
 
     def __init__(self, prompt, max_new, future, deadline=None,
                  trace=None, on_token=None, sse_id=None):
@@ -273,6 +274,10 @@ class DecodeRequest(Request):
         # feed the TTFT and inter-token (TPOT) histograms
         self.t_first_tok = None
         self.t_last_tok = None
+        # useful-FLOPs accumulator for tenant accounting (goodput.py):
+        # each dispatch this request rides adds its share; flushed to
+        # the tenant series when the slot finishes
+        self.uflops = 0
 
 
 class StepProgram(object):
@@ -1029,6 +1034,37 @@ class _DecodeTelemetry(object):
             "mxnet_serve_decode_steps_total",
             "decode step-program dispatches (each steps every live "
             "slot once)")
+        # slot-occupancy decomposition of every step dispatch (ISSUE
+        # 18 satellite): the persistent step always computes num_slots
+        # rows, so each dispatch splits exactly into live rows (a
+        # seated request advanced) and dead rows (masked slots riding
+        # along).  Scraped counters, not occupancy-gauge inference —
+        # the goodput plane's dead-slot FLOPs class divides out of
+        # these same integers.
+        self.slot_steps_live = reg.counter(
+            "mxnet_serve_decode_live_slot_steps_total",
+            "slot-steps computed for LIVE slots (a seated request's "
+            "row advanced one position) across decode step dispatches")
+        self.slot_steps_dead = reg.counter(
+            "mxnet_serve_decode_dead_slot_steps_total",
+            "slot-steps computed for DEAD slots (valid=0 rows riding "
+            "the fixed-extent persistent step) across decode step "
+            "dispatches")
+        # coalesced-prefill element split, per prompt bucket: live =
+        # real prompt positions, padded = the pow2 batch extent times
+        # the bucket length (what the program actually computed) minus
+        # live.  Bounded cardinality: one series per configured bucket.
+        self.prefill_live_elems = reg.counter(
+            "mxnet_serve_decode_prefill_live_elements_total",
+            "prompt positions carrying real tokens in coalesced "
+            "prefill dispatches, per prompt bucket",
+            labelnames=("bucket",))
+        self.prefill_padded_elems = reg.counter(
+            "mxnet_serve_decode_prefill_padded_elements_total",
+            "padding positions (batch-row and sequence overhang) in "
+            "coalesced prefill dispatches, per prompt bucket",
+            labelnames=("bucket",))
+        self._prefill_elem_handles = {}
         self.joins = reg.counter(
             "mxnet_serve_decode_joins_total",
             "requests that joined the running decode batch (slot "
@@ -1186,6 +1222,21 @@ class _DecodeTelemetry(object):
         (handle if handle is not None
          else self.leaves.labels(reason=reason)).inc()
 
+    def prefill_elems(self, bucket, live, padded):
+        """Count one coalesced prefill dispatch's element split under
+        its prompt-bucket label (handles memoized: the bucket set is
+        fixed at construction)."""
+        h = self._prefill_elem_handles.get(bucket)
+        if h is None:
+            b = str(bucket)
+            h = (self.prefill_live_elems.labels(bucket=b),
+                 self.prefill_padded_elems.labels(bucket=b))
+            self._prefill_elem_handles[bucket] = h
+        if live:
+            h[0].inc(live)
+        if padded:
+            h[1].inc(padded)
+
     def close(self):
         self.closed = True
         _telemetry.registry().unregister_callback(self._refresh)
@@ -1207,6 +1258,9 @@ class _DecodeTelemetry(object):
             return
         self.compile_count.set(eng.compile_count)
         refresh_memory_gauges(self, eng)
+        eff = getattr(eng, "_eff", None)
+        if eff is not None:
+            eff.refresh()
         if self.spec_drafted is not None:
             # GIL-atomic int reads: a collect-time callback must not
             # take scheduler locks
@@ -1562,6 +1616,17 @@ class DecodeEngine(object):
         self._slot_free = threading.Event()
         self._tm = (_DecodeTelemetry(self)
                     if _telemetry.enabled() else None)
+        # serving efficiency plane (ISSUE 18): per-dispatch FLOPs
+        # ledger + MFU/goodput gauges + per-tenant accounting.  Step
+        # programs are priced ONCE here (memoized on the program);
+        # prefill buckets price lazily in ProgramCache._plan_for.
+        self._eff = None
+        if self._tm is not None and _goodput.enabled():
+            self._eff = _goodput.EngineEfficiency(
+                "decode", self._tm.engine_label)
+            for r in self._replicas:
+                self._eff.add_replica(r.label, ctx=r.ctx)
+                _goodput.price_step_program(r.program)
         if self._tm is not None and self._aot is not None:
             self._aot.bind_telemetry(*(
                 fam.labels(engine=self._tm.engine_label)
@@ -2097,6 +2162,9 @@ class DecodeEngine(object):
                     rep.thread.join(timeout=None if drain else 60)
                     if not rep.thread.is_alive():
                         rep.thread = None
+        if self._eff is not None:
+            self._eff.close()
+            self._eff = None
         if self._tm is not None:
             self._tm.close()
         if self._obs_name is not None:
@@ -2122,7 +2190,7 @@ class DecodeEngine(object):
 
     # ------------------------------------------------------------- client
     def submit(self, prompt, max_new_tokens=None, deadline_ms=None,
-               on_token=None, request_id=None):
+               on_token=None, request_id=None, tenant=None):
         """Enqueue one generation request; returns a Future resolving
         to a :class:`DecodeResult`.
 
@@ -2147,7 +2215,15 @@ class DecodeEngine(object):
         request's generation by filtering on its id — and resume after
         a disconnect via the standard ``Last-Event-ID`` replay the
         EventHub already implements.  Requires telemetry; None (the
-        default) publishes nothing."""
+        default) publishes nothing.
+
+        ``tenant`` optionally attributes this request to an accounting
+        tenant: the serving-efficiency plane (telemetry/goodput.py)
+        then tracks its useful FLOPs, generated tokens, end-to-end
+        latency, and outcome under a bounded-cardinality ``tenant``
+        label (``MXNET_TELEMETRY_TENANTS_MAX`` distinct labels; later
+        tenants aggregate into ``"other"``).  Pure observability —
+        scheduling is tenant-blind."""
         if self._adm.closed:
             raise EngineClosedError("decode engine is closed")
         prompt = [int(t) for t in prompt]
@@ -2187,6 +2263,15 @@ class DecodeEngine(object):
             # one place every finish/failure/cancel path converges
             fut.add_done_callback(
                 lambda f, _req=req: self._emit_done(_req, f))
+        if tenant is not None and self._eff is not None:
+            # tenant accounting (goodput.py): resolve the label ONCE
+            # under the cardinality guard; outcome/latency/tokens ride
+            # the same every-outcome convergence point as the SSE frame
+            req.tenant = self._eff.tenant_enter(tenant)
+            if req.tenant is not None:
+                fut.add_done_callback(
+                    lambda f, _eff=self._eff, _t=req.tenant,
+                    _t0=req.t_enqueue: _eff.tenant_done(_t, f, _t0))
         # padded-element cost for the regulator's cost-aware shed: a
         # decode request prices as its bucketed prompt plus the
         # positions its generation budget can occupy.  Under
@@ -2725,6 +2810,10 @@ class DecodeEngine(object):
             self._leaves += 1
         if self._tm is not None:
             self._tm.leave("error")
+        if req.tenant is not None and req.uflops \
+                and self._eff is not None:
+            self._eff.tenant_useful(req.tenant, req.uflops)
+            req.uflops = 0
         _fail_future(req.future, exc)
         if req.trace is not None:
             req.trace.abort(type(exc).__name__)
@@ -2771,6 +2860,28 @@ class DecodeEngine(object):
             for req in live:
                 self._fail_seated(rep, req, e)
             return
+        # element split + FLOPs ledger for this one dispatch: the
+        # program computed bb*bucket positions; Σ prompt lengths of
+        # them carried real tokens, the rest were batch-row padding
+        # and sequence overhang
+        live_elems = int(sum(len(r.prompt) for r in live))
+        padded_elems = bb * bucket
+        if self._tm is not None:
+            self._tm.prefill_elems(bucket, live_elems,
+                                   padded_elems - live_elems)
+        if self._eff is not None:
+            shape_key = tuple(sorted(
+                (k, v.shape)
+                for k, v in ((self._prefill_data_name, arr),
+                             (self._prefill_len_name, lens))))
+            useful = self._eff.record_batch(
+                rep.label, rep.prefill_caches[bucket].flops_for(
+                    shape_key), live_elems, padded_elems)
+            if useful:
+                for req in live:
+                    if req.tenant is not None:
+                        req.uflops += (useful * len(req.prompt)
+                                       // live_elems)
         for r_i, req in enumerate(live):
             rows = {name: rows_all[i][r_i]
                     for i, name in enumerate(rep.program.state_names)}
@@ -2889,12 +3000,34 @@ class DecodeEngine(object):
                 rep.tokens_np, rep.pos_np, rep.valid_np, rep.spec_np,
                 rep.states, reset=rep.reset_np)
             rep.reset_np.fill(0.0)
+            if self._eff is not None:
+                # FLOPs ledger, BEFORE the slot advance (a slot that
+                # finishes this very step must still absorb its tenant
+                # share): committed positions = Σ counts over occupied
+                # slots (spec mask 0 rows commit exactly 1), the rest
+                # of the K-position verify window was rejected drafts
+                cl = counts.tolist()
+                committed = int(sum(cl[i] for i in occ))
+                self._ledger_step(
+                    rep, occ,
+                    self._eff.record_spec_step(
+                        rep.label,
+                        _goodput.price_step_program(rep.program),
+                        len(occ), self.num_slots, committed,
+                        self._spec_k + 1))
             new_tokens = self._advance_spec(rep, occ, toks_mat, counts)
         else:
             sampled, rep.states = rep.program.step(
                 rep.tokens_np, rep.pos_np, rep.valid_np, rep.states,
                 reset=rep.reset_np)
             rep.reset_np.fill(0.0)      # consumed: rows are zeroed now
+            if self._eff is not None:
+                self._ledger_step(
+                    rep, occ,
+                    self._eff.record_step(
+                        rep.label,
+                        _goodput.price_step_program(rep.program),
+                        len(occ), self.num_slots))
             # one C-level conversion instead of num_slots
             # ndarray-scalar __getitem__ calls: the slot loop below is
             # the scheduler's per-step GIL cost, and with replica
@@ -2938,6 +3071,27 @@ class DecodeEngine(object):
             if new_tokens:
                 self._tm.tokens.inc(new_tokens)
             rep.tm_step_ms.observe(dt_ms)
+            # slot-occupancy split of this dispatch (ISSUE 18
+            # satellite): the persistent step computed num_slots rows
+            # whatever the occupancy — scraped, not inferred
+            self._tm.slot_steps_live.inc(len(occ))
+            dead = self.num_slots - len(occ)
+            if dead:
+                self._tm.slot_steps_dead.inc(dead)
+
+    def _ledger_step(self, rep, occ, useful):
+        """Spread one step dispatch's useful FLOPs over the live slots
+        for tenant accounting (integer shares; the remainder stays in
+        the engine-level ledger, which is exact by construction)."""
+        if not useful:
+            return
+        share = useful // len(occ)
+        if not share:
+            return
+        for i in occ:
+            req = rep.slots[i]
+            if req.tenant is not None:
+                req.uflops += share
 
     def _advance_spec(self, rep, occ, toks_mat, counts):
         """The variable-width slot advance (ISSUE 15): slot ``i``
@@ -3043,6 +3197,13 @@ class DecodeEngine(object):
         t1 = time.perf_counter()
         res = DecodeResult(req.tokens, reason, n_steps=req.n_steps,
                            prompt_len=len(req.prompt))
+        if req.tenant is not None and req.uflops \
+                and self._eff is not None:
+            # flush the request's accumulated useful-FLOPs share to
+            # its tenant series (tokens/outcome/latency ride the
+            # future's done callback)
+            self._eff.tenant_useful(req.tenant, req.uflops)
+            req.uflops = 0
         if not req.future.cancelled():
             try:
                 req.future.set_result(res)
@@ -3206,6 +3367,9 @@ class DecodeEngine(object):
                 "aot": (self._aot.stats() if self._aot is not None
                         else {"enabled": False}),
                 "memory": _memory_stats_block(self.memory_plan),
+                "efficiency": (self._eff.stats_block()
+                               if self._eff is not None
+                               else {"enabled": False}),
                 "replicas": [r.describe() for r in self._replicas],
                 "prefill": ("bucket" if self._prefill_caches
                             else "step"),

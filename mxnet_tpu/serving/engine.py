@@ -69,6 +69,7 @@ import numpy as np
 from ..base import MXNetError
 from .. import profiler
 from .. import telemetry as _telemetry
+from ..telemetry import goodput as _goodput
 from . import faults as _faults
 from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
@@ -456,6 +457,9 @@ class _EngineTelemetry(object):
                                   for r in eng._replicas))
         self.compile_count.set(eng.compile_count)
         refresh_memory_gauges(self, eng)
+        eff = getattr(eng, "_eff", None)
+        if eff is not None:
+            eff.refresh()       # window MFU + goodput gauges per scrape
         for r in eng._replicas:
             self.replica_healthy.labels(
                 engine=self.engine_label,
@@ -663,6 +667,16 @@ class ServingEngine(object):
         # branch below gates on that, keeping the disabled hot path at
         # zero registry calls per request
         self._tm = _EngineTelemetry(self) if _telemetry.enabled() else None
+        # serving efficiency plane (telemetry/goodput.py): the FLOPs
+        # ledger + MFU/goodput gauges + tenant accounting.  None unless
+        # telemetry AND MXNET_SERVE_EFFICIENCY are on — the disabled
+        # dispatch path prices nothing and makes zero instrument calls
+        self._eff = None
+        if self._tm is not None and _goodput.enabled():
+            self._eff = _goodput.EngineEfficiency(
+                "serve", self._tm.engine_label)
+            for r in self._replicas:
+                self._eff.add_replica(r.label, ctx=r.ctx)
         if self._tm is not None:
             self._record_repair_telemetry()
             self._record_opt_telemetry()
@@ -1151,6 +1165,11 @@ class ServingEngine(object):
                     r.thread.join(timeout=None if drain else 60)
                     if not r.thread.is_alive():
                         r.thread = None
+        if self._eff is not None:
+            # ledger series (engine+replica+tenant children), healthz
+            # section refcount — reclaimed with the bundle
+            self._eff.close()
+            self._eff = None
         if self._tm is not None:
             self._tm.close()
         if self._obs_name is not None:
@@ -1250,10 +1269,16 @@ class ServingEngine(object):
             self._group_cache[sig] = out
         return out
 
-    def submit(self, value=None, deadline_ms=None, **feeds):
+    def submit(self, value=None, deadline_ms=None, tenant=None, **feeds):
         """Enqueue one request; returns a ``concurrent.futures.Future``
         resolving to the per-request output array (list of arrays for
         multi-output graphs).
+
+        ``tenant`` optionally names the submitting tenant for the
+        efficiency plane's per-tenant accounting (useful FLOPs,
+        outcome, e2e latency under a bounded-cardinality label;
+        telemetry/goodput.py).  Ignored — zero instrument calls —
+        when the plane is off.
 
         Raises :class:`QueueFullError` immediately under backpressure;
         the future fails with :class:`DeadlineExceededError` /
@@ -1294,6 +1319,16 @@ class ServingEngine(object):
                 trace = _telemetry.LazyTrace(self._trace_chain)
         req = Request(feeds, group, fut, deadline=deadline,
                       out_rows=out_rows, trace=trace, cost=cost)
+        if tenant is not None and self._eff is not None:
+            # resolve the tenant onto the bounded label set ONCE here;
+            # the done-callback covers every terminal path (result,
+            # error, cancel) for outcome/latency accounting, and
+            # _dispatch attributes the useful-FLOPs share by label
+            req.tenant = self._eff.tenant_enter(tenant)
+            if req.tenant is not None:
+                fut.add_done_callback(
+                    lambda f, _eff=self._eff, _t=req.tenant,
+                    _t0=req.t_enqueue: _eff.tenant_done(_t, f, _t0))
         try:
             if profiler.is_running():
                 with profiler.record_span("serve.enqueue", "serve"):
@@ -1780,6 +1815,24 @@ class ServingEngine(object):
             if padded_elems:
                 tm.pad_waste.labels(bucket=bucket).observe(
                     1.0 - live_elems / float(padded_elems))
+        eff = self._eff
+        if eff is not None:
+            # FLOPs ledger: the program was priced once at plan build
+            # (ProgramCache._plan_for); this dispatch splits its price
+            # into useful (live elements' floor-share) + padding, then
+            # attributes each tenant-labeled request its live-element
+            # share of the useful half
+            shape_key = tuple(sorted((k, v.shape)
+                              for k, v in feeds.items()))
+            useful = eff.record_batch(rep.label,
+                                      rep.cache.flops_for(shape_key),
+                                      live_elems, padded_elems)
+            if useful:
+                for r in reqs:
+                    if r.tenant is not None and live_elems:
+                        r_elems = sum(x.size for x in r.inputs.values())
+                        eff.tenant_useful(
+                            r.tenant, useful * r_elems // live_elems)
         if profiler.is_running():
             profiler.counter("serve.batch_occupancy", n / float(b))
 
@@ -2014,6 +2067,9 @@ class ServingEngine(object):
                                if self.opt_plan is not None else None),
                 },
                 "memory": _memory_stats_block(self.memory_plan),
+                "efficiency": (self._eff.stats_block()
+                               if self._eff is not None
+                               else {"enabled": False}),
                 "latency_ms": {
                     "count": len(lat),
                     "mean": float(np.mean(lat)) if lat else 0.0,

@@ -67,6 +67,10 @@ from .alerts import (AlertRule, AlertManager, default_manager,
                      register_engine_default_rules, load_rules_file)
 from .step import (StepTimer, PHASES, STEP_SECONDS_BUCKETS,
                    PEAKS_TFLOPS, peak_flops_for)
+# serving efficiency plane (goodput.py): exported as a submodule —
+# its enabled() composes the master switch with MXNET_SERVE_EFFICIENCY
+# and would shadow this package's enabled() if flattened
+from . import goodput
 
 __all__ = [
     "Registry", "Counter", "Gauge", "Histogram", "Family",
@@ -89,7 +93,7 @@ __all__ = [
     "AlertRule", "AlertManager", "default_manager",
     "register_engine_default_rules", "load_rules_file",
     "StepTimer", "PHASES", "STEP_SECONDS_BUCKETS", "PEAKS_TFLOPS",
-    "peak_flops_for",
+    "peak_flops_for", "goodput",
     "enabled", "set_enabled", "registry", "counter", "gauge",
     "histogram", "bound", "remove_labeled_series", "reset",
     "dump_state", "trace_sample_every",

@@ -551,6 +551,13 @@ def register_engine_default_rules(kind, engine_label, watchdog_s=None,
     - ``serve_deadline_miss_burn`` (shared): queued expiries + decode
       mid-generation evictions over requests against the same budget —
       the p99 deadline-miss SLO;
+    - ``serve_goodput_collapse_burn`` (shared): padding + dead-slot
+      FLOPs over total dispatched FLOPs (the ISSUE 18 efficiency
+      ledger) burning a 5% waste budget at 14.4x — fires when more
+      than ~72% of the fleet's compute is bucket padding and masked
+      decode slots for a sustained window (collapsed occupancy,
+      pathological bucket fit), NOT on speculative rejections, which
+      are a deliberate latency trade;
     - ``<kind>_engine<N>_stalled``: zero-progress watchdog over this
       engine's worker heartbeat (busy + no progress for
       ``MXNET_TELEMETRY_WATCHDOG_SECS``);
@@ -624,5 +631,20 @@ def register_engine_default_rules(kind, engine_label, watchdog_s=None,
                      "summary": "deadline misses (queued expiries + "
                                 "mid-generation evictions) are burning "
                                 "the 1% latency budget at page rate"}),
+        owner=owner, shared=True)
+    mgr.add_rule(AlertRule(
+        "serve_goodput_collapse_burn", "burn_rate",
+        num=("mxnet_serve_flops_padding_total",
+             "mxnet_serve_flops_dead_slot_total"),
+        den="mxnet_serve_flops_total", budget=0.05, factor=14.4,
+        short_window_s=60.0, long_window_s=600.0,
+        annotations={"slo": "goodput",
+                     "summary": "serving goodput collapsed: bucket "
+                                "padding + dead decode slots are "
+                                "burning the 5% waste-FLOPs budget at "
+                                "page rate (collapsed occupancy or "
+                                "pathological bucket fit — see "
+                                "stats()[...]['efficiency'] and "
+                                "tools/serve_report.py)"}),
         owner=owner, shared=True)
     return owner

@@ -604,6 +604,49 @@ def continuous_round(eng, jobs):
     return total, dt
 
 
+def _efficiency_advisory(eng, tps, stats=None):
+    """Advisory ISSUE 18 fields for a decode bench row: priced from
+    the SAME compile-time FLOPs ledger the serving efficiency plane
+    uses (telemetry/goodput.py price_step_program) — NO new timing
+    protocol, ``tps`` comes from the round already timed.
+
+    Per-token analytic price is one step dispatch amortized over the
+    slot pool (full occupancy yields one token per live slot-step);
+    ``serve_mfu`` divides by the device's PEAKS_TFLOPS entry (honest
+    None on CPU); ``goodput_ratio`` prefers the engine's exact ledger
+    ratio and falls back to tokens/(steps*slots) occupancy when the
+    plane is off."""
+    row = {"analytic_gflops_per_s": None, "serve_mfu": None,
+           "goodput_ratio": None}
+    price = None
+    try:
+        from mxnet_tpu.telemetry import goodput as _goodput
+        price = _goodput.price_step_program(eng._replicas[0].program)
+    except Exception:
+        pass
+    n = eng.num_slots
+    if price and tps:
+        gfs = tps * (price / float(n)) / 1e9
+        row["analytic_gflops_per_s"] = round(gfs, 4)
+        peak = None
+        try:
+            import jax
+            from mxnet_tpu.telemetry import peak_flops_for
+            peak = peak_flops_for(jax.devices()[0])
+        except Exception:
+            pass
+        if peak:
+            row["serve_mfu"] = round(gfs * 1e9 / peak, 6)
+    eff = (stats or {}).get("efficiency") or {}
+    g = eff.get("goodput_ratio")
+    if g is None and stats and stats.get("steps"):
+        g = (stats.get("tokens_generated", 0)
+             / float(stats["steps"] * n))
+    if g is not None:
+        row["goodput_ratio"] = round(g, 4)
+    return row
+
+
 def run_bench(requests=64, slots=8, max_len=128, mean_new=16, vocab=32,
               embed=16, hidden=128, seed=0, repeat=3):
     """One full comparison at a fixed geometry; returns the result row.
@@ -650,6 +693,7 @@ def run_bench(requests=64, slots=8, max_len=128, mean_new=16, vocab=32,
         best_c = max(best_c, c_tokens / c_dt)
     retraces = prog.trace_count + eng.compile_count - c0
     stats = eng.stats()["decode"]
+    adv = _efficiency_advisory(eng, best_c, stats)
     eng.close()
 
     row = {
@@ -672,6 +716,7 @@ def run_bench(requests=64, slots=8, max_len=128, mean_new=16, vocab=32,
         "predicted_peak_bytes":
             stats["memory"].get("predicted_peak_bytes"),
     }
+    row.update(adv)     # advisory efficiency fields (ISSUE 18)
     return row
 
 
@@ -750,6 +795,7 @@ def run_telemetry_overhead(requests=64, slots=8, max_len=128,
 
     off_tps = on_tps = 0.0
     centered, nulls = [], []
+    adv = {}
     try:
         for _ in range(max(1, repeats)):
             ta, dt_a = continuous_round(eng_off, jobs)
@@ -761,6 +807,8 @@ def run_telemetry_overhead(requests=64, slots=8, max_len=128,
             # tokens/s ratios: on/off > 1 means telemetry is FASTER
             centered.append((ta / dt_a + tb / dt_b) / 2.0 / (tn / dt_n))
             nulls.append(abs(1.0 - (ta / dt_a) / (tb / dt_b)))
+        adv = _efficiency_advisory(eng_on, on_tps,
+                                   eng_on.stats()["decode"])
     finally:
         stop_scrape.set()
         if scraper is not None:
@@ -771,7 +819,7 @@ def run_telemetry_overhead(requests=64, slots=8, max_len=128,
         eng_on.close()
     regression = 1.0 - 1.0 / statistics.median(centered)
     noise_floor = statistics.median(nulls)
-    return {
+    return dict(adv, **{
         "requests": requests,
         "slots": slots,
         "mean_new": mean_new,
@@ -786,7 +834,7 @@ def run_telemetry_overhead(requests=64, slots=8, max_len=128,
         "mean_scrape_ms": (round(scrapes[1] / scrapes[0] * 1e3, 3)
                            if scrapes[0] else None),
         "ok": regression < tol + noise_floor,
-    }
+    })
 
 
 def _merge_record(path, key, row):
@@ -899,6 +947,7 @@ def run_replica_sweep(requests=64, slots=8, max_len=128, mean_new=16,
             row["speedup_vs_1"] = round(speedups[k], 2)
             row["speedup_best_of"] = round(
                 best[k] / best[replica_counts[0]], 2)
+        row.update(_efficiency_advisory(eng, best[k], st))
         rows.append(row)
         eng.close()
     return {

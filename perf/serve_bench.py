@@ -141,7 +141,8 @@ def run_bench(requests=512, offered_batch=8, feature=512, hidden=1024,
     stats = eng.stats()
     retraces = eng.compile_count - warm_compiles
     eng.close()
-    return {
+    return dict(_efficiency_advisory(
+        net, feature, requests / engine_s, stats), **{
         "offered_batch": offered_batch,
         "requests": requests,
         "serial_rps": round(requests / serial_s, 1),
@@ -157,7 +158,47 @@ def run_bench(requests=512, offered_batch=8, feature=512, hidden=1024,
         # (analysis/memory.py), for joining against measured peaks
         "predicted_peak_bytes":
             stats["memory"].get("predicted_peak_bytes"),
-    }
+    })
+
+
+def _efficiency_advisory(net, feature, rps, stats, batch=8):
+    """Advisory ISSUE 18 fields for a bench row: priced from the SAME
+    compile-time FLOPs ledger the serving efficiency plane uses
+    (telemetry/goodput.py over analysis/flops.py) — NO new timing
+    protocol, ``rps`` comes from the round already timed.
+
+    ``analytic_gflops_per_s`` is request rate times the per-request
+    amortized bucket price; ``serve_mfu`` divides by the device's
+    PEAKS_TFLOPS entry (honest None on CPU hosts, which have no peak);
+    ``goodput_ratio`` prefers the engine's exact lifetime ledger ratio
+    and falls back to batch occupancy when the plane is off."""
+    row = {"analytic_gflops_per_s": None, "serve_mfu": None,
+           "goodput_ratio": None}
+    price = None
+    try:
+        from mxnet_tpu.telemetry import goodput as _goodput
+        price = _goodput.price_graph(net, {"data": (batch, feature)})
+    except Exception:
+        pass
+    if price and rps:
+        gfs = rps * (price / float(batch)) / 1e9
+        row["analytic_gflops_per_s"] = round(gfs, 4)
+        peak = None
+        try:
+            import jax
+            from mxnet_tpu.telemetry import peak_flops_for
+            peak = peak_flops_for(jax.devices()[0])
+        except Exception:
+            pass
+        if peak:
+            row["serve_mfu"] = round(gfs * 1e9 / peak, 6)
+    eff = (stats or {}).get("efficiency") or {}
+    g = eff.get("goodput_ratio")
+    if g is None:
+        g = (stats or {}).get("batch_occupancy")
+    if g is not None:
+        row["goodput_ratio"] = round(g, 4)
+    return row
 
 
 def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
@@ -252,6 +293,7 @@ def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
     import statistics
     off_s = on_s = float("inf")
     centered, nulls = [], []
+    on_stats = None
     try:
         for _ in range(repeats):
             off_a = closed_loop_round(eng_off, X, requests, offered_batch)
@@ -261,6 +303,7 @@ def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
             on_s = min(on_s, on_i)
             centered.append((off_a + off_b) / 2.0 / on_i)
             nulls.append(abs(1.0 - off_a / off_b))
+        on_stats = eng_on.stats()
     finally:
         stop_scrape.set()
         if scraper is not None:
@@ -271,7 +314,8 @@ def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
         eng_on.close()
     regression = 1.0 - statistics.median(centered)   # >0: telemetry slower
     noise_floor = statistics.median(nulls)
-    return {
+    return dict(_efficiency_advisory(
+        net, feature, requests / on_s, on_stats), **{
         "requests": requests,
         "offered_batch": offered_batch,
         "rps_telemetry_off": round(requests / off_s, 1),
@@ -284,7 +328,7 @@ def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
         "mean_scrape_ms": (round(scrapes[1] / scrapes[0] * 1e3, 3)
                            if scrapes[0] else None),
         "ok": regression < tol + noise_floor,
-    }
+    })
 
 
 def centered_sweep(counts, run_one, repeats):
@@ -443,6 +487,8 @@ def run_replica_sweep(requests=512, offered_batch=8, feature=512,
             "predicted_peak_bytes":
                 st["memory"].get("predicted_peak_bytes"),
         }
+        # advisory efficiency fields (ISSUE 18): same ledger pricing
+        row.update(_efficiency_advisory(net, feature, best[k], st))
         if k != base_k:
             row["speedup_vs_1"] = round(speedups[k], 2)
             row["speedup_best_of"] = round(best[k] / best[base_k], 2)

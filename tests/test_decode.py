@@ -717,3 +717,8 @@ def test_decode_bench_smoke():
     # scheduling wins on STEP COUNT even when host noise hides the
     # wall-clock win at smoke scale: continuous never steps more
     assert row["continuous_steps"] <= row["static_steps"]
+    # ISSUE 18 advisory efficiency fields priced from the FLOPs ledger
+    assert row["analytic_gflops_per_s"] is None \
+        or row["analytic_gflops_per_s"] > 0
+    assert 0 < row["goodput_ratio"] <= 1.0
+    assert "serve_mfu" in row           # honest None on CPU

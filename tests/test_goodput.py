@@ -1,0 +1,556 @@
+"""Serving efficiency plane tests (ISSUE 18: telemetry/goodput.py).
+
+Coverage per the issue contract: the per-dispatch FLOPs ledger priced
+ONCE per compiled program via ``analysis/flops.py`` — with the four
+disjoint classes (useful / padding / dead-slot / spec-rejected)
+conserving EXACTLY against hand-computed integer splits on a mixed
+one-shot + plain-decode + speculative workload — the per-tenant
+accounting dimension with its bounded-cardinality guard, the lifecycle
+law (bitwise-identical serving with the plane off, zero instrument
+calls with telemetry off, every series reclaimed at ``close()``, the
+healthz section registered only while a ledger lives), the satellite
+decode slot/prefill element counters, rank-snapshot aggregation of the
+new counters into ``rank="all"`` fleet rows, and the
+``tools/serve_report.py`` renderer from files, ``--url``, and N rank
+snapshots.
+"""
+import json
+import os
+import shutil
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving, telemetry
+from mxnet_tpu.serving import DecodeEngine
+from mxnet_tpu.telemetry import goodput
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from test_decode import _attn_step, _lstm_step, _sum_state_model  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.set_enabled(None)
+    telemetry.reset()
+    yield
+    telemetry.set_enabled(None)
+    telemetry.reset()
+
+
+def _mlp(feature=6, hidden=16, classes=3, seed=0):
+    """Loss-head-free MLP: its bucket price is exactly
+    ``price_graph(net, {"data": (bucket, feature)})`` with no label
+    plumbing in the way."""
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    rng = np.random.default_rng(seed)
+    params = {
+        "fc1_weight": mx.nd.array(
+            rng.standard_normal((hidden, feature)).astype(np.float32)),
+        "fc1_bias": mx.nd.zeros((hidden,)),
+        "fc2_weight": mx.nd.array(
+            rng.standard_normal((classes, hidden)).astype(np.float32)),
+        "fc2_bias": mx.nd.zeros((classes,)),
+    }
+    return net, params
+
+
+def _serve_engine(net, params, **kw):
+    kw.setdefault("ctx", mx.cpu())
+    kw.setdefault("batch_timeout_ms", 5.0)
+    return serving.ServingEngine(net, params, {}, {"data": (6,)}, **kw)
+
+
+def _val(name, **labels):
+    """Sum of a family's series values whose labels contain ``labels``
+    (registry collect() snapshot)."""
+    fam = telemetry.registry().collect().get(name)
+    if not fam:
+        return 0
+    return sum(s.get("value") or 0 for s in fam["series"]
+               if all(s["labels"].get(k) == v
+                      for k, v in labels.items()))
+
+
+def _series(name):
+    fam = telemetry.registry().collect().get(name)
+    return fam["series"] if fam else []
+
+
+def _import_tool(name):
+    tooldir = os.path.join(REPO, "tools")
+    sys.path.insert(0, tooldir)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.remove(tooldir)
+
+
+def _wait(cond, timeout=30.0):
+    """Spin until ``cond()`` — client futures resolve a few lines
+    BEFORE the worker's dispatch tail increments the ledger, so exact
+    counter assertions must wait out that window, never sleep-guess."""
+    import time
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.005)
+    return True
+
+
+CLASS_FAMILIES = ("mxnet_serve_flops_useful_total",
+                  "mxnet_serve_flops_padding_total",
+                  "mxnet_serve_flops_dead_slot_total",
+                  "mxnet_serve_flops_spec_rejected_total")
+
+ALL_FAMILIES = CLASS_FAMILIES + (
+    "mxnet_serve_flops_total",
+    "mxnet_serve_unpriced_dispatches_total",
+    "mxnet_serve_mfu",
+    "mxnet_serve_goodput_ratio",
+    "mxnet_serve_tenant_useful_flops_total",
+    "mxnet_serve_tenant_tokens_total",
+    "mxnet_serve_tenant_requests_total",
+    "mxnet_serve_tenant_latency_ms",
+    "mxnet_serve_tenant_overflow_total",
+)
+
+
+def _assert_conserved(engine_label):
+    total = _val("mxnet_serve_flops_total", engine=engine_label)
+    acct = sum(_val(f, engine=engine_label) for f in CLASS_FAMILIES)
+    assert acct == total, \
+        "classes sum to %r != total %r" % (acct, total)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# one-shot serving: hand-computed useful/padding split + tenants
+# ---------------------------------------------------------------------------
+
+def test_one_shot_split_hand_computed(monkeypatch):
+    """5 staged requests -> ONE bucket-8 dispatch: useful is the
+    live-element floor-share of the count_flops price, padding the
+    exact remainder, and each tenant gets its per-request floor-share
+    of the useful half — all pinned as INTEGER equalities, then every
+    series reclaimed at close()."""
+    monkeypatch.setenv("MXNET_TELEMETRY_ON", "1")
+    monkeypatch.setenv("MXNET_SERVE_EFFICIENCY", "1")
+    net, params = _mlp()
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((5, 6)).astype(np.float32)
+    eng = _serve_engine(net, params, start=False)
+    try:
+        eng.warmup()
+        futs = [eng.submit(X[i],
+                           tenant="acme" if i < 3 else "globex")
+                for i in range(5)]
+        eng.start()
+        [f.result(timeout=60) for f in futs]
+        lbl = eng._eff.engine_label
+
+        price = goodput.price_graph(net, {"data": (8, 6)})
+        assert price and price > 0
+        live, padded = 5 * 6, 8 * 6
+        useful = price * live // padded
+        # futures resolve a few lines before the worker's dispatch tail
+        # runs the ledger / the done-callbacks run the tenant accounting
+        assert _wait(lambda:
+                     _val("mxnet_serve_flops_total", engine=lbl) >= price
+                     and _val("mxnet_serve_tenant_requests_total",
+                              engine=lbl) >= 5)
+        st = eng.stats()
+        assert st["batches"] == 1
+        assert _val("mxnet_serve_flops_total", engine=lbl) == price
+        assert _val("mxnet_serve_flops_useful_total", engine=lbl) == useful
+        assert _val("mxnet_serve_flops_padding_total",
+                    engine=lbl) == price - useful
+        assert _val("mxnet_serve_flops_dead_slot_total", engine=lbl) == 0
+        assert _val("mxnet_serve_unpriced_dispatches_total",
+                    engine=lbl) == 0
+        _assert_conserved(lbl)
+
+        # per-tenant useful attribution: request floor-share, exactly
+        share = useful * 6 // live
+        assert _val("mxnet_serve_tenant_useful_flops_total", engine=lbl,
+                    tenant="acme") == 3 * share
+        assert _val("mxnet_serve_tenant_useful_flops_total", engine=lbl,
+                    tenant="globex") == 2 * share
+        assert _val("mxnet_serve_tenant_requests_total", engine=lbl,
+                    tenant="acme", outcome="ok") == 3
+        lat = [s for s in _series("mxnet_serve_tenant_latency_ms")
+               if s["labels"].get("engine") == lbl]
+        assert sum(s["count"] for s in lat) == 5
+
+        # stats()["efficiency"] mirrors the scrape, exactly
+        eff = st["efficiency"]
+        assert eff["flops"]["total"] == price
+        assert eff["flops"]["useful"] == useful
+        assert eff["goodput_ratio"] == useful / price
+        assert eff["tenants"]["distinct"] == 2
+
+        # the new series pass the repo's metric-name lint
+        assert telemetry.lint_metric_names() == []
+
+        # healthz section lives exactly as long as a ledger does
+        hz = goodput._healthz_section()
+        assert hz and ("serve_engine%s" % lbl) in hz
+    finally:
+        eng.close()
+    assert goodput._healthz_section() is None
+    for fam in ALL_FAMILIES:
+        assert not any(s["labels"].get("engine") == lbl
+                       for s in _series(fam)), fam
+
+
+# ---------------------------------------------------------------------------
+# decode: hand-computed useful/dead-slot split + slot-step counters
+# ---------------------------------------------------------------------------
+
+def test_decode_split_hand_computed(monkeypatch):
+    """One request riding a 2-slot pool: every step splits the step
+    price into dead = price*(vacant)//slots and useful = remainder;
+    the satellite slot-step counters carry the same occupancy; the
+    request's tenant absorbs the full useful share at finish."""
+    monkeypatch.setenv("MXNET_TELEMETRY_ON", "1")
+    monkeypatch.setenv("MXNET_SERVE_EFFICIENCY", "1")
+    step, params, state_info = _lstm_step()
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                       max_len=16, default_deadline_ms=0)
+    eng.warmup()
+    try:
+        res = eng.submit([1, 2, 3], max_new_tokens=6,
+                         tenant="acme").result(timeout=120)
+        lbl = eng._eff.engine_label
+        price = goodput.price_step_program(eng._replicas[0].program)
+        assert price and price > 0
+        # the final step's future resolves before the worker increments
+        # the steps counter / the done-callback lands: wait for quiescence
+        assert _wait(lambda:
+                     eng.stats()["decode"]["steps"] * price
+                     == _val("mxnet_serve_flops_total", engine=lbl)
+                     and _val("mxnet_serve_tenant_requests_total",
+                              engine=lbl) >= 1)
+        steps = eng.stats()["decode"]["steps"]
+        assert steps > 0 and res.finish_reason in ("length", "eos")
+
+        dead = steps * (price * 1 // 2)     # 1 vacant of 2, every step
+        useful = steps * price - dead
+        assert _val("mxnet_serve_flops_total", engine=lbl) == steps * price
+        assert _val("mxnet_serve_flops_dead_slot_total", engine=lbl) == dead
+        assert _val("mxnet_serve_flops_useful_total", engine=lbl) == useful
+        assert _val("mxnet_serve_flops_padding_total", engine=lbl) == 0
+        _assert_conserved(lbl)
+
+        # satellite: decomposition occupancy from scraped counters alone
+        assert _val("mxnet_serve_decode_live_slot_steps_total") == steps
+        assert _val("mxnet_serve_decode_dead_slot_steps_total") == steps
+
+        # sole live slot -> the tenant absorbs every useful FLOP
+        assert _val("mxnet_serve_tenant_useful_flops_total", engine=lbl,
+                    tenant="acme") == useful
+        assert _val("mxnet_serve_tenant_tokens_total", engine=lbl,
+                    tenant="acme") == len(res.tokens)
+        assert _val("mxnet_serve_tenant_requests_total", engine=lbl,
+                    tenant="acme", outcome=res.finish_reason) == 1
+    finally:
+        eng.close()
+    for fam in ALL_FAMILIES:
+        assert not any(s["labels"].get("engine") == lbl
+                       for s in _series(fam)), fam
+
+
+def test_spec_decode_conservation_exact(monkeypatch):
+    """Speculative draft-k-verify: the step price is K*(draft+target)
+    forwards, vacant slots price as dead exactly as in plain decode,
+    and whatever the acceptance test discarded lands in spec-rejected
+    — the three classes + useful conserving bitwise against
+    steps*price."""
+    monkeypatch.setenv("MXNET_TELEMETRY_ON", "1")
+    monkeypatch.setenv("MXNET_SERVE_EFFICIENCY", "1")
+    step, params, state_info = _attn_step()
+    draft, dparams, dstate = _attn_step(seed=1)
+    for si in state_info + dstate:
+        if len(si["shape"]) >= 2:
+            si["cache"] = True
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                       max_len=16, default_deadline_ms=0,
+                       draft_sym=draft, draft_arg_params=dparams,
+                       draft_state_info=dstate, spec_k=2)
+    try:
+        eng.warmup()
+        res = eng.submit([1, 2], max_new_tokens=6,
+                         tenant="acme").result(timeout=120)
+        lbl = eng._eff.engine_label
+        price = goodput.price_step_program(eng._replicas[0].program)
+        assert price and price > 0
+        assert _wait(lambda:
+                     eng.stats()["decode"]["steps"] * price
+                     == _val("mxnet_serve_flops_total", engine=lbl))
+        steps = eng.stats()["decode"]["steps"]
+        assert steps > 0 and res.finish_reason in ("length", "eos")
+        total = _assert_conserved(lbl)
+        assert total == steps * price
+        # occupancy 1/2 every dispatched step, exactly as in plain decode
+        assert _val("mxnet_serve_flops_dead_slot_total",
+                    engine=lbl) == steps * (price * 1 // 2)
+        # something was committed and (at k=2 with a mismatched draft)
+        # something was rejected
+        assert _val("mxnet_serve_flops_useful_total", engine=lbl) > 0
+        assert _val("mxnet_serve_flops_spec_rejected_total",
+                    engine=lbl) >= 0
+        assert _val("mxnet_serve_flops_padding_total", engine=lbl) == 0
+        assert _val("mxnet_serve_unpriced_dispatches_total",
+                    engine=lbl) == 0
+    finally:
+        eng.close()
+
+
+def test_prefill_split_and_element_counters(monkeypatch):
+    """Coalesced prefill dispatches price like one-shot batches:
+    prompt-bucket padding overhang is the padding class, and the
+    satellite per-bucket element counters carry the exact live/pad
+    position counts."""
+    monkeypatch.setenv("MXNET_TELEMETRY_ON", "1")
+    monkeypatch.setenv("MXNET_SERVE_EFFICIENCY", "1")
+    step, prefill, params, state_info = _sum_state_model()
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                       max_len=16, default_deadline_ms=0,
+                       prefill_sym=prefill)
+    try:
+        eng.warmup()
+        res = eng.submit([1, 2, 3], max_new_tokens=4,
+                         tenant="acme").result(timeout=120)
+        lbl = eng._eff.engine_label
+        step_price = goodput.price_step_program(eng._replicas[0].program)
+        assert step_price and step_price > 0
+        # the prefill bucket program's own compile-time price: the same
+        # run()-side shape key the dispatch ledger reads (bb=1, bucket=4)
+        rep = eng._replicas[0]
+        key = tuple(sorted(((eng._prefill_data_name, (1, 4)),
+                            (eng._prefill_len_name, (1,)))))
+        prefill_price = rep.prefill_caches[4].flops_for(key)
+        assert prefill_price and prefill_price > 0
+        # quiesce: one prefill dispatch + the steps, exactly
+        assert _wait(lambda:
+                     _val("mxnet_serve_flops_total", engine=lbl)
+                     == prefill_price
+                     + eng.stats()["decode"]["steps"] * step_price
+                     and _val("mxnet_serve_tenant_requests_total",
+                              engine=lbl) >= 1)
+        steps = eng.stats()["decode"]["steps"]
+        assert res.finish_reason in ("length", "eos")
+        total = _assert_conserved(lbl)
+        assert total == prefill_price + steps * step_price
+        pad = prefill_price - prefill_price * 3 // 4
+        assert _val("mxnet_serve_flops_padding_total", engine=lbl) == pad
+        assert _val("mxnet_serve_flops_dead_slot_total",
+                    engine=lbl) == steps * (step_price * 1 // 2)
+        assert _val("mxnet_serve_unpriced_dispatches_total",
+                    engine=lbl) == 0
+        # satellite: exact per-bucket prefill element counters
+        assert _val("mxnet_serve_decode_prefill_live_elements_total",
+                    bucket="4") == 3
+        assert _val("mxnet_serve_decode_prefill_padded_elements_total",
+                    bucket="4") == 1
+        # the tenant absorbed its prefill share too (sole live request)
+        assert _val("mxnet_serve_tenant_useful_flops_total", engine=lbl,
+                    tenant="acme") == \
+            _val("mxnet_serve_flops_useful_total", engine=lbl)
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# tenant cardinality guard
+# ---------------------------------------------------------------------------
+
+def test_tenant_cardinality_overflow(monkeypatch):
+    """The first MXNET_TELEMETRY_TENANTS_MAX distinct tenants get
+    labels; later ones collapse into the reserved "other" and count
+    the overflow — and "other" can never claim a slot of its own."""
+    monkeypatch.setenv("MXNET_TELEMETRY_ON", "1")
+    monkeypatch.setenv("MXNET_SERVE_EFFICIENCY", "1")
+    monkeypatch.setenv("MXNET_TELEMETRY_TENANTS_MAX", "2")
+    net, params = _mlp()
+    eng = _serve_engine(net, params)
+    try:
+        eng.warmup()
+        X = np.zeros((6,), np.float32)
+        # "other" submitted FIRST must not occupy one of the 2 slots
+        for t in ("other", "t0", "t1", "t2", "t3", "t2"):
+            eng.submit(X, tenant=t).result(timeout=60)
+        lbl = eng._eff.engine_label
+        # each submit rode its own bucket-1 batch; quiesce on the exact
+        # ledger total + all six done-callbacks before reading counters
+        price1 = goodput.price_graph(net, {"data": (1, 6)})
+        assert _wait(lambda:
+                     _val("mxnet_serve_flops_total", engine=lbl)
+                     == 6 * price1
+                     and _val("mxnet_serve_tenant_requests_total",
+                              engine=lbl) >= 6)
+        st = eng.stats()["efficiency"]
+
+        tenants = {s["labels"]["tenant"]
+                   for s in _series("mxnet_serve_tenant_requests_total")
+                   if s["labels"].get("engine") == lbl}
+        assert tenants == {"t0", "t1", "other"}
+        # other/t2/t3/t2 overflowed; t0/t1 hold the two label slots
+        assert _val("mxnet_serve_tenant_overflow_total", engine=lbl) == 4
+        assert _val("mxnet_serve_tenant_requests_total", engine=lbl,
+                    tenant="other") == 4
+        assert st["tenants"] == {"distinct": 2, "max": 2, "overflowed": 4}
+        _assert_conserved(lbl)
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle law: bitwise off, zero instrument calls, no series
+# ---------------------------------------------------------------------------
+
+def test_efficiency_off_is_bitwise_and_unregistered(monkeypatch):
+    """MXNET_SERVE_EFFICIENCY=0 with telemetry ON: engines hold no
+    ledger, no mxnet_serve_flops/tenant series exist, stats() says
+    disabled — and decode emits bitwise-identical tokens either way."""
+    monkeypatch.setenv("MXNET_TELEMETRY_ON", "1")
+    step, params, state_info = _lstm_step()
+    toks = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("MXNET_SERVE_EFFICIENCY", flag)
+        eng = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                           max_len=16, default_deadline_ms=0)
+        eng.warmup()
+        futs = [eng.submit(p, max_new_tokens=6, tenant="acme")
+                for p in ([1, 2, 3], [5, 1])]
+        toks[flag] = [list(f.result(timeout=120).tokens) for f in futs]
+        st = eng.stats()["decode"]
+        if flag == "0":
+            assert eng._eff is None
+            assert st["efficiency"] == {"enabled": False}
+            assert _series("mxnet_serve_flops_total") == []
+            assert _series("mxnet_serve_tenant_requests_total") == []
+        else:
+            assert st["efficiency"]["flops"]["total"] > 0
+        eng.close()
+    assert toks["0"] == toks["1"]
+
+
+def test_telemetry_off_zero_instrument_calls(monkeypatch):
+    """MXNET_TELEMETRY_ON=0 blanks the whole plane: a tenant-labeled
+    decode run makes ZERO registry instrument calls and registers no
+    family — the disabled hot path never even prices a program."""
+    monkeypatch.setenv("MXNET_TELEMETRY_ON", "0")
+    telemetry.set_enabled(None)
+    step, params, state_info = _lstm_step()
+    eng = DecodeEngine(step, params, {}, state_info, num_slots=2,
+                       max_len=16, default_deadline_ms=0)
+    eng.warmup()
+    eng.submit([1, 2, 3], max_new_tokens=4,
+               tenant="acme").result(timeout=120)
+    assert eng._eff is None
+    eng.close()
+    reg = telemetry.registry()
+    assert reg.instrument_calls() == 0
+    assert reg.families() == []
+
+
+# ---------------------------------------------------------------------------
+# serve_report: offline snapshot, rank aggregation, live --url
+# ---------------------------------------------------------------------------
+
+def test_serve_report_offline_rank_and_url(monkeypatch, tmp_path,
+                                           capsys):
+    """End-to-end render of the decomposition table from (a) one
+    snapshot file, (b) two rank snapshots aggregated into the
+    rank="all" fleet row with counters summed exactly (the satellite
+    telemetry_dump.aggregate contract), and (c) a live --url endpoint
+    whose /healthz carries the serve_efficiency section."""
+    monkeypatch.setenv("MXNET_TELEMETRY_ON", "1")
+    monkeypatch.setenv("MXNET_SERVE_EFFICIENCY", "1")
+    net, params = _mlp()
+    eng = _serve_engine(net, params, start=False)
+    try:
+        eng.warmup()
+        X = np.random.default_rng(3).standard_normal((5, 6)).astype(
+            np.float32)
+        futs = [eng.submit(X[i], tenant="acme") for i in range(5)]
+        eng.start()
+        [f.result(timeout=60) for f in futs]
+        lbl = eng._eff.engine_label
+        # futures resolve before the worker tail records the batch and
+        # the tenant done-callbacks land: quiesce before capturing totals
+        assert _wait(lambda:
+                     _val("mxnet_serve_flops_total", engine=lbl) > 0
+                     and _val("mxnet_serve_tenant_requests_total",
+                              engine=lbl) >= 5)
+        total = _val("mxnet_serve_flops_total", engine=lbl)
+        useful = _val("mxnet_serve_flops_useful_total", engine=lbl)
+        t_useful = _val("mxnet_serve_tenant_useful_flops_total",
+                        engine=lbl, tenant="acme")
+        assert total > 0 and t_useful > 0
+
+        srv = telemetry.start_server(0, host="127.0.0.1")
+        try:
+            base = "http://127.0.0.1:%d" % srv.port
+            hz = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=10).read())
+            assert "serve_efficiency" in hz
+            sec = hz["serve_efficiency"]["serve_engine%s" % lbl]
+            assert sec["flops"]["total"] == total
+
+            serve_report = _import_tool("serve_report")
+            assert serve_report.main(["--url", base]) == 0
+            out = capsys.readouterr().out
+            assert "engine=%s" % lbl in out and "useful" in out
+            assert "acme" in out
+        finally:
+            telemetry.stop_server()
+
+        p0 = str(tmp_path / "telemetry_rank0.json")
+        telemetry.dump_state(p0)
+    finally:
+        eng.close()
+    p1 = str(tmp_path / "telemetry_rank1.json")
+    shutil.copy(p0, p1)
+
+    # (a) one offline snapshot renders the same table
+    assert serve_report.main([p0]) == 0
+    out = capsys.readouterr().out
+    assert "engine=%s" % lbl in out and "spec-rejected" in out
+
+    # (b) two rank snapshots: counters sum EXACTLY into rank="all"
+    assert serve_report.main(["--json", p0, p1]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    rows = {(r["engine"], r["rank"]): r for r in doc["engines"]}
+    fleet = rows[(lbl, "all")]
+    assert fleet["total"] == 2 * total
+    assert fleet["flops"]["useful"] == 2 * useful
+    assert sum(fleet["flops"].values()) == fleet["total"]
+    assert fleet["tenants"]["acme"]["useful_flops"] == 2 * t_useful
+
+    # the aggregate_docs satellite, pinned directly: every flops
+    # counter gains a summed rank="all" series
+    telemetry_dump = _import_tool("telemetry_dump")
+    base_doc = telemetry_dump.load_doc(p0)
+    merged = telemetry_dump.aggregate_docs([("0", base_doc),
+                                            ("1", base_doc)])
+    fam = merged["metrics"]["mxnet_serve_flops_total"]
+    alls = [s for s in fam["series"]
+            if s["labels"].get("rank") == "all"
+            and s["labels"].get("engine") == lbl]
+    assert sum(s["value"] for s in alls) == 2 * total
+
+    # empty snapshot -> exit 1 with the hint, not a crash
+    empty = str(tmp_path / "empty.json")
+    with open(empty, "w") as f:
+        json.dump({"metrics": {}}, f)
+    assert serve_report.main([empty]) == 1

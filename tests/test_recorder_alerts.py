@@ -809,7 +809,7 @@ def test_reload_loop_reclaims_rules_heartbeats_recorder(monkeypatch):
         eng = _engine(net, params)
         assert eng._owns_recorder
         assert telemetry.get_recorder() is not None
-        assert len(mgr) == 4               # watchdog+retrace+2 shared burns
+        assert len(mgr) == 5               # watchdog+retrace+3 shared burns
         assert len(telemetry.heartbeats()) == 1
         eng.close()
         assert telemetry.get_recorder() is None
@@ -820,10 +820,10 @@ def test_reload_loop_reclaims_rules_heartbeats_recorder(monkeypatch):
     # co-resident engines: shared burn rules refcount, last close wins
     e1 = _engine(net, params)
     e2 = _engine(net, params)
-    assert len(mgr) == 6                   # 2x(watchdog+retrace) + 2 shared
+    assert len(mgr) == 7                   # 2x(watchdog+retrace) + 3 shared
     assert len(telemetry.heartbeats()) == 2
     e1.close()
-    assert len(mgr) == 4                   # e2's rules + shared survive
+    assert len(mgr) == 5                   # e2's rules + shared survive
     assert telemetry.get_recorder() is not None
     e2.close()
     assert len(mgr) == 0 and telemetry.get_recorder() is None

@@ -429,7 +429,22 @@ def test_reload_loop_leak_gate_with_replicas(_fresh_telemetry):
                      "mxnet_serve_decode_step_ms",
                      "mxnet_serve_memory_predicted_peak_bytes",
                      "mxnet_serve_memory_measured_peak_bytes",
-                     "mxnet_serve_queue_depth"):
+                     "mxnet_serve_queue_depth",
+                     # serving efficiency plane (ISSUE 18): every
+                     # engine-labeled ledger/gauge/tenant series
+                     "mxnet_serve_flops_total",
+                     "mxnet_serve_flops_useful_total",
+                     "mxnet_serve_flops_padding_total",
+                     "mxnet_serve_flops_dead_slot_total",
+                     "mxnet_serve_flops_spec_rejected_total",
+                     "mxnet_serve_unpriced_dispatches_total",
+                     "mxnet_serve_mfu",
+                     "mxnet_serve_goodput_ratio",
+                     "mxnet_serve_tenant_useful_flops_total",
+                     "mxnet_serve_tenant_tokens_total",
+                     "mxnet_serve_tenant_requests_total",
+                     "mxnet_serve_tenant_latency_ms",
+                     "mxnet_serve_tenant_overflow_total"):
         fam = reg.get(fam_name)
         assert fam is None or fam.series() == [], fam_name
     assert reg._callbacks == []

@@ -404,6 +404,11 @@ def test_serve_bench_smoke():
     assert res["requests"] == 48
     assert 0 < res["batch_occupancy"] <= 1.0
     assert res["p99_ms"] >= res["p50_ms"] >= 0
+    # ISSUE 18 advisory efficiency fields priced from the FLOPs ledger
+    assert res["analytic_gflops_per_s"] is None \
+        or res["analytic_gflops_per_s"] > 0
+    assert 0 < res["goodput_ratio"] <= 1.0
+    assert "serve_mfu" in res           # honest None on CPU
 
 
 # ---------------------------------------------------------------------------

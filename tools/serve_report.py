@@ -1,0 +1,276 @@
+"""Serving efficiency report: where do the fleet's serving FLOPs go?
+
+The serving analog of ``step_report.py``, over the ISSUE 18 efficiency
+plane (mxnet_tpu/telemetry/goodput.py).  Renders one decomposition
+table per engine (and per rank, for aggregated snapshots): the four
+disjoint FLOPs classes every dispatch splits into —
+
+- **useful**: live rows x valid positions (the work a client asked for),
+- **padding**: pow2-bucket batch rows and sequence-pad overhang,
+- **dead-slot**: vacant decode slots riding the persistent step masked,
+- **spec-rejected**: draft+verify FLOPs for speculative tokens the
+  target model discarded
+
+— which sum EXACTLY to the total (integer conservation, pinned by
+tests), plus the goodput ratio, per-replica serving MFU, unpriced
+dispatches, and a per-tenant accounting table (useful FLOPs, tokens,
+request outcomes, mean end-to-end latency) when requests carried
+``submit(tenant=...)`` labels.
+
+Sources: a telemetry JSON snapshot (``telemetry.dump_state``, the
+snapshot thread, or a rank snapshot), a live endpoint via ``--url``,
+or SEVERAL rank snapshots — aggregated first (tools/telemetry_dump.py
+machinery): FLOPs-class counters sum into ``rank="all"`` fleet rows,
+while the MFU / goodput gauges render their min/max/argmax spread (a
+summed ratio would lie; the spread names the straggling rank)::
+
+  python tools/serve_report.py telemetry.json
+  python tools/serve_report.py --url http://host:9100
+  python tools/serve_report.py shared/telemetry_rank*.json
+"""
+import argparse
+import json
+import sys
+
+from telemetry_dump import load_doc, aggregate_docs, _doc_rank
+
+#: decomposition row order; (metric suffix, display name)
+CLASSES = (("useful", "useful"),
+           ("padding", "padding"),
+           ("dead_slot", "dead-slot"),
+           ("spec_rejected", "spec-rejected"))
+
+
+def _series(metrics, name):
+    return (metrics.get(name) or {}).get("series", [])
+
+
+def _flops_name(cls):
+    return ("mxnet_serve_flops_total" if cls == "total"
+            else "mxnet_serve_flops_%s_total" % cls)
+
+
+def build_report(doc):
+    """{(engine, rank): table dict} from one (possibly aggregated)
+    telemetry document.  ``rank`` is None for single-host snapshots;
+    aggregated docs contribute their ``rank="all"`` fleet sums."""
+    metrics = doc.get("metrics", {})
+    out = {}
+    for cls in ("total",) + tuple(c for c, _ in CLASSES):
+        for s in _series(metrics, _flops_name(cls)):
+            lab = s.get("labels", {})
+            key = (lab.get("engine", "?"), lab.get("rank"))
+            if key[1] is not None and key[1] != "all":
+                continue    # per-rank detail lives in the gauge spread
+            row = out.setdefault(key, {
+                "engine": key[0], "rank": key[1],
+                "flops": {c: 0 for c, _ in CLASSES},
+                "total": 0, "replicas": {}, "tenants": {}})
+            # engine totals sum over the replica label
+            if cls == "total":
+                row["total"] += s.get("value") or 0
+                rep = lab.get("replica")
+                if rep is not None:
+                    row["replicas"].setdefault(rep, {})
+            else:
+                row["flops"][cls] += s.get("value") or 0
+    for s in _series(metrics, "mxnet_serve_mfu"):
+        lab = s.get("labels", {})
+        key = (lab.get("engine", "?"), lab.get("rank"))
+        row = out.get((key[0], None)) or out.get(key)
+        if row is not None and s.get("value") is not None:
+            row["replicas"].setdefault(
+                lab.get("replica", "?"), {})["mfu"] = s["value"]
+    for s in _series(metrics, "mxnet_serve_goodput_ratio"):
+        lab = s.get("labels", {})
+        row = out.get((lab.get("engine", "?"), lab.get("rank"))) \
+            or out.get((lab.get("engine", "?"), None))
+        if row is not None and s.get("value") is not None:
+            row["goodput_gauge"] = s["value"]
+    for s in _series(metrics, "mxnet_serve_unpriced_dispatches_total"):
+        lab = s.get("labels", {})
+        key = (lab.get("engine", "?"), lab.get("rank"))
+        if key[1] is not None and key[1] != "all":
+            continue
+        row = out.get(key) or out.get((key[0], None))
+        if row is not None:
+            row["unpriced"] = (row.get("unpriced", 0)
+                               + (s.get("value") or 0))
+    _fold_tenants(metrics, out)
+    return out
+
+
+def _fold_tenants(metrics, out):
+    def _row_for(lab):
+        key = (lab.get("engine", "?"), lab.get("rank"))
+        if key[1] is not None and key[1] != "all":
+            return None
+        return out.get(key) or out.get((key[0], None))
+
+    for name, field in (("mxnet_serve_tenant_useful_flops_total",
+                         "useful_flops"),
+                        ("mxnet_serve_tenant_tokens_total", "tokens")):
+        for s in _series(metrics, name):
+            lab = s.get("labels", {})
+            row = _row_for(lab)
+            if row is None:
+                continue
+            t = row["tenants"].setdefault(lab.get("tenant", "?"),
+                                          {"outcomes": {}})
+            t[field] = t.get(field, 0) + (s.get("value") or 0)
+    for s in _series(metrics, "mxnet_serve_tenant_requests_total"):
+        lab = s.get("labels", {})
+        row = _row_for(lab)
+        if row is None:
+            continue
+        t = row["tenants"].setdefault(lab.get("tenant", "?"),
+                                      {"outcomes": {}})
+        oc = lab.get("outcome", "?")
+        t["outcomes"][oc] = t["outcomes"].get(oc, 0) \
+            + (s.get("value") or 0)
+    for s in _series(metrics, "mxnet_serve_tenant_latency_ms"):
+        lab = s.get("labels", {})
+        row = _row_for(lab)
+        if row is None or not s.get("count"):
+            continue
+        t = row["tenants"].setdefault(lab.get("tenant", "?"),
+                                      {"outcomes": {}})
+        t["latency_sum_ms"] = t.get("latency_sum_ms", 0.0) \
+            + (s.get("sum") or 0.0)
+        t["latency_count"] = t.get("latency_count", 0) + s["count"]
+
+
+def format_table(row):
+    lines = []
+    head = "engine=%s" % row["engine"]
+    if row.get("rank"):
+        head += " rank=%s" % row["rank"]
+    total = row["total"]
+    lines.append("%s  (total %.6g FLOPs dispatched)" % (head, total))
+    lines.append("  %-16s %16s %9s" % ("class", "FLOPs", "% total"))
+    acct = 0
+    for cls, disp in CLASSES:
+        v = row["flops"][cls]
+        acct += v
+        lines.append("  %-16s %16.6g %8.2f%%"
+                     % (disp, v, v / total * 1e2 if total else 0))
+    lines.append("  %-16s %16.6g %8.2f%%"
+                 % ("total", total, 100.0 if total else 0))
+    if total and abs(acct - total) > 0.5:
+        # the conservation law is pinned by tests; a broken snapshot
+        # (partial scrape, mixed versions) must confess, not hide
+        lines.append("  !! classes sum to %.6g != total %.6g" %
+                     (acct, total))
+    scal = ["goodput=%.4f" % (row["flops"]["useful"] / total)] \
+        if total else []
+    if row.get("goodput_gauge") is not None:
+        scal.append("window_goodput=%.4f" % row["goodput_gauge"])
+    if row.get("unpriced"):
+        scal.append("unpriced_dispatches=%d" % row["unpriced"])
+    if scal:
+        lines.append("  " + "  ".join(scal))
+    for rep in sorted(row["replicas"]):
+        mfu = row["replicas"][rep].get("mfu")
+        if mfu is not None:
+            lines.append("  replica %-4s mfu=%.6f" % (rep, mfu))
+    if row["tenants"]:
+        lines.append("  %-16s %14s %8s %8s %12s  %s"
+                     % ("tenant", "useful FLOPs", "tokens", "reqs",
+                        "mean e2e ms", "outcomes"))
+        for t in sorted(row["tenants"]):
+            d = row["tenants"][t]
+            reqs = sum(d["outcomes"].values())
+            mean = (d.get("latency_sum_ms", 0.0)
+                    / d["latency_count"]
+                    if d.get("latency_count") else None)
+            lines.append("  %-16s %14.6g %8d %8d %12s  %s"
+                         % (t, d.get("useful_flops", 0),
+                            d.get("tokens", 0), reqs,
+                            "%.2f" % mean if mean is not None else "-",
+                            ",".join("%s=%d" % kv for kv in
+                                     sorted(d["outcomes"].items()))))
+    return "\n".join(lines)
+
+
+def format_spread(doc):
+    """MFU / goodput gauge spread across ranks: aggregate_docs never
+    sums gauges — the straggler (argmin MFU) is the point."""
+    rows = []
+    for name in ("mxnet_serve_mfu", "mxnet_serve_goodput_ratio"):
+        for labels, v in sorted(((doc.get("gauge_spread") or {})
+                                 .get(name) or {}).items()):
+            rows.append("  %-44s min %s@rank %s, max %s@rank %s"
+                        % (name + labels,
+                           "%.4f" % v["min"], v["min_rank"],
+                           "%.4f" % v["max"], v["max_rank"]))
+    if not rows:
+        return ""
+    return "efficiency gauge spread across ranks (straggler view):\n" \
+        + "\n".join(rows)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render the serving FLOPs-decomposition table")
+    ap.add_argument("files", nargs="*",
+                    help="telemetry JSON snapshot(s); two or more "
+                         "rank snapshots are aggregated first")
+    ap.add_argument("--url",
+                    help="scrape a live MXNET_TELEMETRY_PORT endpoint "
+                         "instead of reading files")
+    ap.add_argument("--engine", help="only report this engine label")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report instead of text")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        doc = load_doc(args.url)
+    elif len(args.files) == 1:
+        doc = load_doc(args.files[0])
+    elif len(args.files) > 1:
+        used, entries = set(), []
+        for i, src in enumerate(args.files):
+            d = load_doc(src)
+            if "text" in d:
+                print("serve_report needs JSON snapshots; %r is "
+                      "Prometheus text" % src, file=sys.stderr)
+                return 2
+            entries.append((_doc_rank(d, src, i, used), d))
+        doc = aggregate_docs(entries)
+    else:
+        print("serve_report: pass snapshot file(s) or --url "
+              "http://host:port", file=sys.stderr)
+        return 2
+    if "text" in doc:
+        print("serve_report needs a JSON snapshot (got Prometheus "
+              "text); re-dump with MXNET_TELEMETRY_SNAPSHOT_FORMAT="
+              "json or use /metrics.json", file=sys.stderr)
+        return 2
+
+    report = build_report(doc)
+    if args.engine:
+        report = {k: v for k, v in report.items()
+                  if k[0] == args.engine}
+    if args.as_json:
+        out = {"engines": sorted(
+            report.values(),
+            key=lambda r: (r["engine"], r["rank"] or "")),
+            "gauge_spread": doc.get("gauge_spread") or {}}
+        print(json.dumps(out, indent=1, sort_keys=True))
+        return 0
+    if not report:
+        print("(no mxnet_serve_flops_total series — did the engine "
+              "run with MXNET_TELEMETRY_ON=1 and "
+              "MXNET_SERVE_EFFICIENCY=1?)")
+        return 1
+    blocks = [format_table(report[k]) for k in sorted(
+        report, key=lambda k: (k[0], k[1] or ""))]
+    spread = format_spread(doc)
+    if spread:
+        blocks.append(spread)
+    print("\n\n".join(blocks))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
