@@ -75,8 +75,13 @@ from .optimize import (OptPlan, OptAction, optimize_graph,
                        SELECT_OPT_PASSES)
 from .sharding import (ShardingCheck, check_sharding_plan,
                        audit_sharding_plan)
+from .concurrency import (ConcurrencyModel, LockDef,
+                          analyze_package as analyze_concurrency,
+                          analyze_sources as analyze_concurrency_sources)
 
 __all__ = [
+    "ConcurrencyModel", "LockDef", "analyze_concurrency",
+    "analyze_concurrency_sources",
     "Severity", "Diagnostic", "Report", "AnalysisError",
     "hazard_fingerprint",
     "AnalysisContext", "AnalysisPass", "analyze", "register_pass",

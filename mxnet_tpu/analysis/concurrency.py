@@ -1,0 +1,1193 @@
+"""Static concurrency soundness: lock-order / race lints over SOURCE.
+
+Every other pass in this package proves the *graph IR* sound; the
+runtime that serves those graphs is itself a ~40-lock, dozen-daemon-
+thread system (router/replica dispatch threads, supervisor, regulator,
+recorder, SSE hub, watchdogs) whose worst bugs — locks held across
+cold compiles (PR 11 moved AOT resolution out of
+``ProgramCache._lock`` for exactly this), close()-vs-registration
+races, stale refcount tokens — were all caught by HAND in per-PR
+review passes.  This module machine-checks that contract: an AST-based
+analysis over the package's Python sources (no execution), in the
+pass-registry/verdict-gate mold of the IR passes (TVM 1802.04799 /
+Relay 1810.00952 applied to the runtime's own source instead of
+Symbol JSON).
+
+What it builds:
+
+1. **Lock discovery** — every ``threading.Lock/RLock/Condition``
+   assignment (module-level or ``self.x = ...``) and every
+   ``locks.named_lock/named_rlock/named_condition`` call.  Named locks
+   get the sanitizer name as their graph node id, so OBSERVED edges
+   from the runtime sanitizer (``MXNET_LOCK_SANITIZER=1``,
+   mxnet_tpu/locks.py) merge onto the same nodes
+   (:meth:`ConcurrencyModel.merge_observed`).  A ``Condition(lock)``
+   aliases its lock: acquiring the condition IS acquiring the lock.
+
+2. **May-hold-while-acquiring edge graph** — an intraprocedural walk
+   of every function body tracking the held-lock stack through
+   ``with``/``acquire()``/``release()``, plus a call-graph closure:
+   ``self.method()`` resolves within the class (one-level attribute
+   type inference covers ``self.x = SomeClass(...)`` members),
+   module-function and cross-module calls resolve within the package.
+   A call made while holding L adds edges L -> every lock the callee
+   may (transitively) acquire.
+
+3. **Findings** (node-pinned :mod:`.diagnostics`, pass names below):
+
+   - ``lock-order``     ERROR: acquisition-order cycles (tricolor DFS,
+     the PR 2 verifier's algorithm) — each edge witnessed by a site;
+   - ``lock-blocking``  WARNING: blocking call under a held lock —
+     ``jax.*`` dispatch/compile, ``time.sleep``, file IO, blocking
+     queue ops, HTTP/subprocess, ``Future.result``/``Thread.join``/
+     ``Event.wait`` — direct or through the call graph (the witness
+     chain names the path to the blocking leaf);
+   - ``cond-wait``      WARNING: ``Condition.wait`` outside a
+     predicate loop (missed-notify / spurious-wakeup hazard), and
+     ``wait`` while holding OTHER locks (they are NOT released);
+   - ``lifecycle``      WARNING: acquire-style API (heartbeat/rule/
+     refcount/callback registration, dynamic-label metric series)
+     with no paired release reachable from a close()-like method;
+   - ``thread-daemon``  WARNING: ``threading.Thread`` started
+     non-daemon with no join path.
+
+CLI: ``tools/thread_lint.py`` (graph_lint exit contract, ``--strict``,
+``--json``, explicit allowlist with per-entry justification) — gated
+in tier-1 over the whole package by tests/test_thread_lint.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .diagnostics import Severity, Diagnostic, Report
+
+__all__ = ["analyze_package", "analyze_sources", "ConcurrencyModel",
+           "LockDef", "find_cycles", "PASSES"]
+
+PASSES = ("lock-order", "lock-blocking", "cond-wait", "lifecycle",
+          "thread-daemon")
+
+# close()-like entry points: a release reachable from any of these
+# counts as "reclaimed on the object's way out"
+_CLOSE_ENTRIES = ("close", "stop", "shutdown", "release", "disable",
+                  "reset", "clear", "unbind", "unregister", "remove",
+                  "__exit__", "__del__")
+
+# acquire-API -> acceptable release-API names (any one suffices).
+# These are the repo's refcount/registration verbs whose pairing was
+# previously enforced only by convention (and by hand, in the PR 9-12
+# review passes).
+LIFECYCLE_PAIRS = (
+    ("register_heartbeat", ("unregister_heartbeat",)),
+    ("recorder_acquire", ("recorder_release",)),
+    ("server_acquire", ("server_release",)),
+    ("register_callback", ("unregister_callback",)),
+    ("register_healthz_section", ("unregister_healthz_section",)),
+    ("add_rule", ("remove_rule", "remove_owner")),
+    ("register_engine", ("unregister_engine",)),
+    ("register_engine_default_rules",
+     ("remove_engine_default_rules", "remove_owner", "remove_rule")),
+)
+
+# metric-series reclaim verbs (Family.remove / the shared helper)
+_SERIES_RECLAIMS = ("remove", "remove_labeled_series")
+
+# -- blocking-call classification -------------------------------------------
+# dotted-prefix rules (alias-canonicalized: `import time as _t` still
+# matches "time.sleep")
+_BLOCKING_PREFIXES = (
+    ("time.sleep", "sleeps"),
+    ("jax.", "jax dispatch/compile"),
+    ("subprocess.", "subprocess"),
+    ("urllib.", "HTTP"),
+    ("requests.", "HTTP"),
+    ("socket.", "socket IO"),
+    ("shutil.", "file IO"),
+)
+_BLOCKING_EXACT = {
+    "open": "file IO",
+    "os.replace": "file IO",
+    "os.fsync": "file IO",
+    "os.makedirs": "file IO",
+    "json.dump": "file IO",
+    "json.load": "file IO",
+    "pickle.dump": "file IO",
+    "pickle.load": "file IO",
+}
+# attribute-name rules, each with a guard refining the match
+_BLOCKING_ATTRS = ("block_until_ready", "result", "join", "wait",
+                   "get", "put")
+
+
+def _attr_blocking(call, dotted):
+    """Reason string when ``call`` (an ast.Call on an Attribute) is a
+    blocking method by attribute-name heuristics, else None."""
+    func = call.func
+    attr = func.attr
+    if attr == "block_until_ready":
+        return "jax dispatch"
+    if attr == "result":
+        return "future wait"
+    if attr == "join":
+        # exclude str.join (constant receivers, os.path.join, sep vars
+        # named *sep*) — thread/process joins are what we care about
+        if isinstance(func.value, ast.Constant):
+            return None
+        if dotted.startswith("os.path.") or dotted.startswith("posixpath."):
+            return None
+        base = dotted.rsplit(".", 1)[0]
+        if "sep" in base or base.endswith("'"):
+            return None
+        return "thread join"
+    if attr == "wait":
+        return "wait"
+    if attr == "get":
+        # dict.get(key[, default]) has positional args; a blocking
+        # queue get() has none
+        if call.args:
+            return None
+        base = _dotted_name(func.value)
+        if "queue" in base.lower() or base.lower().endswith("_q"):
+            return "queue get"
+        if not call.args and not call.keywords:
+            return None       # zero-arg .get() on unknown type: skip
+        return "queue get"    # .get(timeout=..) / .get(block=..)
+    if attr == "put":
+        base = _dotted_name(func.value)
+        if "queue" in base.lower() or base.lower().endswith("_q"):
+            return "queue put"
+        return None
+    return None
+
+
+def _dotted_name(node):
+    """Best-effort dotted rendering of an expression ('self._lock',
+    'threading.Lock', 'telemetry.counter()')."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted_name(node.value)
+        return (base + "." + node.attr) if base else node.attr
+    if isinstance(node, ast.Call):
+        base = _dotted_name(node.func)
+        return (base + "()") if base else ""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return "'%s'" % node.value
+    return ""
+
+
+class LockDef(object):
+    """One discovered lock (or condition): the graph node."""
+    __slots__ = ("id", "kind", "module", "owner", "attr", "file",
+                 "line", "named")
+
+    def __init__(self, id, kind, module, owner, attr, file, line,
+                 named=False):
+        self.id = id            # sanitizer name, or module.Owner.attr
+        self.kind = kind        # "lock" | "rlock" | "condition"
+        self.module = module
+        self.owner = owner      # class name or "" (module level)
+        self.attr = attr
+        self.file = file
+        self.line = line
+        self.named = named      # True = sanitizer-named (merge key)
+
+    def to_dict(self):
+        return {"id": self.id, "kind": self.kind, "named": self.named,
+                "site": "%s:%d" % (self.file, self.line)}
+
+
+class _ClassInfo(object):
+    __slots__ = ("key", "name", "module", "methods", "locks",
+                 "attr_types", "bases", "line")
+
+    def __init__(self, key, name, module, line):
+        self.key = key                  # "module:Class"
+        self.name = name
+        self.module = module
+        self.methods = {}               # name -> func id
+        self.locks = {}                 # attr -> (lock_id, kind)
+        self.attr_types = {}            # attr -> class key
+        self.bases = []                 # resolvable base class keys
+        self.line = line
+
+
+class _FuncInfo(object):
+    __slots__ = ("id", "module", "cls", "name", "node", "file", "line",
+                 "acq_edges", "direct_acquires", "calls", "blocking",
+                 "cond_waits", "api_calls", "labels_dynamic",
+                 "series_reclaims", "thread_ctors")
+
+    def __init__(self, id, module, cls, name, node, file, line):
+        self.id = id
+        self.module = module
+        self.cls = cls                  # class key or None
+        self.name = name
+        self.node = node
+        self.file = file
+        self.line = line
+        # populated by the body walk:
+        self.acq_edges = []             # (src, dst, line)
+        self.direct_acquires = set()    # lock ids
+        self.calls = []                 # (callee id, held tuple, line)
+        self.blocking = []              # (reason, dotted, held, line)
+        self.cond_waits = []            # (lock id, in_loop, others, line)
+        self.api_calls = {}             # api name -> first line
+        self.labels_dynamic = []        # lines of dynamic .labels()
+        self.series_reclaims = []       # lines of .remove()-style calls
+        self.thread_ctors = []          # (line, daemon)
+
+
+class _ModuleInfo(object):
+    __slots__ = ("name", "path", "tree", "imports", "locks", "classes",
+                 "functions")
+
+    def __init__(self, name, path, tree):
+        self.name = name                # package-relative ("serving.engine")
+        self.path = path
+        self.tree = tree
+        self.imports = {}               # alias -> ("mod", name) |
+        #                                          ("sym", mod, attr)
+        self.locks = {}                 # NAME -> (lock_id, kind)
+        self.classes = {}               # class name -> _ClassInfo
+        self.functions = {}             # func name -> func id
+
+
+# ===========================================================================
+
+def analyze_package(root=None, exclude=()):
+    """Analyze every ``*.py`` under ``root`` (default: the installed
+    mxnet_tpu package directory).  Returns a :class:`ConcurrencyModel`."""
+    if root is None:
+        import mxnet_tpu
+        root = os.path.dirname(os.path.abspath(mxnet_tpu.__file__))
+    paths = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__",))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                if any(rel.startswith(e) for e in exclude):
+                    continue
+                paths.append(os.path.join(dirpath, fn))
+    return analyze_sources(paths, root=root)
+
+
+def analyze_sources(paths, root=None):
+    """Analyze an explicit list of source files.  ``root`` anchors the
+    module names ("serving.engine"); files outside it use their stem."""
+    model = ConcurrencyModel(root=root)
+    for p in paths:
+        model.load(p)
+    model.run()
+    return model
+
+
+def find_cycles(adj):
+    """Tricolor DFS over ``{node: iterable-of-successors}``; cycles as
+    node lists ``[a, b, ..., a]`` canonically rotated and deduped."""
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {}
+    for n, succs in adj.items():
+        color[n] = WHITE
+        for m in succs:
+            color.setdefault(m, WHITE)
+    stack, cycles, seen = [], [], set()
+
+    def visit(n):
+        color[n] = GREY
+        stack.append(n)
+        for m in sorted(adj.get(n, ())):
+            c = color.get(m, BLACK)
+            if c == GREY:
+                body = stack[stack.index(m):]
+                k = body.index(min(body))
+                canon = tuple(body[k:] + body[:k])
+                if canon not in seen:
+                    seen.add(canon)
+                    cycles.append(list(canon) + [canon[0]])
+            elif c == WHITE:
+                visit(m)
+        stack.pop()
+        color[n] = BLACK
+
+    for n in sorted(color):
+        if color[n] == WHITE:
+            visit(n)
+    return cycles
+
+
+# ===========================================================================
+
+class ConcurrencyModel(object):
+    """The package-wide lock model + findings."""
+
+    def __init__(self, root=None):
+        self.root = root
+        self.modules = {}               # rel module name -> _ModuleInfo
+        self.locks = {}                 # lock id -> LockDef
+        self.funcs = {}                 # func id -> _FuncInfo
+        self.classes = {}               # class key -> _ClassInfo
+        self.edges = {}                 # (src, dst) -> [site, ...]
+        self.cycles = []
+        self.load_errors = []           # (path, message)
+        self.report = Report()
+        self._may_acquire = {}
+        self._may_block = {}
+
+    # ------------------------------------------------------------- loading
+    def _module_name(self, path):
+        path = os.path.abspath(path)
+        if self.root:
+            rel = os.path.relpath(path, os.path.abspath(self.root))
+            if not rel.startswith(".."):
+                name = rel[:-3].replace(os.sep, ".")
+                if name.endswith(".__init__"):
+                    name = name[:-len(".__init__")]
+                elif name == "__init__":
+                    name = ""
+                return name
+        return os.path.basename(path)[:-3]
+
+    def load(self, path):
+        try:
+            with open(path, "r") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError, ValueError) as e:
+            self.load_errors.append((path, str(e)))
+            return
+        name = self._module_name(path)
+        mod = _ModuleInfo(name, path, tree)
+        self.modules[name] = mod
+        self._collect(mod)
+
+    # -- phase A: defs (imports, locks, classes, functions) ----------------
+    def _collect(self, mod):
+        relfile = (os.path.relpath(mod.path, self.root)
+                   if self.root else mod.path)
+        pkg_parts = mod.name.split(".")[:-1] if mod.name else []
+
+        def resolve_from(level, modname):
+            # package-relative "from"-target as a rel module name
+            if level == 0:
+                return None                    # absolute: external
+            base = (mod.name.split(".")[:-1] if mod.name else [])
+            base = base[:len(base) - (level - 1)] if level > 1 else base
+            parts = base + (modname.split(".") if modname else [])
+            return ".".join(p for p in parts if p)
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.imports[a.asname or a.name.split(".")[0]] = \
+                        ("ext", a.name)
+            elif isinstance(node, ast.ImportFrom):
+                target = resolve_from(node.level, node.module)
+                for a in node.names:
+                    local = a.asname or a.name
+                    if target is None:
+                        # absolute import: track externals for
+                        # canonicalization (time, jax, threading, ...)
+                        mod.imports[local] = \
+                            ("extsym", node.module or "", a.name)
+                    elif a.name == "*":
+                        continue
+                    else:
+                        full = (target + "." + a.name) \
+                            if target else a.name
+                        mod.imports[local] = ("sym", target, a.name)
+                        # "from . import faults" arrives as ImportFrom
+                        # with module=None: the bound name IS a module
+                        mod.imports.setdefault(
+                            local, ("sym", target, a.name))
+                        if node.module is None or not node.module:
+                            mod.imports[local] = ("mod", full)
+
+        # module body, in order (lock defs may reference earlier ones)
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign):
+                self._maybe_lockdef(mod, None, stmt, relfile)
+            elif isinstance(stmt, ast.FunctionDef):
+                self._add_func(mod, None, stmt, relfile)
+            elif isinstance(stmt, ast.ClassDef):
+                ckey = "%s:%s" % (mod.name, stmt.name)
+                ci = _ClassInfo(ckey, stmt.name, mod.name, stmt.lineno)
+                for b in stmt.bases:
+                    bd = _dotted_name(b)
+                    if bd:
+                        ci.bases.append(bd)
+                self.classes[ckey] = ci
+                mod.classes[stmt.name] = ci
+                for item in stmt.body:
+                    if isinstance(item, ast.FunctionDef):
+                        self._add_func(mod, ci, item, relfile)
+                        for sub in ast.walk(item):
+                            if isinstance(sub, ast.Assign):
+                                self._maybe_lockdef(mod, ci, sub,
+                                                    relfile)
+                                self._maybe_attr_type(mod, ci, sub)
+
+    def _add_func(self, mod, ci, node, relfile):
+        if ci is None:
+            fid = "%s:%s" % (mod.name, node.name)
+            mod.functions[node.name] = fid
+        else:
+            fid = "%s:%s.%s" % (mod.name, ci.name, node.name)
+            ci.methods[node.name] = fid
+        self.funcs[fid] = _FuncInfo(fid, mod.name,
+                                    ci.key if ci else None,
+                                    node.name, node, relfile,
+                                    node.lineno)
+
+    def _canonical_call(self, mod, call):
+        """Canonical dotted name of a call target, alias-resolved
+        through the module's imports ('threading.Lock',
+        'named_lock', 'time.sleep', ...)."""
+        dotted = _dotted_name(call.func)
+        if not dotted:
+            return ""
+        head, _, rest = dotted.partition(".")
+        imp = mod.imports.get(head)
+        if imp is not None:
+            if imp[0] == "ext":
+                head = imp[1]
+            elif imp[0] == "extsym":
+                head = (imp[1] + "." + imp[2]) if imp[1] else imp[2]
+            elif imp[0] == "sym":
+                head = imp[2]
+            elif imp[0] == "mod":
+                head = imp[1].split(".")[-1]
+        return head + ("." + rest if rest else "")
+
+    _LOCK_CTORS = {"threading.Lock": "lock", "threading.RLock": "rlock",
+                   "threading.Condition": "condition",
+                   "named_lock": "lock", "named_rlock": "rlock",
+                   "named_condition": "condition"}
+
+    def _maybe_lockdef(self, mod, ci, assign, relfile):
+        if not isinstance(assign.value, ast.Call) \
+                or len(assign.targets) != 1:
+            return
+        call = assign.value
+        canon = self._canonical_call(mod, call)
+        kind = self._LOCK_CTORS.get(canon)
+        if kind is None:
+            # absolute imports of the sanitizer API
+            # (mxnet_tpu.serving.locks.named_lock) still count
+            tail = canon.rsplit(".", 1)[-1]
+            if tail.startswith("named_"):
+                kind = self._LOCK_CTORS.get(tail)
+        if kind is None:
+            return
+        target = assign.targets[0]
+        named = canon.rsplit(".", 1)[-1].startswith("named_")
+        # identity: sanitizer name when literal, else structural
+        lock_id = None
+        if named and call.args \
+                and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            lock_id = call.args[0].value
+        # a Condition over an existing lock ALIASES that lock
+        alias_expr = None
+        if kind == "condition":
+            if canon == "threading.Condition" and call.args:
+                alias_expr = call.args[0]
+            elif named:
+                if len(call.args) > 1:
+                    alias_expr = call.args[1]
+                for kw in call.keywords:
+                    if kw.arg == "lock":
+                        alias_expr = kw.value
+        if alias_expr is not None:
+            aliased = self._resolve_lock_expr(mod, ci, alias_expr)
+            if aliased is not None:
+                lock_id = aliased[0]
+
+        if isinstance(target, ast.Name) and ci is None:
+            attr, owner = target.id, ""
+        elif isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self" and ci is not None:
+            attr, owner = target.attr, ci.name
+        else:
+            return
+        if lock_id is None:
+            lock_id = ".".join(x for x in (mod.name, owner, attr) if x)
+        if lock_id not in self.locks:
+            self.locks[lock_id] = LockDef(
+                lock_id, kind, mod.name, owner, attr, relfile,
+                assign.lineno, named=named)
+        if ci is None:
+            mod.locks[attr] = (lock_id, kind)
+        else:
+            ci.locks[attr] = (lock_id, kind)
+
+    def _maybe_attr_type(self, mod, ci, assign):
+        """One-level member type inference: self.x = SomeClass(...)
+        (looking through ``X(...) if cond else None`` gating)."""
+        if len(assign.targets) != 1:
+            return
+        target = assign.targets[0]
+        if not (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            return
+        values = [assign.value]
+        while values and isinstance(values[0], ast.IfExp):
+            v = values.pop(0)
+            values.extend([v.body, v.orelse])
+        for v in values:
+            if isinstance(v, ast.Call):
+                ckey = self._resolve_class(mod, v.func)
+                if ckey is not None:
+                    ci.attr_types.setdefault(target.attr, ckey)
+                    return
+
+    def _resolve_class(self, mod, func_expr):
+        """Resolve a call target to a package class key, if it is one."""
+        dotted = _dotted_name(func_expr)
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        head = parts[0]
+        if len(parts) == 1:
+            if head in mod.classes:
+                return mod.classes[head].key
+            imp = mod.imports.get(head)
+            if imp is not None and imp[0] == "sym":
+                key = "%s:%s" % (imp[1], imp[2])
+                return key if key in self.classes else None
+            return None
+        imp = mod.imports.get(head)
+        if imp is not None and imp[0] == "mod" and len(parts) == 2:
+            key = "%s:%s" % (imp[1], parts[1])
+            return key if key in self.classes else None
+        return None
+
+    # ------------------------------------------------------- lock resolve
+    def _class_lock(self, ckey, attr, _seen=None):
+        ci = self.classes.get(ckey)
+        if ci is None:
+            return None
+        if attr in ci.locks:
+            return ci.locks[attr]
+        _seen = _seen or {ckey}
+        mod = self.modules.get(ci.module)
+        for b in ci.bases:
+            bkey = self._resolve_class(mod, ast.parse(
+                b, mode="eval").body) if mod else None
+            if bkey and bkey not in _seen:
+                _seen.add(bkey)
+                r = self._class_lock(bkey, attr, _seen)
+                if r is not None:
+                    return r
+        return None
+
+    def _resolve_lock_expr(self, mod, ci, expr):
+        """(lock_id, kind) for an expression naming a known lock."""
+        if isinstance(expr, ast.Name):
+            return mod.locks.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name):
+                if expr.value.id == "self" and ci is not None:
+                    return self._class_lock(ci.key, expr.attr)
+                imp = mod.imports.get(expr.value.id)
+                if imp is not None and imp[0] == "mod":
+                    m2 = self.modules.get(imp[1])
+                    if m2 is not None:
+                        return m2.locks.get(expr.attr)
+        return None
+
+    def _resolve_callee(self, mod, ci, call):
+        """Func id (or class __init__ id) a call statically targets
+        within the package, else None."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in mod.functions:
+                return mod.functions[name]
+            imp = mod.imports.get(name)
+            if imp is not None and imp[0] == "sym":
+                m2 = self.modules.get(imp[1])
+                if m2 is not None and imp[2] in m2.functions:
+                    return m2.functions[imp[2]]
+            ckey = self._resolve_class(mod, func)
+            if ckey is not None:
+                return self.classes[ckey].methods.get("__init__")
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and ci is not None:
+                m = self._class_method(ci.key, attr)
+                if m is not None:
+                    return m
+                return None
+            imp = mod.imports.get(base.id)
+            if imp is not None and imp[0] == "mod":
+                m2 = self.modules.get(imp[1])
+                if m2 is not None:
+                    return m2.functions.get(attr)
+            ckey = self._resolve_class(mod, base)
+            if ckey is not None:       # ClassName.method(obj, ...)
+                return self._class_method(ckey, attr)
+            return None
+        # self.<member>.method() via one-level attr type inference
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self" and ci is not None:
+            tkey = self.classes[ci.key].attr_types.get(base.attr) \
+                if ci.key in self.classes else None
+            if tkey is not None:
+                return self._class_method(tkey, attr)
+        return None
+
+    def _class_method(self, ckey, name, _seen=None):
+        ci = self.classes.get(ckey)
+        if ci is None:
+            return None
+        if name in ci.methods:
+            return ci.methods[name]
+        _seen = _seen or {ckey}
+        mod = self.modules.get(ci.module)
+        for b in ci.bases:
+            try:
+                bexpr = ast.parse(b, mode="eval").body
+            except SyntaxError:
+                continue
+            bkey = self._resolve_class(mod, bexpr) if mod else None
+            if bkey and bkey not in _seen:
+                _seen.add(bkey)
+                r = self._class_method(bkey, name, _seen)
+                if r is not None:
+                    return r
+        return None
+
+    # ------------------------------------------------------------ phase B
+    def run(self):
+        for fid in sorted(self.funcs):
+            self._walk_function(self.funcs[fid])
+        self._close_summaries()
+        self._build_edges()
+        self._find_order_findings()
+        self._find_blocking_findings()
+        self._find_cond_findings()
+        self._find_lifecycle_findings()
+        self._find_thread_findings()
+        return self
+
+    def _walk_function(self, fi):
+        mod = self.modules[fi.module]
+        ci = self.classes.get(fi.cls) if fi.cls else None
+        walker = _BodyWalker(self, mod, ci, fi)
+        walker.walk_block(fi.node.body)
+
+    # ------------------------------------------------------------ phase C
+    def _close_summaries(self):
+        # fixpoint: may_acquire* and may_block* over the call graph
+        acquire = {f: set(fi.direct_acquires)
+                   for f, fi in self.funcs.items()}
+        block = {f: bool(fi.blocking) for f, fi in self.funcs.items()}
+        block_via = {f: None for f in self.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for f, fi in self.funcs.items():
+                for callee, _held, _line in fi.calls:
+                    if callee not in acquire:
+                        continue
+                    new = acquire[callee] - acquire[f]
+                    if new:
+                        acquire[f] |= new
+                        changed = True
+                    if block[callee] and not block[f]:
+                        block[f] = True
+                        block_via[f] = callee
+                        changed = True
+        self._may_acquire = acquire
+        self._may_block = block
+        self._block_via = block_via
+
+    def _block_chain(self, fid, limit=6):
+        """Witness chain from fid to a directly-blocking function."""
+        chain = [fid]
+        cur = fid
+        while len(chain) < limit:
+            fi = self.funcs.get(cur)
+            if fi is not None and fi.blocking:
+                reason, dotted, _held, line = fi.blocking[0]
+                chain.append("%s [%s:%d]" % (dotted, fi.file, line))
+                return chain, reason
+            nxt = self._block_via.get(cur)
+            if nxt is None or nxt in chain:
+                break
+            chain.append(nxt)
+            cur = nxt
+        return chain, "blocks"
+
+    def _build_edges(self):
+        for f, fi in self.funcs.items():
+            for src, dst, line in fi.acq_edges:
+                if src != dst:
+                    self.edges.setdefault((src, dst), []).append(
+                        "%s (%s:%d)" % (f, fi.file, line))
+            for callee, held, line in fi.calls:
+                for dst in self._may_acquire.get(callee, ()):
+                    for src in held:
+                        if src != dst:
+                            site = "%s (%s:%d) via %s" % (
+                                f, fi.file, line, callee)
+                            sites = self.edges.setdefault((src, dst),
+                                                          [])
+                            if len(sites) < 8:
+                                sites.append(site)
+        adj = {}
+        for (src, dst) in self.edges:
+            adj.setdefault(src, set()).add(dst)
+        self.cycles = find_cycles(adj)
+
+    def _find_order_findings(self):
+        for cyc in self.cycles:
+            pairs = [(cyc[i], cyc[i + 1]) for i in range(len(cyc) - 1)]
+            wit = "; ".join("%s->%s at %s" % (a, b,
+                            self.edges.get((a, b), ["?"])[0])
+                            for a, b in pairs)
+            self.report.add(Diagnostic(
+                Severity.ERROR, "lock-order",
+                "lock-order cycle: %s (%s) — two threads taking these "
+                "locks in opposite orders can deadlock"
+                % (" -> ".join(cyc), wit),
+                node=" -> ".join(cyc)))
+
+    def _find_blocking_findings(self):
+        seen = set()
+        for f, fi in sorted(self.funcs.items()):
+            for reason, dotted, held, line in fi.blocking:
+                if not held:
+                    continue
+                key = (f, dotted, held[-1])
+                if key in seen:
+                    continue
+                seen.add(key)
+                self.report.add(Diagnostic(
+                    Severity.WARNING, "lock-blocking",
+                    "blocking call under %s: %s (%s) at %s:%d — held "
+                    "locks stall every thread contending for them"
+                    % (held[-1], dotted, reason, fi.file, line),
+                    node=f, op=dotted, provenance=held))
+            for callee, held, line in fi.calls:
+                if not held or not self._may_block.get(callee):
+                    continue
+                key = (f, callee, held[-1])
+                if key in seen:
+                    continue
+                seen.add(key)
+                chain, reason = self._block_chain(callee)
+                self.report.add(Diagnostic(
+                    Severity.WARNING, "lock-blocking",
+                    "blocking call under %s: %s may block (%s) at "
+                    "%s:%d" % (held[-1], callee, reason, fi.file,
+                               line),
+                    node=f, op=callee,
+                    provenance=tuple(held) + tuple(chain)))
+
+    def _find_cond_findings(self):
+        for f, fi in sorted(self.funcs.items()):
+            for lock_id, in_loop, others, line in fi.cond_waits:
+                if not in_loop:
+                    self.report.add(Diagnostic(
+                        Severity.WARNING, "cond-wait",
+                        "Condition.wait outside a predicate loop at "
+                        "%s:%d — a missed notify or spurious wakeup "
+                        "resumes with the predicate still false"
+                        % (fi.file, line),
+                        node=f, op=lock_id))
+                if others:
+                    self.report.add(Diagnostic(
+                        Severity.WARNING, "lock-blocking",
+                        "blocking call under %s: Condition.wait(%s) "
+                        "releases only its own lock at %s:%d"
+                        % (others[-1], lock_id, fi.file, line),
+                        node=f, op="%s.wait" % lock_id,
+                        provenance=others))
+
+    # -- lifecycle pairing -------------------------------------------------
+    def _close_reachable(self, ckey, limit=400):
+        """Func ids reachable from the class's close()-like methods —
+        following resolved calls ACROSS classes (teardown commonly
+        delegates: ``engine.close() -> self._tm.close()``).  Release-
+        side lifecycle verbs defined on the class (``remove_rule``,
+        ``unregister_*``) count as close entries too: reclaim wired to
+        the class's own teardown API is paired."""
+        ci = self.classes.get(ckey)
+        if ci is None:
+            return set()
+        entries = set(_CLOSE_ENTRIES)
+        for _acq, rels in LIFECYCLE_PAIRS:
+            entries.update(rels)
+        frontier = [fid for name, fid in ci.methods.items()
+                    if name in entries]
+        seen = set(frontier)
+        while frontier and len(seen) < limit:
+            fid = frontier.pop()
+            fi = self.funcs.get(fid)
+            if fi is None:
+                continue
+            for callee, _h, _l in fi.calls:
+                if callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+        return seen
+
+    def _find_lifecycle_findings(self):
+        for ckey in sorted(self.classes):
+            ci = self.classes[ckey]
+            mids = set(ci.methods.values())
+            reach = self._close_reachable(ckey)
+            calls_by_api = {}
+            dyn_labels = []
+            reclaims_reachable = False
+            for fid in mids:
+                fi = self.funcs.get(fid)
+                if fi is None:
+                    continue
+                for api, line in fi.api_calls.items():
+                    calls_by_api.setdefault(api, (fid, line))
+                if fi.labels_dynamic and fid not in reach:
+                    dyn_labels.append((fid, fi.labels_dynamic[0]))
+            reclaims_reachable = any(
+                self.funcs[m].series_reclaims
+                for m in reach if m in self.funcs)
+            for acq, rels in LIFECYCLE_PAIRS:
+                if acq not in calls_by_api:
+                    continue
+                fid, line = calls_by_api[acq]
+                ok = any(
+                    rel in self.funcs[m].api_calls
+                    for m in reach if m in self.funcs
+                    for rel in rels)
+                if not ok:
+                    fi = self.funcs[fid]
+                    self.report.add(Diagnostic(
+                        Severity.WARNING, "lifecycle",
+                        "unpaired acquire: %s called at %s:%d but no "
+                        "%s reachable from a close()-like method of "
+                        "%s — reload loops leak it"
+                        % (acq, fi.file, line, "/".join(rels),
+                           ci.name),
+                        node=ckey, op=acq))
+            if dyn_labels and not reclaims_reachable:
+                fid, line = dyn_labels[0]
+                fi = self.funcs[fid]
+                self.report.add(Diagnostic(
+                    Severity.WARNING, "lifecycle",
+                    "dynamic-label metric series at %s:%d with no "
+                    ".remove()/remove_labeled_series reachable from a "
+                    "close()-like method of %s — scrape output grows "
+                    "per construction" % (fi.file, line, ci.name),
+                    node=ckey, op="labels"))
+        # module-level functions: require the module (as a whole) to
+        # call a release for every acquire verb it uses
+        for mname in sorted(self.modules):
+            mod = self.modules[mname]
+            fids = [self.funcs[f] for f in mod.functions.values()
+                    if f in self.funcs]
+            apis = {}
+            for fi in fids:
+                for api, line in fi.api_calls.items():
+                    apis.setdefault(api, (fi, line))
+            # a release DEFINED in this module (e.g. the manager class
+            # whose remove_rule callers invoke at close) satisfies the
+            # module-level pairing — the per-caller obligation is
+            # checked at class granularity above
+            defined = set(mod.functions)
+            for ci in mod.classes.values():
+                defined.update(ci.methods)
+            for acq, rels in LIFECYCLE_PAIRS:
+                if acq in apis and not any(
+                        r in apis or r in defined for r in rels):
+                    fi, line = apis[acq]
+                    self.report.add(Diagnostic(
+                        Severity.WARNING, "lifecycle",
+                        "unpaired acquire: module %s calls %s at "
+                        "%s:%d but never any of %s"
+                        % (mname, acq, fi.file, line, "/".join(rels)),
+                        node=mname or fi.file, op=acq))
+
+    def _find_thread_findings(self):
+        for f, fi in sorted(self.funcs.items()):
+            for line, daemon in fi.thread_ctors:
+                if daemon:
+                    continue
+                # a join path anywhere in the owning class (or module,
+                # for free functions) keeps a non-daemon thread sound
+                scope = []
+                if fi.cls and fi.cls in self.classes:
+                    scope = [self.funcs[m] for m in
+                             self.classes[fi.cls].methods.values()
+                             if m in self.funcs]
+                else:
+                    scope = [self.funcs[x] for x in
+                             self.modules[fi.module].functions.values()
+                             if x in self.funcs]
+                joins = any(
+                    any(r == "thread join" for r, _d, _h, _l in g.blocking)
+                    or "join" in g.api_calls for g in scope)
+                if not joins:
+                    self.report.add(Diagnostic(
+                        Severity.WARNING, "thread-daemon",
+                        "thread started non-daemon with no join path "
+                        "at %s:%d — process exit hangs on it"
+                        % (fi.file, line),
+                        node=f))
+
+    # -- observed-edge merge ----------------------------------------------
+    def merge_observed(self, observed):
+        """Merge sanitizer-observed edges (``locks.observed_edges()``
+        dict or the dump file's ``edges`` list) into the static graph
+        and re-run cycle detection.  New cycles involving observed
+        edges are appended to the report as lock-order ERRORs tagged
+        ``observed``.  Returns the list of NEW cycles."""
+        if isinstance(observed, dict):
+            rows = [{"src": s, "dst": d,
+                     "site": v.get("site", "observed")}
+                    for (s, d), v in observed.items()]
+        else:
+            rows = list(observed)
+        before = {tuple(c) for c in self.cycles}
+        for row in rows:
+            key = (row["src"], row["dst"])
+            if key[0] == key[1]:
+                continue
+            self.edges.setdefault(key, []).append(
+                "observed at %s" % row.get("site", "?"))
+        adj = {}
+        for (src, dst) in self.edges:
+            adj.setdefault(src, set()).add(dst)
+        self.cycles = find_cycles(adj)
+        new = [c for c in self.cycles if tuple(c) not in before]
+        for cyc in new:
+            pairs = [(cyc[i], cyc[i + 1]) for i in range(len(cyc) - 1)]
+            wit = "; ".join("%s->%s at %s" % (a, b,
+                            self.edges.get((a, b), ["?"])[0])
+                            for a, b in pairs)
+            self.report.add(Diagnostic(
+                Severity.ERROR, "lock-order",
+                "lock-order cycle (with observed edges): %s (%s)"
+                % (" -> ".join(cyc), wit),
+                node=" -> ".join(cyc)))
+        return new
+
+    # -- export ------------------------------------------------------------
+    def to_dict(self):
+        return {
+            "locks": [self.locks[k].to_dict()
+                      for k in sorted(self.locks)],
+            "edges": [{"src": s, "dst": d, "sites": sites}
+                      for (s, d), sites in sorted(self.edges.items())],
+            "cycles": self.cycles,
+            "functions": len(self.funcs),
+            "modules": sorted(self.modules),
+            "load_errors": [{"path": p, "error": e}
+                            for p, e in self.load_errors],
+            "findings": self.report.to_list(),
+        }
+
+
+# ===========================================================================
+
+class _BodyWalker(object):
+    """Held-stack statement walker for one function body."""
+
+    def __init__(self, model, mod, ci, fi):
+        self.model = model
+        self.mod = mod
+        self.ci = ci
+        self.fi = fi
+        self.held = []              # lock ids, acquisition order
+        self.loop_depth = 0
+
+    # -- helpers -----------------------------------------------------------
+    def _resolve(self, expr):
+        return self.model._resolve_lock_expr(self.mod, self.ci, expr)
+
+    def _push(self, lock_id):
+        self.held.append(lock_id)
+        self.fi.direct_acquires.add(lock_id)
+        for src in self.held[:-1]:
+            self.fi.acq_edges.append((src, lock_id, self._line))
+
+    def _pop(self, lock_id):
+        for i in range(len(self.held) - 1, -1, -1):
+            if self.held[i] == lock_id:
+                del self.held[i]
+                return
+
+    # -- statements --------------------------------------------------------
+    def walk_block(self, stmts):
+        for s in stmts:
+            self.walk_stmt(s)
+
+    def walk_stmt(self, s):
+        self._line = getattr(s, "lineno", 0)
+        if isinstance(s, ast.With):
+            pushed = []
+            for item in s.items:
+                self.walk_expr(item.context_expr)
+                r = self._resolve(item.context_expr)
+                if r is not None:
+                    self._push(r[0])
+                    pushed.append(r[0])
+            self.walk_block(s.body)
+            for lid in reversed(pushed):
+                self._pop(lid)
+        elif isinstance(s, (ast.While, ast.For)):
+            if isinstance(s, ast.While):
+                self.walk_expr(s.test)
+            else:
+                self.walk_expr(s.iter)
+            self.loop_depth += 1
+            self.walk_block(s.body)
+            self.walk_block(s.orelse)
+            self.loop_depth -= 1
+        elif isinstance(s, ast.If):
+            self.walk_expr(s.test)
+            held0 = list(self.held)
+            self.walk_block(s.body)
+            held_then = self.held
+            self.held = list(held0)
+            self.walk_block(s.orelse)
+            # union of branches: conservative for later statements
+            for lid in held_then:
+                if lid not in self.held:
+                    self.held.append(lid)
+        elif isinstance(s, ast.Try):
+            self.walk_block(s.body)
+            for h in s.handlers:
+                self.walk_block(h.body)
+            self.walk_block(s.orelse)
+            self.walk_block(s.finalbody)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested function: analyzed as its own (un-held) function
+            sub_id = "%s.<%s>" % (self.fi.id, s.name)
+            sub = _FuncInfo(sub_id, self.fi.module, self.fi.cls,
+                            s.name, s, self.fi.file, s.lineno)
+            self.model.funcs[sub_id] = sub
+            w = _BodyWalker(self.model, self.mod, self.ci, sub)
+            w.walk_block(s.body)
+        elif isinstance(s, ast.ClassDef):
+            pass
+        elif isinstance(s, ast.Expr):
+            self.walk_expr(s.value, stmt=True)
+        elif isinstance(s, ast.Assign):
+            self.walk_expr(s.value)
+            for t in s.targets:
+                self.walk_expr(t)
+        elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+            if s.value is not None:
+                self.walk_expr(s.value)
+        elif isinstance(s, ast.Return):
+            if s.value is not None:
+                self.walk_expr(s.value)
+        elif isinstance(s, (ast.Raise,)):
+            if s.exc is not None:
+                self.walk_expr(s.exc)
+        elif isinstance(s, ast.Assert):
+            self.walk_expr(s.test)
+        elif isinstance(s, ast.Delete):
+            pass
+        else:
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self.walk_expr(child)
+                elif isinstance(child, ast.stmt):
+                    self.walk_stmt(child)
+
+    # -- expressions -------------------------------------------------------
+    def walk_expr(self, e, stmt=False):
+        if e is None:
+            return
+        for node in ast.walk(e):
+            if isinstance(node, ast.Call):
+                self._visit_call(node)
+            elif isinstance(node, (ast.Lambda,)):
+                pass
+
+    def _visit_call(self, call):
+        fi = self.fi
+        line = getattr(call, "lineno", self._line)
+        func = call.func
+        dotted = _dotted_name(func)
+        canon = self.model._canonical_call(self.mod, call)
+
+        # lock protocol on known locks: acquire/release/wait/notify
+        if isinstance(func, ast.Attribute):
+            r = self._resolve(func.value)
+            if r is not None:
+                lock_id, kind = r
+                if func.attr == "acquire":
+                    self._push(lock_id)
+                    return
+                if func.attr == "release":
+                    self._pop(lock_id)
+                    return
+                if func.attr == "wait":
+                    others = tuple(h for h in self.held
+                                   if h != lock_id)
+                    fi.cond_waits.append(
+                        (lock_id, self.loop_depth > 0, others, line))
+                    return
+                if func.attr in ("notify", "notify_all", "locked"):
+                    return
+
+        # thread construction
+        if canon == "threading.Thread":
+            daemon = any(kw.arg == "daemon"
+                         and isinstance(kw.value, ast.Constant)
+                         and kw.value.value for kw in call.keywords)
+            fi.thread_ctors.append((line, daemon))
+
+        # lifecycle API usage (by bare/terminal name)
+        api = dotted.rsplit(".", 1)[-1] if dotted else ""
+        for acq, rels in LIFECYCLE_PAIRS:
+            if api == acq or any(api == r for r in rels):
+                fi.api_calls.setdefault(api, line)
+        if api == "join":
+            fi.api_calls.setdefault("join", line)
+        if api == "labels":
+            dynamic = any(not isinstance(a, ast.Constant)
+                          for a in call.args) \
+                or any(not isinstance(kw.value, ast.Constant)
+                       for kw in call.keywords)
+            if dynamic:
+                fi.labels_dynamic.append(line)
+        if api in _SERIES_RECLAIMS:
+            fi.series_reclaims.append(line)
+
+        # blocking classification (canonical dotted first, attrs next)
+        reason = None
+        for prefix, why in _BLOCKING_PREFIXES:
+            if canon.startswith(prefix):
+                reason = why
+                break
+        if reason is None:
+            reason = _BLOCKING_EXACT.get(canon)
+        if reason is None and isinstance(func, ast.Attribute):
+            # skip attr heuristics on known locks (handled above)
+            reason = _attr_blocking(call, dotted)
+        if reason == "thread join":
+            fi.api_calls.setdefault("join", line)
+        if reason is not None:
+            fi.blocking.append((reason, dotted or canon,
+                                tuple(self.held), line))
+            return
+
+        # package-internal call-graph edge
+        callee = self.model._resolve_callee(self.mod, self.ci, call)
+        if callee is not None and callee != fi.id:
+            fi.calls.append((callee, tuple(self.held), line))

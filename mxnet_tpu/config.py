@@ -414,6 +414,27 @@ VARIABLES = {v.name: v for v in [
          "the engine existed do not pin it off); '0' is the explicit "
          "opt-out.  An operator-set jax_compilation_cache_dir is "
          "never overridden."),
+    _Var("MXNET_LOCK_SANITIZER", bool, False,
+         "Runtime lock sanitizer (mxnet_tpu/locks.py, surfaced as "
+         "serving.locks).  When on, every named_lock/named_rlock/"
+         "named_condition the runtime constructs is a recording "
+         "wrapper: each acquisition records the held-while-acquiring "
+         "order edge from every lock the thread already holds "
+         "(mxnet_lock_order_edges_total{src,dst}) and each release "
+         "records the hold time (mxnet_lock_hold_seconds{lock}); "
+         "observed edges merge into the static lock-order graph "
+         "(tools/thread_lint.py --merge-observed) and "
+         "locks.assert_no_inversions() fails a test run on any "
+         "observed inversion.  Off (the default): the factories "
+         "return the plain threading primitives — zero wrappers, "
+         "zero instrument calls, serving byte-identical to the "
+         "sanitizer never existing (tests pin it bitwise)."),
+    _Var("MXNET_LOCK_SANITIZER_DUMP", str, "",
+         "With MXNET_LOCK_SANITIZER=1: write the observed lock-order "
+         "edges, hold-time stats, and any inversions to this path as "
+         "JSON at interpreter exit (atomic replace) — the artifact "
+         "the sanitizer subprocess smoke test and thread_lint "
+         "--merge-observed consume.  Empty = no dump."),
     _Var("MXNET_FAULT_PLAN", str, "",
          "Deterministic fault-injection plan (serving/faults.py).  "
          "Either a JSON list of clause dicts or the compact grammar "

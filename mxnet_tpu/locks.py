@@ -1,0 +1,524 @@
+"""Named locks + the runtime lock sanitizer (``MXNET_LOCK_SANITIZER``).
+
+The serving runtime is a ~40-lock, dozen-daemon-thread system whose
+worst historical bugs (CHANGES PR 10-14) were lock-discipline bugs:
+locks held across cold compiles, close()-vs-registration races, stale
+refcount tokens.  The static half of the concurrency contract lives in
+:mod:`mxnet_tpu.analysis.concurrency`; this module is the DYNAMIC half:
+
+- :func:`named_lock` / :func:`named_rlock` / :func:`named_condition`
+  construct the runtime's locks under stable names (``"serve.route"``,
+  ``"aot.cache"``, ...).  With the sanitizer OFF (the default) they
+  return the plain ``threading`` primitive — zero wrappers, zero
+  per-acquire instrument calls, byte-identical serving (the faults.py
+  zero-overhead discipline; tests pin it).
+- With ``MXNET_LOCK_SANITIZER=1`` they return a recording wrapper that
+  observes, per acquisition: the ORDER edge from every lock already
+  held by this thread to the one being acquired
+  (``mxnet_lock_order_edges_total{src,dst}``), and per release the
+  HOLD TIME (``mxnet_lock_hold_seconds{lock}``).  Observed edges merge
+  into the static may-hold-while-acquiring graph
+  (``analysis.concurrency.merge_observed`` /
+  ``tools/thread_lint.py --merge-observed``) so a runtime-only
+  acquisition order the AST walk could not see still participates in
+  cycle detection — and :func:`observed_inversions` /
+  :func:`assert_no_inversions` fail tests on any observed inversion.
+
+The lock NAMES are the join key: the static analyzer resolves a
+``named_lock("serve.route")`` assignment to the node id
+``serve.route``, so an observed edge and a static edge over the same
+pair land on the same graph nodes.
+
+Set ``MXNET_LOCK_SANITIZER_DUMP=/path.json`` to write the observed
+edges, hold stats, and any inversions at interpreter exit — the seam
+the subprocess smoke test (tests/test_thread_lint.py) reads back.
+"""
+from __future__ import annotations
+
+import atexit
+import bisect
+import json
+import os
+import threading
+import time
+
+__all__ = ["named_lock", "named_rlock", "named_condition", "enabled",
+           "enable", "disable", "reset", "observed_edges", "hold_stats",
+           "observed_inversions", "assert_no_inversions", "stats",
+           "dump", "HOLD_BUCKETS", "LockInversionError"]
+
+# Hold-time bucket edges in seconds: sub-microsecond scalar updates up
+# to multi-second cold compiles (the exact bug class the sanitizer
+# exists to catch red-handed).
+HOLD_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0)
+
+# The sanitizer's own state is guarded by a RAW lock: the sanitizer
+# must never sanitize itself (recording an edge would recurse).
+_STATE = threading.Lock()
+_EDGES = {}           # (src, dst) -> {"count": int, "site": "file:line"}
+_HOLDS = {}           # name -> [count, total_s, max_s, bucket_counts]
+_NAMES = set()        # every sanitized-lock name constructed
+_TLS = threading.local()
+
+_ACTIVE = None        # None = read env lazily; else the pinned bool
+_HOOKS = False        # atexit dump + healthz section installed
+_CB = False           # collect-time mirroring callback registered
+_HZ = False           # /healthz 'locks' section registered
+_PENDING = []         # (name, dt) hold observations awaiting collect
+_PUB_EDGES = {}       # (src, dst) -> count already mirrored
+_MAX_PENDING = 8192   # scrape-gap bound; _HOLDS aggregates regardless
+
+
+class LockInversionError(AssertionError):
+    """Raised by :func:`assert_no_inversions`: the sanitizer observed
+    two locks taken in both orders (a potential deadlock), with the
+    witnessing sites in the message."""
+
+
+def enabled():
+    """Is the sanitizer on?  Decided once from ``MXNET_LOCK_SANITIZER``
+    (or :func:`enable`/:func:`disable`); locks are constructed against
+    the answer, so flipping mid-process only affects locks built
+    afterwards — the env var at process start is the supported knob."""
+    global _ACTIVE
+    if _ACTIVE is None:
+        from . import config
+        _ACTIVE = bool(config.get("MXNET_LOCK_SANITIZER"))
+        if _ACTIVE:
+            _install_hooks()
+    elif _ACTIVE and not (_CB and _HZ):
+        # the first named_lock is often built while telemetry itself is
+        # mid-import (server.py constructs its section lock at module
+        # scope) — the initial registrations fail; retry until they land
+        _install_hooks()
+    return _ACTIVE
+
+
+def enable():
+    """Force the sanitizer on for locks constructed from now on
+    (tests; production uses the env var so EVERY lock is covered)."""
+    global _ACTIVE
+    _ACTIVE = True
+    _install_hooks()
+
+
+def disable():
+    """Turn the sanitizer off and reclaim its telemetry series and
+    /healthz section (the standing lifecycle rule: short-lived state
+    must not leave scrape residue).  Already-constructed sanitized
+    locks keep working but stop publishing new series."""
+    global _ACTIVE
+    _ACTIVE = False
+    _reclaim()
+
+
+def reset():
+    """Drop every observed edge/hold (tests run scenarios back to
+    back); keeps the on/off state."""
+    with _STATE:
+        _EDGES.clear()
+        _HOLDS.clear()
+        del _PENDING[:]
+        _PUB_EDGES.clear()
+
+
+# ---------------------------------------------------------------- factories
+
+def named_lock(name):
+    """A ``threading.Lock`` under a stable sanitizer name.  OFF: the
+    raw primitive (zero overhead, byte-identical).  ON: a recording
+    wrapper."""
+    if not enabled():
+        return threading.Lock()
+    return _SanitizedLock(name, threading.Lock())
+
+
+def named_rlock(name):
+    """A ``threading.RLock`` under a stable sanitizer name."""
+    if not enabled():
+        return threading.RLock()
+    return _SanitizedLock(name, threading.RLock(), reentrant=True)
+
+
+def named_condition(name, lock=None):
+    """A ``threading.Condition`` whose underlying lock is sanitized
+    under ``name``.  Pass ``lock`` (itself from :func:`named_lock`) to
+    share one lock between a condition and direct ``with`` use — the
+    Condition protocol only needs acquire/release, which the wrapper
+    provides, so ``wait()`` correctly pops/pushes the held set across
+    its release/reacquire."""
+    if lock is None:
+        lock = named_lock(name)
+    return threading.Condition(lock)
+
+
+# ---------------------------------------------------------------- wrapper
+
+class _SanitizedLock(object):
+    """Recording proxy around a threading lock.
+
+    Per-thread held state rides ``_TLS.held`` (a stack of
+    ``[name, t_acquire]`` records).  A thread-local ``busy`` flag makes
+    recording re-entrancy-safe.  Record paths never call into
+    telemetry: telemetry's own registry/family locks are sanitized
+    too, so publishing synchronously from acquire/release would
+    re-acquire the very lock being recorded (observed as a /healthz
+    hang).  Publication happens at scrape time via ``_collect_cb``.
+    """
+    __slots__ = ("name", "_lock", "_reentrant")
+
+    def __init__(self, name, lock, reentrant=False):
+        self.name = str(name)
+        self._lock = lock
+        self._reentrant = reentrant
+        with _STATE:
+            _NAMES.add(self.name)
+
+    # -- lock protocol ----------------------------------------------------
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._record_acquire()
+        return got
+
+    def release(self):
+        self._record_release()
+        self._lock.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __repr__(self):
+        return "<named_lock %s (sanitized)>" % self.name
+
+    # -- recording --------------------------------------------------------
+    def _record_acquire(self):
+        if getattr(_TLS, "busy", False):
+            return
+        _TLS.busy = True
+        try:
+            held = getattr(_TLS, "held", None)
+            if held is None:
+                held = _TLS.held = []
+            if held:
+                seen = {self.name}
+                site = None
+                for rec in held:
+                    src = rec[0]
+                    if src in seen:
+                        continue        # re-entrant / duplicate names
+                    seen.add(src)
+                    key = (src, self.name)
+                    with _STATE:
+                        e = _EDGES.get(key)
+                        if e is None:
+                            if site is None:
+                                site = _call_site()
+                            _EDGES[key] = {"count": 1, "site": site}
+                        else:
+                            e["count"] += 1
+            held.append([self.name, time.monotonic()])
+        finally:
+            _TLS.busy = False
+
+    def _record_release(self):
+        if getattr(_TLS, "busy", False):
+            return
+        _TLS.busy = True
+        try:
+            held = getattr(_TLS, "held", None)
+            if not held:
+                return
+            # release order is LIFO in practice; tolerate out-of-order
+            # by scanning from the top for the newest matching record
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][0] == self.name:
+                    dt = time.monotonic() - held[i][1]
+                    del held[i]
+                    self._record_hold(dt)
+                    return
+        finally:
+            _TLS.busy = False
+
+    def _record_hold(self, dt):
+        with _STATE:
+            h = _HOLDS.get(self.name)
+            if h is None:
+                h = _HOLDS[self.name] = [0, 0.0, 0.0,
+                                         [0] * (len(HOLD_BUCKETS) + 1)]
+            h[0] += 1
+            h[1] += dt
+            h[2] = max(h[2], dt)
+            h[3][bisect.bisect_left(HOLD_BUCKETS, dt)] += 1
+            if len(_PENDING) < _MAX_PENDING:
+                _PENDING.append((self.name, dt))
+
+
+def _call_site():
+    """file:line of the acquiring frame (outside this module)."""
+    import sys
+    f = sys._getframe(2)
+    here = os.path.abspath(__file__)
+    while f is not None and os.path.abspath(f.f_code.co_filename) == here:
+        f = f.f_back
+    if f is None:
+        return "?"
+    return "%s:%d" % (os.path.relpath(f.f_code.co_filename,
+                                      os.getcwd()), f.f_lineno)
+
+
+# ---------------------------------------------------------------- telemetry
+
+def _ensure_collect_cb():
+    """Register the collect-time mirroring callback (idempotent;
+    retried until telemetry is importable — module-level named_lock
+    construction can run DURING the telemetry package's own import)."""
+    global _CB
+    if _CB:
+        return
+    try:
+        from . import telemetry
+        telemetry.registry().register_callback(_collect_cb)
+        _CB = True
+    except Exception:
+        pass
+
+
+def _collect_cb(reg):
+    """Scrape-time mirroring (the engines' _refresh idiom): drain the
+    pending hold observations into ``mxnet_lock_hold_seconds`` and the
+    edge-count deltas into ``mxnet_lock_order_edges_total``.  Record
+    paths themselves NEVER touch telemetry — publishing synchronously
+    from acquire/release deadlocks the moment the lock being recorded
+    is one of telemetry's own (registry/family), exactly the class of
+    bug this module exists to catch."""
+    if not _ACTIVE:
+        return
+    with _STATE:
+        pending = _PENDING[:]
+        del _PENDING[:]
+        deltas = {}
+        for key, e in _EDGES.items():
+            d = e["count"] - _PUB_EDGES.get(key, 0)
+            if d:
+                deltas[key] = d
+                _PUB_EDGES[key] = e["count"]
+    try:
+        if pending:
+            fam = reg.histogram(
+                "mxnet_lock_hold_seconds",
+                "lock hold time by sanitizer lock name "
+                "(MXNET_LOCK_SANITIZER=1 only; mxnet_tpu/locks.py)",
+                labelnames=("lock",), buckets=HOLD_BUCKETS)
+            for name, dt in pending:
+                fam.labels(lock=name).observe(dt)
+        if deltas:
+            fam = reg.counter(
+                "mxnet_lock_order_edges_total",
+                "observed held-while-acquiring lock-order edges "
+                "(MXNET_LOCK_SANITIZER=1 only; src held when dst "
+                "acquired — a pair present in BOTH directions is a "
+                "potential deadlock)",
+                labelnames=("src", "dst"))
+            for (s, d2), d in deltas.items():
+                fam.labels(src=s, dst=d2).inc(d)
+    except Exception:
+        pass
+
+
+def _reclaim():
+    """Remove the sanitizer's telemetry series and healthz section."""
+    global _HOOKS, _CB, _HZ
+    try:
+        from . import telemetry
+        reg = telemetry.registry()
+        if _CB:
+            reg.unregister_callback(_collect_cb)
+            _CB = False
+        for fam_name in ("mxnet_lock_hold_seconds",
+                         "mxnet_lock_order_edges_total"):
+            fam = reg.get(fam_name)
+            if fam is not None:
+                for values, _ in fam.series():
+                    fam.remove(*values)
+    except Exception:
+        pass
+    try:
+        from .telemetry import server
+        server.unregister_healthz_section("locks")
+    except Exception:
+        pass
+    _HZ = False
+    _HOOKS = False
+
+
+def _install_hooks():
+    """Install the sanitizer's observation hooks: the collect-time
+    telemetry mirror, the /healthz 'locks' section (top hold-time
+    offenders), and the atexit dump (MXNET_LOCK_SANITIZER_DUMP).  The
+    registrations are individually retried — see :func:`enabled`."""
+    global _HOOKS
+    _ensure_collect_cb()
+    _ensure_healthz()
+    if _HOOKS:
+        return
+    _HOOKS = True
+    path = os.environ.get("MXNET_LOCK_SANITIZER_DUMP", "").strip()
+    if path:
+        atexit.register(_dump_at_exit, path)
+
+
+def _ensure_healthz():
+    global _HZ
+    if _HZ:
+        return
+    try:
+        from .telemetry import server
+        server.register_healthz_section("locks", healthz_section)
+        _HZ = True
+    except Exception:
+        pass
+
+
+def _dump_at_exit(path):
+    try:
+        dump(path)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------- queries
+
+def observed_edges():
+    """``{(src, dst): {"count", "site"}}`` — every held-while-acquiring
+    edge the sanitizer has seen."""
+    with _STATE:
+        return {k: dict(v) for k, v in _EDGES.items()}
+
+
+def hold_stats():
+    """``{name: {"count", "total_s", "max_s", "mean_s", "buckets"}}``."""
+    out = {}
+    with _STATE:
+        for name, (count, total, mx, buckets) in _HOLDS.items():
+            out[name] = {"count": count, "total_s": total, "max_s": mx,
+                         "mean_s": (total / count) if count else 0.0,
+                         "buckets": list(buckets)}
+    return out
+
+
+def _find_cycles(adj):
+    """Tricolor DFS over ``{node: set(successors)}``; returns cycles as
+    node lists (each rotated to start at its min node, deduped)."""
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in adj}
+    stack, cycles, seen = [], [], set()
+
+    def visit(n):
+        color[n] = GREY
+        stack.append(n)
+        for m in sorted(adj.get(n, ())):
+            if m not in color:
+                continue
+            c = color[m]
+            if c == GREY:
+                cyc = stack[stack.index(m):] + [m]
+                body = cyc[:-1]
+                k = body.index(min(body))
+                canon = tuple(body[k:] + body[:k])
+                if canon not in seen:
+                    seen.add(canon)
+                    cycles.append(list(canon) + [canon[0]])
+            elif c == WHITE:
+                visit(m)
+        stack.pop()
+        color[n] = BLACK
+
+    for n in sorted(adj):
+        if color[n] == WHITE:
+            visit(n)
+    return cycles
+
+
+def observed_inversions():
+    """Cycles among the OBSERVED edges alone (two locks seen taken in
+    both orders at runtime, however long the cycle).  Each cycle comes
+    with the witnessing first-observation sites."""
+    with _STATE:
+        adj = {}
+        for (src, dst) in _EDGES:
+            adj.setdefault(src, set()).add(dst)
+            adj.setdefault(dst, set())
+        sites = {k: v["site"] for k, v in _EDGES.items()}
+    out = []
+    for cyc in _find_cycles(adj):
+        out.append({"cycle": cyc,
+                    "sites": [sites.get((cyc[i], cyc[i + 1]), "?")
+                              for i in range(len(cyc) - 1)]})
+    return out
+
+
+def assert_no_inversions():
+    """Raise :class:`LockInversionError` naming every observed cycle —
+    the test-suite gate: any suite run under MXNET_LOCK_SANITIZER=1
+    can end with this one call."""
+    inv = observed_inversions()
+    if inv:
+        lines = ["lock sanitizer observed %d acquisition-order "
+                 "inversion(s):" % len(inv)]
+        for item in inv:
+            lines.append("  " + " -> ".join(item["cycle"]))
+            for (a, b), s in zip(
+                    [(item["cycle"][i], item["cycle"][i + 1])
+                     for i in range(len(item["cycle"]) - 1)],
+                    item["sites"]):
+                lines.append("    %s -> %s first seen at %s" % (a, b, s))
+        raise LockInversionError("\n".join(lines))
+
+
+def stats():
+    """One JSON-able document: edges, holds, inversions, names."""
+    if _ACTIVE and not _CB:
+        _ensure_collect_cb()
+    return {"enabled": bool(_ACTIVE),
+            "locks": sorted(_NAMES),
+            "edges": [{"src": s, "dst": d, "count": v["count"],
+                       "site": v["site"]}
+                      for (s, d), v in sorted(observed_edges().items())],
+            "holds": hold_stats(),
+            "inversions": observed_inversions()}
+
+
+def healthz_section():
+    """The /healthz 'locks' block: sanitizer state + the top-5 hottest
+    locks by total hold time (the contended-lock shortlist an operator
+    reads before reaching for a profiler)."""
+    if _ACTIVE and not _CB:
+        _ensure_collect_cb()
+    holds = hold_stats()
+    top = sorted(holds.items(), key=lambda kv: -kv[1]["total_s"])[:5]
+    return {"sanitizer": bool(_ACTIVE),
+            "observed_edges": len(_EDGES),
+            "inversions": len(observed_inversions()),
+            "hottest": [{"lock": name,
+                         "count": h["count"],
+                         "total_s": round(h["total_s"], 6),
+                         "max_s": round(h["max_s"], 6)}
+                        for name, h in top]}
+
+
+def dump(path):
+    """Write :func:`stats` to ``path`` atomically (tmp + os.replace) —
+    the artifact ``tools/thread_lint.py --merge-observed`` and the
+    subprocess smoke read."""
+    doc = stats()
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return doc

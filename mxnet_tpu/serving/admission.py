@@ -30,6 +30,7 @@ import time
 
 from ..base import MXNetError
 from . import faults as _faults
+from .locks import named_condition
 
 __all__ = ["AdmissionController", "Request", "QueueFullError",
            "DeadlineExceededError", "ServerOverloadError",
@@ -152,7 +153,7 @@ class AdmissionController(object):
         # scheduler iteration (sub-ms apart), and an O(queue) scan per
         # step to discover "nothing can expire" is pure hot-path waste
         self._n_deadlined = 0
-        self._cond = threading.Condition()
+        self._cond = named_condition("serve.admission")
         self._closed = False
         # monotonically increasing counters, guarded by _cond's lock
         self.admitted = 0

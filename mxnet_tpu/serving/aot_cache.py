@@ -67,6 +67,8 @@ import hashlib
 import json
 import os
 import threading
+
+from .locks import named_lock
 import time
 import warnings
 
@@ -81,7 +83,7 @@ ENTRY_VERSION = 1
 # one warning per failure cause per process: a reload loop over a bad
 # cache volume must not spam one warning per bucket per engine
 _WARNED = set()
-_WARN_LOCK = threading.Lock()
+_WARN_LOCK = named_lock("aot.warn")
 
 
 def _warn_once(cause, msg):
@@ -175,7 +177,7 @@ class AOTCache(object):
         self.sharding = sharding if isinstance(sharding, dict) \
             else str(sharding)
         self._fp = None                 # computed lazily (needs jax)
-        self._lock = threading.Lock()
+        self._lock = named_lock("aot.cache")
         self.hits = 0
         self.misses = 0
         self.writes = 0

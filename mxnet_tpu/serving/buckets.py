@@ -35,6 +35,7 @@ import threading
 import numpy as np
 
 from ..base import MXNetError
+from .locks import named_lock
 from ..cached_op import CachedOp
 from ..predict import _infer_label_shapes, _label_like
 
@@ -213,8 +214,8 @@ class ProgramCache(object):
         self._n_out = len(symbol._outputs)
         self._plans = {}         # full data-shape key -> prefilled flat
         self._keys = set()       # bucket signatures dispatched so far
-        self._lock = threading.Lock()
-        self._build_lock = threading.Lock()   # plan construction only
+        self._lock = named_lock("serve.programs")
+        self._build_lock = named_lock("serve.programs.build")
         # plan-cache traffic counters: plain ints (only the single
         # worker + pre-start warmup touch them), mirrored into the
         # telemetry registry by the engine's collect callback and

@@ -98,6 +98,7 @@ from ..base import MXNetError
 from .. import telemetry as _telemetry
 from ..telemetry import goodput as _goodput
 from . import faults as _faults
+from .locks import named_lock, named_condition
 from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
 from .buckets import ProgramCache, _next_pow2
@@ -685,7 +686,7 @@ class StepProgram(object):
         # the lazy resolution can be reached from two threads at once
         # (the replica scheduler's first step racing a rehab probe on
         # this program): serialize it so exactly one trace happens
-        self._kernel_lock = threading.Lock()
+        self._kernel_lock = named_lock("decode.kernel")
         self._graph_digest = None
         if self._aot is not None:
             from .aot_cache import graph_digest
@@ -1610,8 +1611,8 @@ class DecodeEngine(object):
         for i, (rctx, rplan) in enumerate(placements):
             self._replicas.append(self._new_replica(i, rctx, rplan))
         self._multi = len(self._replicas) > 1
-        self._dr_lock = threading.Lock()
-        self._dr_cond = threading.Condition(self._dr_lock)
+        self._dr_lock = named_lock("decode.replica")
+        self._dr_cond = named_condition("decode.replica", self._dr_lock)
         self._dr_stop = False
         self._slot_free = threading.Event()
         self._tm = (_DecodeTelemetry(self)
@@ -1639,7 +1640,7 @@ class DecodeEngine(object):
             max_queue=max_queue, overload_policy=overload_policy,
             wake_hint=self.num_slots * len(self._replicas),
             telemetry=self._tm)
-        self._lock = threading.Lock()
+        self._lock = named_lock("decode.engine")
         self._step_ms = collections.deque(maxlen=4096)
         self._lat_ms = collections.deque(maxlen=4096)
         self._steps = 0
@@ -3346,6 +3347,9 @@ class DecodeEngine(object):
         counts, per-step and end-to-end latency percentiles — the
         same numbers the ``mxnet_serve_decode_*`` series carry."""
         snap = self._adm.stats()
+        # allocator peek outside the lock: device_memory_peak() can
+        # stall on the backend, and a scrape must not block stepping
+        mem = _memory_stats_block(self.memory_plan)
         with self._lock:
             step = sorted(self._step_ms)
             lat = sorted(self._lat_ms)
@@ -3366,7 +3370,7 @@ class DecodeEngine(object):
                 "sharding": self._sharding_spec,
                 "aot": (self._aot.stats() if self._aot is not None
                         else {"enabled": False}),
-                "memory": _memory_stats_block(self.memory_plan),
+                "memory": mem,
                 "efficiency": (self._eff.stats_block()
                                if self._eff is not None
                                else {"enabled": False}),

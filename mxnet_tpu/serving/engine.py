@@ -71,6 +71,7 @@ from .. import profiler
 from .. import telemetry as _telemetry
 from ..telemetry import goodput as _goodput
 from . import faults as _faults
+from .locks import named_lock, named_condition
 from .admission import (AdmissionController, Request, EngineClosedError,
                         _fail_future)
 from .buckets import BucketPolicy, ProgramCache, pad_valid_lengths
@@ -660,8 +661,9 @@ class ServingEngine(object):
                                                plan=rplan))
         self._cache = self._replicas[0].cache   # single-replica alias
         self._multi = len(self._replicas) > 1
-        self._route_lock = threading.Lock()
-        self._route_cond = threading.Condition(self._route_lock)
+        self._route_lock = named_lock("serve.route")
+        self._route_cond = named_condition("serve.route",
+                                           self._route_lock)
         self._replicas_stop = False
         # telemetry bundle: None when disabled — every instrumented
         # branch below gates on that, keeping the disabled hot path at
@@ -698,13 +700,13 @@ class ServingEngine(object):
                                   if self._tm is not None else False)
         self._sig_labels = {}        # group key -> shape-sig counter child
         self._sig_other = None       # shared catch-all child past the cap
-        self._sig_lock = threading.Lock()   # guards creation + the cap
+        self._sig_lock = named_lock("serve.sig")  # creation + the cap
         self._retraces = 0
         self._adm = AdmissionController(max_queue=max_queue,
                                         overload_policy=overload_policy,
                                         wake_hint=self._policy.max_batch,
                                         telemetry=self._tm)
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.engine")
         self._group_cache = {}   # exact input shapes -> validated group
         self._lat_ms = collections.deque(maxlen=4096)
         self._batches = 0
@@ -2017,6 +2019,9 @@ class ServingEngine(object):
         reports zeros for every latency field, never NaN or an
         exception."""
         snap = self._adm.stats()
+        # allocator peek outside the lock: device_memory_peak() can
+        # stall on the backend, and a scrape must not block dispatch
+        mem = _memory_stats_block(self.memory_plan)
         with self._lock:
             lat = sorted(self._lat_ms)
             snap.update({
@@ -2066,7 +2071,7 @@ class ServingEngine(object):
                     "reason": (self.opt_plan.reason
                                if self.opt_plan is not None else None),
                 },
-                "memory": _memory_stats_block(self.memory_plan),
+                "memory": mem,
                 "efficiency": (self._eff.stats_block()
                                if self._eff is not None
                                else {"enabled": False}),

@@ -1,0 +1,29 @@
+"""serving.locks — named-lock wrappers + the runtime lock sanitizer.
+
+The serving tier's documented home for the lock-discipline API; the
+implementation lives in :mod:`mxnet_tpu.locks` (package top level,
+stdlib-only imports) so that telemetry/ — which serving imports — can
+adopt the same named locks without an import cycle.
+
+Usage (the runtime's own pattern)::
+
+    from .locks import named_lock, named_condition       # serving/
+    from ..locks import named_lock                       # telemetry/
+
+    self._route_lock = named_lock("serve.route")
+    self._route_cond = named_condition("serve.route", self._route_lock)
+
+With ``MXNET_LOCK_SANITIZER=0`` (default) these ARE the plain
+``threading`` primitives; with ``=1`` they record acquisition-order
+edges, held-sets, and hold-time histograms.  See
+:mod:`mxnet_tpu.locks` and the README "Concurrency soundness" section.
+"""
+from ..locks import (named_lock, named_rlock, named_condition, enabled,
+                     enable, disable, reset, observed_edges, hold_stats,
+                     observed_inversions, assert_no_inversions, stats,
+                     dump, HOLD_BUCKETS, LockInversionError)
+
+__all__ = ["named_lock", "named_rlock", "named_condition", "enabled",
+           "enable", "disable", "reset", "observed_edges", "hold_stats",
+           "observed_inversions", "assert_no_inversions", "stats",
+           "dump", "HOLD_BUCKETS", "LockInversionError"]

@@ -45,6 +45,7 @@ import threading
 import time
 
 from .. import telemetry as _telemetry
+from .locks import named_lock
 
 __all__ = ["Regulator", "WATCHED_RULES"]
 
@@ -111,7 +112,7 @@ class Regulator(object):
         self.tightenings = 0
         self.relaxations = 0
         self.last_decision = None
-        self._lock = threading.Lock()
+        self._lock = named_lock("regulator.state")
         self._stop = threading.Event()
         self._thread = None
         self._tm = None

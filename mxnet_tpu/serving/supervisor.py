@@ -47,6 +47,7 @@ import warnings
 import weakref
 
 from .. import telemetry as _telemetry
+from .locks import named_lock
 
 __all__ = ["Supervisor", "engine_acquire", "engine_release",
            "engine_state", "get_supervisor"]
@@ -114,7 +115,7 @@ class Supervisor(object):
         self.max_attempts = int(max_attempts)
         self.jitter = float(jitter)
         self.seed = int(seed)
-        self._lock = threading.Lock()
+        self._lock = named_lock("supervisor.state")
         self._engines = {}      # id -> (weakref, name, tm_label)
         self._records = {}      # (id, replica_index) -> _Record
         self._counts = {"ok": 0, "fail": 0, "retired": 0}
@@ -377,7 +378,7 @@ class Supervisor(object):
 
 # -- process-wide refcounted singleton (server.py discipline) ----------------
 
-_LOCK = threading.Lock()
+_LOCK = named_lock("supervisor.registry")
 _SUP = None
 _REFS = 0
 

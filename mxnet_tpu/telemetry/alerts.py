@@ -44,6 +44,7 @@ import time
 import warnings
 
 from ..base import MXNetError
+from ..locks import named_lock
 
 __all__ = ["AlertRule", "AlertManager", "default_manager",
            "register_engine_default_rules", "load_rules_file"]
@@ -249,7 +250,7 @@ class AlertManager(object):
     """
 
     def __init__(self, registry=None):
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.alerts")
         self._states = {}
         self._registry = registry
         self.last_eval = None        # monotonic of the last evaluate()

@@ -33,6 +33,7 @@ import bisect
 import threading
 
 from ..base import MXNetError
+from ..locks import named_lock
 
 __all__ = ["Counter", "Gauge", "Histogram", "Family", "Registry",
            "LATENCY_MS_BUCKETS", "LATENCY_S_BUCKETS", "RATIO_BUCKETS",
@@ -170,7 +171,7 @@ class Family(object):
         self.labelnames = tuple(labelnames)
         self.buckets = tuple(buckets) if buckets else None
         self._children = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.family")
         if not self.labelnames:
             self._children[()] = self._new_child()
 
@@ -258,7 +259,7 @@ class Registry(object):
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.registry")
         self._families = {}
         self._callbacks = []
         # bumped by reset(): lets call sites memoize bound instrument

@@ -49,6 +49,7 @@ import time
 import weakref
 
 from ..base import MXNetError
+from ..locks import named_lock
 
 __all__ = ["HistoryRecorder", "FlightRecorder", "RingFile",
            "start_recorder",
@@ -121,7 +122,7 @@ class HistoryRecorder(object):
         self.alerts = alerts
         self._ring = collections.deque(maxlen=self.window)
         self._kinds = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.recorder")
         self._stop = threading.Event()
         self._thread = None
         self.t_start = time.monotonic()
@@ -355,7 +356,7 @@ class HistoryRecorder(object):
 # WeakMethod storage: an engine GC'd without close() must drop out of
 # the poll instead of being kept alive by its own diagnostics.
 
-_HB_LOCK = threading.Lock()
+_HB_LOCK = named_lock("telemetry.heartbeats")
 _HEARTBEATS = {}
 
 
@@ -400,7 +401,7 @@ def heartbeats():
 
 # -- live-engine registry (flight-recorder stats() capture) ------------------
 
-_ENG_LOCK = threading.Lock()
+_ENG_LOCK = named_lock("telemetry.recorder.engines")
 _ENGINES = {}
 
 
@@ -468,7 +469,7 @@ class RingFile(object):
         self.path = path
         self.slot_size = int(slot_size)
         self.nslots = int(nslots)
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.ring")
         self._seq = 0
         self._f = None
         try:
@@ -591,7 +592,7 @@ class RingFile(object):
         return [dict(rec, seq=seq) for seq, rec in recs]
 
 
-_RING_LOCK = threading.Lock()
+_RING_LOCK = named_lock("telemetry.ring.global")
 _RINGFILE = None
 _RING_PATH = None
 
@@ -644,7 +645,7 @@ class FlightRecorder(object):
         self.directory = directory
         self.max_bundles = int(max_bundles)
         self.min_interval_s = float(min_interval_s)
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.flight")
         self._last = {}          # reason -> monotonic of last dump
 
     @staticmethod
@@ -751,7 +752,7 @@ class FlightRecorder(object):
             pass
 
 
-_FR_LOCK = threading.Lock()
+_FR_LOCK = named_lock("telemetry.flight.global")
 _FR = None
 _FR_DIR = None
 
@@ -773,7 +774,7 @@ def flight_recorder():
 
 # -- process-wide singleton + engine refcounting (server.py discipline) ------
 
-_LOCK = threading.Lock()
+_LOCK = named_lock("telemetry.recorder.global")
 _REC = None
 _MANUAL = False
 _REFS = 0

@@ -66,6 +66,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse, parse_qs
 
 from ..base import MXNetError
+from ..locks import named_lock
 
 __all__ = ["TelemetryServer", "start_server", "stop_server",
            "server_address", "publish_event", "event_hub",
@@ -81,7 +82,7 @@ __all__ = ["TelemetryServer", "start_server", "stop_server",
 # import at scrape time).  A raising provider reports itself instead
 # of failing the probe.
 
-_SECTIONS_LOCK = threading.Lock()
+_SECTIONS_LOCK = named_lock("telemetry.healthz")
 _HEALTHZ_SECTIONS = {}
 
 
@@ -106,7 +107,7 @@ class _EventHub(object):
     the publisher — observability must never slow the observed."""
 
     def __init__(self, replay=256, sub_capacity=1024):
-        self._lock = threading.Lock()
+        self._lock = named_lock("telemetry.events")
         self._seq = 0
         self._replay = collections.deque(maxlen=replay)
         self._subs = []
@@ -586,7 +587,7 @@ class TelemetryServer(object):
 # engine-reload loops leak-free without letting one engine's close tear
 # down a server the operator started deliberately.
 
-_LOCK = threading.Lock()
+_LOCK = named_lock("telemetry.server")
 _SERVER = None
 _MANUAL = False          # True: outlives engine refcounting
 _ENGINE_REFS = 0

@@ -1,0 +1,25 @@
+"""thread_lint test fixture: blocking-call-under-lock + wait-no-loop.
+
+``slow_under_lock`` sleeps while holding a lock (the PR 11 bug class:
+every contending thread stalls behind it); ``wait_no_loop`` calls
+``Condition.wait`` outside a predicate loop (missed-notify hazard).
+tests/test_thread_lint.py asserts both fire as WARNINGs (exit 0
+non-strict, exit 1 under --strict) and that an allowlist row
+suppresses the sleep with its justification as provenance.  Never
+imported at runtime.
+"""
+import threading
+import time
+
+LOCK = threading.Lock()
+COND = threading.Condition()
+
+
+def slow_under_lock():
+    with LOCK:
+        time.sleep(0.1)
+
+
+def wait_no_loop():
+    with COND:
+        COND.wait(1.0)

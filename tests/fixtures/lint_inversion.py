@@ -1,0 +1,23 @@
+"""thread_lint test fixture: a deliberate lock-order inversion.
+
+``ab()`` takes A then B; ``ba()`` takes B then A — two threads running
+these concurrently can deadlock.  tests/test_thread_lint.py asserts
+the linter's tricolor DFS reports exactly this cycle as a lock-order
+ERROR (exit 1 even without --strict).  Never imported at runtime.
+"""
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def ab():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+
+def ba():
+    with LOCK_B:
+        with LOCK_A:
+            pass

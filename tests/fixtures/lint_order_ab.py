@@ -1,0 +1,18 @@
+"""thread_lint test fixture: one-directional named-lock nesting.
+
+Statically only ``fix.a -> fix.b`` exists — no cycle.  The test merges
+a sanitizer dump carrying an OBSERVED ``fix.b -> fix.a`` edge
+(--merge-observed), which closes the cycle: static analysis and the
+runtime sanitizer meet on the same named-lock graph nodes.  Never
+imported at runtime.
+"""
+from mxnet_tpu.serving.locks import named_lock
+
+A = named_lock("fix.a")
+B = named_lock("fix.b")
+
+
+def ab():
+    with A:
+        with B:
+            pass

@@ -827,6 +827,16 @@ def test_reload_loop_reclaims_rules_heartbeats_recorder(monkeypatch):
     assert telemetry.get_recorder() is not None
     e2.close()
     assert len(mgr) == 0 and telemetry.get_recorder() is None
+    # second, independent gate (PR 19): the static lifecycle lint
+    # must also prove every register_heartbeat / add_rule /
+    # recorder_acquire has a close()-reachable release — a future
+    # unpaired-acquire regression fails in two distinct ways
+    from mxnet_tpu.analysis import analyze_concurrency
+    model = analyze_concurrency()
+    unpaired = [d for d in model.report.to_list()
+                if d["pass"] == "lifecycle"
+                and d["node"] != "telemetry.sampling:SamplerChain"]
+    assert unpaired == [], unpaired
 
 
 def test_operator_owned_recorder_survives_engine_close(monkeypatch):

@@ -451,6 +451,16 @@ def test_reload_loop_leak_gate_with_replicas(_fresh_telemetry):
     assert len(mgr) == rules0
     assert telemetry.heartbeats() == {}
     assert telemetry.get_recorder() is None
+    # second, independent gate (PR 19): the STATIC reclaim-pairing
+    # lint must agree that every dynamic-label series has a close()-
+    # reachable reclaim — a series-without-reclaim regression now
+    # fails here even if the runtime loop above misses its family
+    from mxnet_tpu.analysis import analyze_concurrency
+    model = analyze_concurrency()
+    leaks = [d for d in model.report.to_list()
+             if d["pass"] == "lifecycle"
+             and d["node"] != "telemetry.sampling:SamplerChain"]
+    assert leaks == [], leaks
 
 
 # ---------------------------------------------------------------------------

@@ -501,6 +501,24 @@ def format_healthz(doc):
                      % (al.get("rules"), al.get("firing"),
                         "" if al.get("evaluating") else
                         "  [WARNING: nothing evaluating]"))
+    lk = doc.get("locks")
+    if lk:
+        lines.append("locks: sanitizer=%s edges=%s inversions=%s%s"
+                     % ("on" if lk.get("sanitizer") else "off",
+                        lk.get("observed_edges"),
+                        lk.get("inversions"),
+                        "  [INVERSION OBSERVED]"
+                        if lk.get("inversions") else ""))
+        hot = lk.get("hottest") or []
+        if hot:
+            lines.append("  %-28s %9s %11s %11s"
+                         % ("hottest locks", "holds", "total_s",
+                            "max_s"))
+            for row in hot:
+                lines.append("  %-28s %9s %11s %11s"
+                             % (row.get("lock"), row.get("count"),
+                                _num(row.get("total_s")),
+                                _num(row.get("max_s"))))
     if doc.get("train_steps") is not None:
         lines.append("train_steps=%s  mfu=%s"
                      % (doc.get("train_steps"), doc.get("train_mfu")))

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Thread linter: the static concurrency-soundness suite from the shell.
+
+Runs :mod:`mxnet_tpu.analysis.concurrency` — lock discovery, the
+may-hold-while-acquiring edge graph, lock-order cycle detection, and
+the blocking-under-lock / cond-wait / lifecycle-pairing / thread-
+daemon lints — over the installed ``mxnet_tpu`` package (or an
+explicit set of files) without importing or executing any of it.
+
+Usage:
+    # lint the whole runtime (CI gate: must exit 0)
+    python tools/thread_lint.py --strict
+
+    # machine-readable findings + the full lock/edge model
+    python tools/thread_lint.py --json
+
+    # lint specific files (the tests' fixture path)
+    python tools/thread_lint.py --files tests/fixtures/inversion.py
+
+    # merge a sanitizer dump (MXNET_LOCK_SANITIZER_DUMP=...) into the
+    # static graph before cycle detection: observed edges from a real
+    # run can close a cycle the static walk alone cannot see
+    python tools/thread_lint.py --merge-observed /tmp/locks.json
+
+Exit codes (the graph_lint contract, adapted):
+    0  clean (non-strict: WARNING findings allowed; strict: none)
+    1  findings — any lock-order cycle (ERROR) always exits 1;
+       WARNING-level findings exit 1 under --strict only
+    2  analysis could not run (unreadable/unparseable source, bad
+       allowlist, bad --merge-observed file)
+
+Allowlist: ``tools/thread_lint_allow.json`` next to this script is
+auto-loaded (``--allowlist`` overrides, ``--no-allowlist`` disables).
+Each entry must carry a non-empty ``justification`` (no TODOs) and
+matches findings by ``pass`` + ``node`` (+ optional ``op``):
+
+    [{"pass": "lock-blocking",
+      "node": "serving.buckets:ProgramCache._plan_for",
+      "op": "serving.buckets:ProgramCache._resolve_kernel",
+      "justification": "single-flight build lock; _lock stays fast"}]
+
+Suppressed findings are still reported (stderr summary and the
+``suppressed`` array in --json) with the justification as provenance —
+an allowlist hides nothing, it only moves the exit code.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+if __package__ is None or __package__ == "":       # script invocation
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_allowlist(path):
+    """Parse + validate the allowlist; raises ValueError on bad rows."""
+    with open(path, "r") as f:
+        rows = json.load(f)
+    if not isinstance(rows, list):
+        raise ValueError("allowlist must be a JSON array of objects")
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            raise ValueError("allowlist[%d]: not an object" % i)
+        for req in ("pass", "node", "justification"):
+            if not isinstance(row.get(req), str) or not row[req].strip():
+                raise ValueError(
+                    "allowlist[%d]: missing/empty %r" % (i, req))
+        if "todo" in row["justification"].lower():
+            raise ValueError(
+                "allowlist[%d]: justification contains TODO — write "
+                "the actual reason the finding is safe" % i)
+        if row["pass"] not in __import__(
+                "mxnet_tpu.analysis.concurrency",
+                fromlist=["PASSES"]).PASSES:
+            raise ValueError(
+                "allowlist[%d]: unknown pass %r" % (i, row["pass"]))
+    return rows
+
+
+def _matches(row, finding):
+    if row["pass"] != finding["pass"]:
+        return False
+    if row["node"] != (finding.get("node") or ""):
+        return False
+    if "op" in row and row["op"] != (finding.get("op") or ""):
+        return False
+    return True
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="static lock-order/race linter over mxnet_tpu "
+                    "runtime sources")
+    ap.add_argument("--files", nargs="*", default=None,
+                    help="explicit source files (default: the whole "
+                         "installed mxnet_tpu package)")
+    ap.add_argument("--root", default=None,
+                    help="package root anchoring module names when "
+                         "--files is used")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on WARNING findings too")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the full model + findings as JSON")
+    ap.add_argument("--allowlist", default=None,
+                    help="explicit allowlist path (default: "
+                         "thread_lint_allow.json next to this script)")
+    ap.add_argument("--no-allowlist", action="store_true",
+                    help="ignore any allowlist")
+    ap.add_argument("--merge-observed", default=None, metavar="DUMP",
+                    help="sanitizer dump JSON whose observed edges "
+                         "are merged before cycle detection")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.analysis import concurrency
+
+    # ---- allowlist -------------------------------------------------------
+    allow = []
+    if not args.no_allowlist:
+        path = args.allowlist
+        if path is None:
+            cand = os.path.join(os.path.dirname(os.path.abspath(
+                __file__)), "thread_lint_allow.json")
+            path = cand if os.path.exists(cand) else None
+        elif not os.path.exists(path):
+            print("thread_lint: allowlist not found: %s" % path,
+                  file=sys.stderr)
+            return 2
+        if path is not None:
+            try:
+                allow = _load_allowlist(path)
+            except (ValueError, OSError, json.JSONDecodeError) as e:
+                print("thread_lint: bad allowlist %s: %s" % (path, e),
+                      file=sys.stderr)
+                return 2
+
+    # ---- analyze ---------------------------------------------------------
+    try:
+        if args.files:
+            model = concurrency.analyze_sources(args.files,
+                                                root=args.root)
+        else:
+            model = concurrency.analyze_package()
+    except Exception as e:
+        print("thread_lint: analysis failed: %s" % e, file=sys.stderr)
+        return 2
+    if model.load_errors:
+        for p, msg in model.load_errors:
+            print("thread_lint: cannot analyze %s: %s" % (p, msg),
+                  file=sys.stderr)
+        return 2
+
+    if args.merge_observed:
+        try:
+            with open(args.merge_observed) as f:
+                dump = json.load(f)
+            model.merge_observed(dump.get("edges", dump)
+                                 if isinstance(dump, dict) else dump)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print("thread_lint: bad --merge-observed file: %s" % e,
+                  file=sys.stderr)
+            return 2
+
+    # ---- partition findings against the allowlist ------------------------
+    findings = model.report.to_list()
+    active, suppressed = [], []
+    for fd in findings:
+        row = next((r for r in allow if _matches(r, fd)), None)
+        if row is None:
+            active.append(fd)
+        else:
+            fd = dict(fd, suppressed_by=row["justification"])
+            suppressed.append(fd)
+
+    errors = [f for f in active if f["severity"] == "error"]
+    warnings_ = [f for f in active if f["severity"] != "error"]
+
+    # ---- report ----------------------------------------------------------
+    if args.as_json:
+        out = model.to_dict()
+        out["findings"] = active
+        out["suppressed"] = suppressed
+        out["strict"] = bool(args.strict)
+        out["exit"] = 1 if (errors or (args.strict and warnings_)) \
+            else 0
+        json.dump(out, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        print("thread_lint: %d modules, %d functions, %d locks, "
+              "%d hold-edges, %d cycles"
+              % (len(model.modules), len(model.funcs),
+                 len(model.locks), len(model.edges),
+                 len(model.cycles)))
+        for fd in active:
+            print("  [%s/%s] %s" % (fd["severity"].upper(),
+                                    fd["pass"], fd["message"]))
+        for fd in suppressed:
+            print("  [allowlisted/%s] %s\n      justification: %s"
+                  % (fd["pass"], fd["message"], fd["suppressed_by"]))
+        verdict = "CLEAN" if not active else (
+            "FAIL" if errors or args.strict else "WARN")
+        print("thread_lint: %s (%d errors, %d warnings, "
+              "%d allowlisted)" % (verdict, len(errors),
+                                   len(warnings_), len(suppressed)))
+
+    if errors:
+        return 1
+    if args.strict and warnings_:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
