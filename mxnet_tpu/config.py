@@ -274,6 +274,27 @@ VARIABLES = {v.name: v for v in [
          "request-scoped tracing across serving, executor, kvstore, and "
          "the input pipeline.  Off = instrumented call sites hold no "
          "instruments and make zero registry calls per request."),
+    _Var("MXNET_TELEMETRY_TIMELINE", bool, True,
+         "Unified fleet timeline (telemetry/timeline.py): a process-"
+         "wide bounded ring of dual-stamped (wall + monotonic) events "
+         "fed by every plane — span trees, per-replica dispatches, "
+         "decode scheduler iterations and slot churn, lock holds, "
+         "alert transitions, flight dumps, regulator limit moves, "
+         "supervisor rehab/retire, injected faults.  Exported as "
+         "Chrome trace_event JSON (GET /timeline?format=chrome, "
+         "tools/telemetry_dump.py timeline, tools/request_autopsy.py)."
+         "  Requires MXNET_TELEMETRY_ON; 0 = zero ring appends and "
+         "bitwise-identical serving."),
+    _Var("MXNET_TELEMETRY_TIMELINE_CAP", int, 16384,
+         "Capacity of the timeline event ring (events, process-wide). "
+         "Oldest events drop first; the drop count is reported in "
+         "every export so a truncated window is never mistaken for a "
+         "quiet one."),
+    _Var("MXNET_TELEMETRY_TIMELINE_LOCK_MS", float, 1.0,
+         "Minimum lock-hold duration (ms) the lock sanitizer records "
+         "into the timeline ring.  Micro-holds below this flood the "
+         "bounded window without carrying contention signal; 0 "
+         "records every hold."),
     _Var("MXNET_TELEMETRY_SNAPSHOT_SECS", float, 0.0,
          "Interval for the periodic telemetry snapshot thread (0 = "
          "off).  Every interval the current metrics snapshot is "

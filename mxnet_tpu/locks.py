@@ -39,6 +39,7 @@ import atexit
 import bisect
 import json
 import os
+import sys
 import threading
 import time
 
@@ -257,6 +258,13 @@ class _SanitizedLock(object):
             h[3][bisect.bisect_left(HOLD_BUCKETS, dt)] += 1
             if len(_PENDING) < _MAX_PENDING:
                 _PENDING.append((self.name, dt))
+        # timeline feed: lock-free by construction (deque append), so
+        # it is the ONE telemetry call a record path may make.  Guard
+        # on the already-imported module — never trigger an import
+        # from inside a lock release.
+        tl = sys.modules.get("mxnet_tpu.telemetry.timeline")
+        if tl is not None:
+            tl.lock_feed(self.name, dt)
 
 
 def _call_site():

@@ -1617,6 +1617,11 @@ class DecodeEngine(object):
         self._slot_free = threading.Event()
         self._tm = (_DecodeTelemetry(self)
                     if _telemetry.enabled() else None)
+        # unified fleet timeline (telemetry/timeline.py): cached ring
+        # reference, None when the plane is off — the disabled path
+        # appends nothing and decodes bitwise-identically
+        self._tl = (_telemetry.timeline.get()
+                    if _telemetry.timeline.enabled() else None)
         # serving efficiency plane (ISSUE 18): per-dispatch FLOPs
         # ledger + MFU/goodput gauges + per-tenant accounting.  Step
         # programs are priced ONCE here (memoized on the program);
@@ -2166,6 +2171,9 @@ class DecodeEngine(object):
         if self._eff is not None:
             self._eff.close()
             self._eff = None
+        # the timeline ring is process-wide (no per-engine state to
+        # reclaim); drop the reference so a closed engine cannot feed
+        self._tl = None
         if self._tm is not None:
             self._tm.close()
         if self._obs_name is not None:
@@ -2532,6 +2540,10 @@ class DecodeEngine(object):
                     self._steals += stolen
                 if self._tm is not None:
                     self._tm.steals.inc(stolen)
+                if self._tl is not None:
+                    self._tl.instant("decode.steal", "decode",
+                                     "decode:%s" % rep.label,
+                                     args={"stolen": stolen})
             live = []
             for req in seats:
                 # honor deadlines that expired in the routed-but-
@@ -2615,6 +2627,10 @@ class DecodeEngine(object):
             self._finish_slot(rep, i, "error")
         if rep.tm_failures is not None:
             rep.tm_failures.inc()
+        if self._tl is not None:
+            self._tl.instant("decode.replica_failed", "decode",
+                             "decode:%s" % rep.label,
+                             args={"error": repr(exc)})
         fr = _telemetry.recorder.flight_recorder()
         if fr is not None:
             fr.dump("replica_failed:%s:%s"
@@ -2795,6 +2811,12 @@ class DecodeEngine(object):
             self._joins += 1
         if self._tm is not None:
             self._tm.joins.inc()
+        if self._tl is not None:
+            self._tl.instant("decode.join", "decode",
+                             "decode:%s" % rep.label,
+                             args={"slot": slot,
+                                   "request": req.sse_id,
+                                   "prompt_len": len(req.prompt)})
         return True
 
     def _fail_seated(self, rep, req, exc):
@@ -2846,6 +2868,7 @@ class DecodeEngine(object):
             plen = len(req.prompt)
             arr[r_i, :plen] = req.prompt
             lens[r_i] = plen
+        t_pf0 = time.perf_counter()
         try:
             outs = rep.prefill_caches[bucket].run({
                 self._prefill_data_name: arr,
@@ -2870,6 +2893,11 @@ class DecodeEngine(object):
         if self._tm is not None:
             self._tm.prefill_elems(bucket, live_elems,
                                    padded_elems - live_elems)
+        if self._tl is not None:
+            self._tl.complete("decode.prefill", "decode",
+                              "decode:%s" % rep.label, t_pf0,
+                              time.perf_counter(),
+                              args={"bucket": bucket, "group": len(live)})
         if self._eff is not None:
             shape_key = tuple(sorted(
                 (k, v.shape)
@@ -2926,6 +2954,13 @@ class DecodeEngine(object):
         Requests without a ``request_id`` pay a single attribute check."""
         if req.sse_id is None:
             return
+        if self._tl is not None:
+            # streaming requests already pay an SSE publish per token;
+            # the ring append is cheaper and gives request_autopsy the
+            # exact per-token gaps instead of step-derived estimates
+            self._tl.instant("decode.token", "decode", "decode.tokens",
+                             args={"request": req.sse_id,
+                                   "index": len(req.tokens) - 1})
         try:
             _telemetry.server.publish_event(
                 "decode.token",
@@ -3062,11 +3097,17 @@ class DecodeEngine(object):
                             and not self._fire_on_token(rep, req, tok):
                         continue    # evicted by its own callback
                 self._check_finish(rep, i)
-        dt_ms = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        dt_ms = (t1 - t0) * 1e3
         with self._lock:
             self._steps += 1
             self._tokens_out += new_tokens
             self._step_ms.append(dt_ms)
+        if self._tl is not None:
+            self._tl.complete("decode.step", "decode",
+                              "decode:%s" % rep.label, t0, t1,
+                              args={"live": len(occ),
+                                    "tokens": new_tokens})
         if self._tm is not None:
             self._tm.steps.inc()
             if new_tokens:
@@ -3216,6 +3257,14 @@ class DecodeEngine(object):
             if reason == "deadline":
                 self._evictions += 1
             self._lat_ms.append((now - req.t_enqueue) * 1e3)
+        if self._tl is not None:
+            self._tl.instant(
+                "decode.evict" if reason == "deadline"
+                else "decode.leave", "decode",
+                "decode:%s" % rep.label,
+                args={"slot": slot, "reason": reason,
+                      "request": req.sse_id,
+                      "tokens": len(req.tokens)})
         if self._tm is not None:
             self._tm.leave(reason)
             if reason == "deadline":
@@ -3235,11 +3284,16 @@ class DecodeEngine(object):
             def build(tc, _req=req, _t_join=t_join, _t1=t1,
                       _reason=reason):
                 tc.add("queue-wait", tc.root.t0, _t_join, "serve")
-                tc.add("decode", _t_join, _t1, "serve",
-                       meta={"steps": _req.n_steps,
-                             "tokens": len(_req.tokens),
-                             "prompt_len": len(_req.prompt),
-                             "finish_reason": _reason})
+                meta = {"steps": _req.n_steps,
+                        "tokens": len(_req.tokens),
+                        "prompt_len": len(_req.prompt),
+                        "finish_reason": _reason}
+                if _req.sse_id is not None:
+                    # the request id joins the retained trace to its
+                    # SSE stream and timeline token instants — the
+                    # request_autopsy lookup key
+                    meta["request"] = _req.sse_id
+                tc.add("decode", _t_join, _t1, "serve", meta=meta)
             req.trace.finish(t1, build=build)
 
     # ------------------------------------------------------------ observe

@@ -669,6 +669,11 @@ class ServingEngine(object):
         # branch below gates on that, keeping the disabled hot path at
         # zero registry calls per request
         self._tm = _EngineTelemetry(self) if _telemetry.enabled() else None
+        # unified fleet timeline (telemetry/timeline.py): cached ring
+        # reference, None when the plane is off — the disabled path
+        # appends nothing and serves bitwise-identically
+        self._tl = (_telemetry.timeline.get()
+                    if _telemetry.timeline.enabled() else None)
         # serving efficiency plane (telemetry/goodput.py): the FLOPs
         # ledger + MFU/goodput gauges + tenant accounting.  None unless
         # telemetry AND MXNET_SERVE_EFFICIENCY are on — the disabled
@@ -1172,6 +1177,9 @@ class ServingEngine(object):
             # section refcount — reclaimed with the bundle
             self._eff.close()
             self._eff = None
+        # the timeline ring is process-wide (no per-engine state to
+        # reclaim); drop the reference so a closed engine cannot feed
+        self._tl = None
         if self._tm is not None:
             self._tm.close()
         if self._obs_name is not None:
@@ -1568,6 +1576,10 @@ class ServingEngine(object):
                    exc, sum(1 for x in self._replicas if x.healthy)))
             if r.tm_failures is not None:
                 r.tm_failures.inc()
+            if self._tl is not None:
+                self._tl.instant("serve.replica_failed", "serve",
+                                 "replica:%d" % r.index,
+                                 args={"error": repr(exc)})
             fr = _telemetry.recorder.flight_recorder()
             if fr is not None:
                 fr.dump("replica_failed:%s:%s"
@@ -1817,6 +1829,16 @@ class ServingEngine(object):
             if padded_elems:
                 tm.pad_waste.labels(bucket=bucket).observe(
                     1.0 - live_elems / float(padded_elems))
+        tl = self._tl
+        if tl is not None:
+            lane = "replica:%d" % rep.index
+            tl.complete("serve.dispatch", "serve", lane, t_disp0,
+                        t_disp1, args={"bucket": b, "live": n,
+                                       "compiled": compiled})
+            tl.counter("serve.batch_occupancy", "serve", lane,
+                       n / float(b))
+            tl.counter("serve.queue_depth", "serve", "serve",
+                       len(self._adm))
         eff = self._eff
         if eff is not None:
             # FLOPs ledger: the program was priced once at plan build

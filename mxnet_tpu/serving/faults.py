@@ -314,6 +314,11 @@ def _tm_count(site, action):
                 "production means a chaos plan is live",
                 labelnames=("site", "action")).labels(
                     site=site, action=action).inc()
+        # fleet-timeline instant: the chaos schedule becomes visible
+        # in the exported trace exactly where it perturbed serving
+        telemetry.timeline.instant(
+            "fault:" + site, "faults", "faults",
+            args={"site": site, "action": action})
     except Exception:
         pass
 

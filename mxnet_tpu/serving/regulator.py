@@ -203,6 +203,15 @@ class Regulator(object):
                 self._tm[2].labels(
                     engine=self.engine_label,
                     direction=action).inc()
+        if action != "hold":
+            from ..telemetry import timeline as _timeline
+            _timeline.instant("regulator." + action, "regulator",
+                              "regulator",
+                              args={"engine": self.engine_label,
+                                    "limit": limit})
+            _timeline.counter("regulator.limit", "regulator",
+                              "regulator", limit,
+                              args={"engine": self.engine_label})
         self.last_decision = {
             "t": now, "action": action, "firing": firing,
             "rule_states": states, "limit": limit,

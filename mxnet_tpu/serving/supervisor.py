@@ -281,8 +281,16 @@ class Supervisor(object):
                 "attempts": rec.attempts, "reason": rec.last_reason})
         except Exception:
             pass
+        _telemetry.timeline.instant(
+            "supervisor.retired", "supervisor", "supervisor",
+            args={"engine": name, "replica": idx,
+                  "attempts": rec.attempts,
+                  "reason": rec.last_reason})
 
     def _count_rehab(self, tm_label, outcome):
+        _telemetry.timeline.instant(
+            "supervisor.rehab", "supervisor", "supervisor",
+            args={"engine": tm_label, "outcome": outcome})
         if tm_label is None or not _telemetry.enabled():
             return
         rehabs, _w, _r = _supervisor_metric_families(

@@ -71,6 +71,12 @@ from .step import (StepTimer, PHASES, STEP_SECONDS_BUCKETS,
 # its enabled() composes the master switch with MXNET_SERVE_EFFICIENCY
 # and would shadow this package's enabled() if flattened
 from . import goodput
+# unified fleet timeline (timeline.py): same submodule treatment —
+# its enabled() composes the master switch with
+# MXNET_TELEMETRY_TIMELINE, and its ring must stay importable by the
+# lock sanitizer without pulling the whole package surface
+from . import timeline
+from .timeline import export_chrome_trace
 
 __all__ = [
     "Registry", "Counter", "Gauge", "Histogram", "Family",
@@ -93,7 +99,7 @@ __all__ = [
     "AlertRule", "AlertManager", "default_manager",
     "register_engine_default_rules", "load_rules_file",
     "StepTimer", "PHASES", "STEP_SECONDS_BUCKETS", "PEAKS_TFLOPS",
-    "peak_flops_for", "goodput",
+    "peak_flops_for", "goodput", "timeline", "export_chrome_trace",
     "enabled", "set_enabled", "registry", "counter", "gauge",
     "histogram", "bound", "remove_labeled_series", "reset",
     "dump_state", "trace_sample_every",

@@ -436,6 +436,10 @@ class AlertManager(object):
             "(pending / firing / resolved / cancelled)",
             labelnames=("rule", "state")).labels(
                 rule=st.rule.name, state=to).inc()
+        from . import timeline
+        timeline.instant("alert." + to, "alerts", "alerts",
+                         args={"rule": st.rule.name, "from": prev,
+                               "value": st.value})
         try:
             from .server import publish_event
             publish_event("alert", {

@@ -134,6 +134,13 @@ def render_json(registry=None, include_traces=True, meta=None):
     if include_traces:
         from . import tracing
         doc["traces"] = tracing.all_traces()
+    from . import timeline
+    if timeline.enabled():
+        # the fleet-event window rides every JSON snapshot: rank
+        # documents under MXNET_TELEMETRY_SHARED_DIR therefore carry
+        # the events `telemetry_dump aggregate/timeline` wall-aligns
+        # across ranks on the scrape stamps above
+        doc["timeline"] = timeline.get().snapshot(limit=8192)
     if meta:
         doc.update(meta)
     return json.dumps(_finite(doc), indent=1, sort_keys=True,

@@ -687,12 +687,16 @@ class FlightRecorder(object):
             if t_last is not None and now - t_last < self.min_interval_s:
                 return None
             self._last[reason] = now
-        from . import registry, tracing
+        from . import registry, timeline, tracing
         from .export import _finite
         if recorder is None:
             recorder = get_recorder()
         if alerts is None and recorder is not None:
             alerts = recorder.alerts
+        # the dump itself is a timeline moment (and the bundle embeds
+        # the window below): post-mortems can see every dump in context
+        timeline.instant("flight.dump", "alerts", "alerts",
+                         args={"reason": str(reason)})
         bundle = {
             "format": "mxnet_tpu.telemetry/flight-1",
             "reason": reason,
@@ -708,6 +712,8 @@ class FlightRecorder(object):
                         if recorder is not None else None),
             "metrics": registry().collect(),
             "traces": tracing.all_traces(),
+            "timeline": (timeline.get().snapshot(window_s, limit=4096)
+                         if timeline.enabled() else None),
             "thread_stacks": self.thread_stacks(),
         }
         os.makedirs(self.directory, exist_ok=True)

@@ -115,7 +115,15 @@ class _EventHub(object):
 
     def publish(self, event, data):
         """Enqueue one event to every subscriber and the replay ring.
-        ``data`` must be JSON-able; returns the event id."""
+        ``data`` must be JSON-able; returns the event id.
+
+        Every frame carries a wall-clock ``ts`` stamped at publish —
+        additive (a publisher's own ``ts`` wins), and stamped BEFORE
+        the replay ring so Last-Event-ID replays deliver the original
+        publish time, not the replay time: consumers can order frames
+        across ranks whose connections opened at different moments."""
+        if isinstance(data, dict) and "ts" not in data:
+            data = dict(data, ts=time.time())
         payload = json.dumps(data, sort_keys=True, default=str)
         with self._lock:
             self._seq += 1
@@ -263,6 +271,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/history":
             code, doc = _history_doc(query)
             self._send_json(code, doc)
+        elif path == "/timeline":
+            code, doc = _timeline_doc(query)
+            self._send_json(code, doc)
         elif path in ("/", "/healthz"):
             self._send_json(200, _healthz(self.server.telemetry_server))
         else:
@@ -270,7 +281,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "error": "unknown route %r" % path,
                 "routes": ["/metrics", "/metrics.json", "/traces",
                            "/traces/<id>", "/alerts", "/history",
-                           "/events", "/healthz"]})
+                           "/timeline", "/events", "/healthz"]})
 
     # ---------------------------------------------------------------- SSE
     def _serve_events(self, query):
@@ -361,6 +372,34 @@ def _alerts_doc():
                             if mgr.last_eval is not None else None),
         "scrape_ts": time.time(),
     }
+
+
+def _timeline_doc(query):
+    """(status, doc) for one ``GET /timeline`` query: the fleet-event
+    window (``?window=`` trailing seconds, whole ring by default),
+    either as the self-contained timeline document or — with
+    ``?format=chrome`` — pre-rendered as Chrome ``trace_event`` JSON
+    an operator can drop straight into Perfetto."""
+    from . import timeline
+    if not timeline.enabled():
+        return 503, {"error": "timeline plane disabled (set "
+                              "MXNET_TELEMETRY_TIMELINE=1 and "
+                              "MXNET_TELEMETRY_ON=1)"}
+    window_s = None
+    if query.get("window") is not None:
+        try:
+            window_s = float(query["window"])
+        except (TypeError, ValueError):
+            return 400, {"error": "bad window=%r (want seconds)"
+                                  % query.get("window")}
+    doc = timeline.get().snapshot(window_s)
+    doc["scrape_ts"] = time.time()
+    doc["scrape_monotonic"] = time.monotonic()
+    if query.get("format") == "chrome":
+        rank = query.get("rank")
+        return 200, timeline.export_chrome_trace(
+            doc["events"], rank=int(rank) if rank is not None else None)
+    return 200, doc
 
 
 def _history_doc(query):

@@ -212,6 +212,7 @@ class TraceContext(object):
             while len(_STORE) > cap:
                 _STORE.popitem(last=False)
         self._bridge_to_profiler()
+        self._feed_timeline()
         # push the keep to live /events subscribers (SSE): only the
         # retained minority reaches this line, so the dropped-path
         # cost stays zero; a hub failure must never fail a request
@@ -227,8 +228,13 @@ class TraceContext(object):
             pass
 
     def to_dict(self):
-        return {"trace_id": self.trace_id,
-                "root": self.root.to_dict(self.root.t0)}
+        from . import timeline
+        root = self.root.to_dict(self.root.t0)
+        # wall anchor of the root: every span's start_ms offsets from
+        # here, which is how request_autopsy joins the tree against
+        # wall-stamped timeline events
+        root["t0_wall"] = timeline.wall_of_perf(self.root.t0)
+        return {"trace_id": self.trace_id, "root": root}
 
     def _bridge_to_profiler(self):
         from .. import profiler
@@ -240,6 +246,23 @@ class TraceContext(object):
             profiler.add_span_event(sp.name, sp.cat, sp.t0,
                                     sp.t1 if sp.t1 is not None else sp.t0,
                                     args=args)
+            for c in sp.children:
+                walk(c)
+        walk(self.root)
+
+    def _feed_timeline(self):
+        """Mirror the retained tree into the fleet timeline — only the
+        kept minority pays, and a dropped trace appends nothing."""
+        from . import timeline
+        if not timeline.enabled():
+            return
+        tl = timeline.get()
+        args = {"trace": self.trace_id}
+
+        def walk(sp):
+            tl.complete(sp.name, sp.cat, "trace", sp.t0,
+                        sp.t1 if sp.t1 is not None else sp.t0,
+                        args=args)
             for c in sp.children:
                 walk(c)
         walk(self.root)

@@ -738,9 +738,12 @@ def run_telemetry_overhead(requests=64, slots=8, max_len=128,
     measurement resolution (``noise_floor``).  The gate only fails
     when the measured regression exceeds ``tol`` PLUS that floor.
     With ``http`` the FULL plane runs: live endpoint + a background
-    scraper hammering ``GET /metrics`` across BOTH modes' rounds (so
-    its GIL share cancels in the A/B) — the marginal cost measured is
-    the telemetry plane's own.
+    scraper hammering ``GET /metrics`` AND ``GET /timeline`` across
+    BOTH modes' rounds (so its GIL share cancels in the A/B) — the
+    marginal cost measured is the telemetry plane's own, now including
+    the fleet-event ring the ON engine feeds per step/token and the
+    timeline snapshot+render the scrape pays.  Record the row with
+    ``--record BENCH_timeline.json``.
     """
     import statistics
     import threading
@@ -765,10 +768,15 @@ def run_telemetry_overhead(requests=64, slots=8, max_len=128,
 
     eng_off = make_engine(False)
     eng_on = make_engine(True)
+    # master switch pinned ON for the round phase so /timeline serves
+    # (both engines bound their instrument handles at construction, so
+    # the pin changes neither hot path); restored in the finally below
+    telemetry.set_enabled(True)
 
     server = scraper = None
     stop_scrape = threading.Event()
     scrapes = [0, 0.0]
+    tl_scrapes = [0, 0.0]
     if http:
         import http.client
         server = telemetry.start_server(0, host="127.0.0.1")
@@ -784,6 +792,15 @@ def run_telemetry_overhead(requests=64, slots=8, max_len=128,
                     assert body.startswith(b"#"), "unparseable scrape"
                     scrapes[0] += 1
                     scrapes[1] += time.perf_counter() - t0
+                    # timeline plane end-to-end: snapshot + render of
+                    # the per-step/per-token events the ON engine feeds
+                    t0 = time.perf_counter()
+                    conn.request("GET", "/timeline?window=5")
+                    tl = json.loads(conn.getresponse().read())
+                    assert tl.get("format") == \
+                        "mxnet_tpu.telemetry/timeline-1", tl
+                    tl_scrapes[0] += 1
+                    tl_scrapes[1] += time.perf_counter() - t0
                 except Exception:
                     conn.close()
                     if stop_scrape.is_set():
@@ -796,6 +813,7 @@ def run_telemetry_overhead(requests=64, slots=8, max_len=128,
     off_tps = on_tps = 0.0
     centered, nulls = [], []
     adv = {}
+    tl_appended = 0
     try:
         for _ in range(max(1, repeats)):
             ta, dt_a = continuous_round(eng_off, jobs)
@@ -809,7 +827,10 @@ def run_telemetry_overhead(requests=64, slots=8, max_len=128,
             nulls.append(abs(1.0 - (ta / dt_a) / (tb / dt_b)))
         adv = _efficiency_advisory(eng_on, on_tps,
                                    eng_on.stats()["decode"])
+        tl_ring = telemetry.timeline.peek()
+        tl_appended = tl_ring.appended() if tl_ring is not None else 0
     finally:
+        telemetry.set_enabled(None)
         stop_scrape.set()
         if scraper is not None:
             scraper.join(timeout=10)
@@ -833,6 +854,11 @@ def run_telemetry_overhead(requests=64, slots=8, max_len=128,
         "metrics_scrapes": scrapes[0],
         "mean_scrape_ms": (round(scrapes[1] / scrapes[0] * 1e3, 3)
                            if scrapes[0] else None),
+        "timeline_scrapes": tl_scrapes[0],
+        "mean_timeline_scrape_ms": (
+            round(tl_scrapes[1] / tl_scrapes[0] * 1e3, 3)
+            if tl_scrapes[0] else None),
+        "timeline_events": tl_appended,
         "ok": regression < tol + noise_floor,
     })
 
@@ -1160,10 +1186,9 @@ def main(argv=None):
             http=not args.no_http)
         print(json.dumps(row))
         if args.record:
-            with open(args.record, "w") as f:
-                json.dump({"decode_telemetry_overhead": row}, f,
-                          indent=1, sort_keys=True)
-                f.write("\n")
+            # section-merge so serve and decode gates can share one
+            # BENCH_timeline.json (same discipline as BENCH_replica)
+            _merge_record(args.record, "decode_telemetry_overhead", row)
         if not row["ok"]:
             print("FAIL: telemetry costs %.2f%% tokens/s "
                   "(tol %.2f%% + measured noise floor %.2f%%)"

@@ -216,6 +216,13 @@ def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
     INTERLEAVED (off, on, off, on, ...) and best-of-``repeats`` per
     mode so shared-machine drift hits both paths alike.  ``http=False``
     drops the server+scraper for the registry-only measurement.
+
+    Since the timeline plane landed, the ON engine also feeds the
+    fleet-event ring per dispatch and the scraper alternates
+    ``GET /metrics`` with ``GET /timeline?window=5`` — the gate covers
+    the timeline plane end-to-end (ring appends + snapshot + JSON
+    render) under the same A/A noise-floor protocol; record the row
+    with ``--record BENCH_timeline.json``.
     """
     from mxnet_tpu import serving, telemetry
 
@@ -237,10 +244,16 @@ def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
 
     eng_off = make_engine(False)
     eng_on = make_engine(True)
+    # master switch pinned ON for the round phase so /timeline serves
+    # (the route gates on live state; both engines already bound their
+    # instrument handles at construction, so the pin changes neither
+    # hot path) — restored to env-var control in the finally below
+    telemetry.set_enabled(True)
 
     # live endpoint + scraper: a background thread hammers GET /metrics
-    # over ONE keep-alive connection at 10 Hz throughout BOTH modes'
-    # rounds and requires every response to parse.  Running it across
+    # AND GET /timeline over ONE keep-alive connection at 10 Hz
+    # throughout BOTH modes' rounds and requires every response to
+    # parse.  Running it across
     # both phases keeps the external load identical, so the A/B
     # isolates the telemetry plane's marginal cost (instrument writes,
     # per-request trace retention, render work) — which is the number
@@ -251,7 +264,8 @@ def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
     # reported alongside so scrape cost stays visible, not hidden.
     server = scraper = None
     stop_scrape = threading.Event()
-    scrapes = [0, 0.0]                     # count, total seconds
+    scrapes = [0, 0.0]                     # /metrics count, total secs
+    tl_scrapes = [0, 0.0]                  # /timeline count, total secs
     if http:
         import http.client
         server = telemetry.start_server(0, host="127.0.0.1")
@@ -267,6 +281,16 @@ def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
                     assert body.startswith(b"#"), "unparseable scrape"
                     scrapes[0] += 1
                     scrapes[1] += time.perf_counter() - t0
+                    # timeline plane end-to-end: ring snapshot + JSON
+                    # render, bounded window so the payload tracks
+                    # recent activity rather than ring capacity
+                    t0 = time.perf_counter()
+                    conn.request("GET", "/timeline?window=5")
+                    tl = json.loads(conn.getresponse().read())
+                    assert tl.get("format") == \
+                        "mxnet_tpu.telemetry/timeline-1", tl
+                    tl_scrapes[0] += 1
+                    tl_scrapes[1] += time.perf_counter() - t0
                 except Exception:
                     conn.close()
                     if stop_scrape.is_set():
@@ -294,6 +318,7 @@ def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
     off_s = on_s = float("inf")
     centered, nulls = [], []
     on_stats = None
+    tl_appended = 0
     try:
         for _ in range(repeats):
             off_a = closed_loop_round(eng_off, X, requests, offered_batch)
@@ -304,7 +329,10 @@ def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
             centered.append((off_a + off_b) / 2.0 / on_i)
             nulls.append(abs(1.0 - off_a / off_b))
         on_stats = eng_on.stats()
+        tl_ring = telemetry.timeline.peek()
+        tl_appended = tl_ring.appended() if tl_ring is not None else 0
     finally:
+        telemetry.set_enabled(None)
         stop_scrape.set()
         if scraper is not None:
             scraper.join(timeout=10)
@@ -327,6 +355,11 @@ def run_telemetry_overhead(requests=512, offered_batch=8, feature=512,
         "metrics_scrapes": scrapes[0],
         "mean_scrape_ms": (round(scrapes[1] / scrapes[0] * 1e3, 3)
                            if scrapes[0] else None),
+        "timeline_scrapes": tl_scrapes[0],
+        "mean_timeline_scrape_ms": (
+            round(tl_scrapes[1] / tl_scrapes[0] * 1e3, 3)
+            if tl_scrapes[0] else None),
+        "timeline_events": tl_appended,
         "ok": regression < tol + noise_floor,
     })
 
@@ -715,10 +748,9 @@ def main():
             tol=args.telemetry_tol, http=not args.no_http)
         print(json.dumps(row))
         if args.record:
-            with open(args.record, "w") as f:
-                json.dump({"telemetry_overhead": row}, f, indent=1,
-                          sort_keys=True)
-                f.write("\n")
+            # section-merge so serve and decode gates can share one
+            # BENCH_timeline.json (same discipline as BENCH_replica)
+            _merge_record(args.record, "telemetry_overhead", row)
         if not row["ok"]:
             print("FAIL: telemetry costs %.2f%% throughput "
                   "(tol %.2f%% + measured noise floor %.2f%%)"

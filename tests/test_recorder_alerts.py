@@ -683,8 +683,13 @@ def test_sse_pushes_alert_transitions_and_keepalives():
     assert buf.startswith(b"retry: 3000\n\n")      # reconnect delay
     assert b": keep-alive\n\n" in buf              # idle-proxy defense
     events = _parse_sse(buf)
-    assert ("alert", {"rule": "x", "from": "pending", "to": "firing"}) \
-        in [(e, d) for _, e, d in events]
+    alerts = [d for _, e, d in events if e == "alert"
+              and d.get("rule") == "x"]
+    assert alerts
+    # every frame carries a wall-clock publish stamp (additive ts
+    # field — the timeline plane's cross-source alignment key)
+    assert isinstance(alerts[0].pop("ts"), float)
+    assert alerts[0] == {"rule": "x", "from": "pending", "to": "firing"}
 
 
 def test_sse_last_event_id_replay_and_reset():
@@ -817,6 +822,12 @@ def test_reload_loop_reclaims_rules_heartbeats_recorder(monkeypatch):
         assert telemetry.heartbeats() == {}
         assert not [t for t in threading.enumerate()
                     if t.name == "mxnet-telemetry-recorder"]
+        # timeline plane (ISSUE 20): close() drops the engine's ring
+        # reference, and the process-wide ring stays bounded — reload
+        # loops must not grow timeline state any more than rule state
+        assert eng._tl is None
+        tl = telemetry.timeline.peek()
+        assert tl is None or len(tl.events()) <= tl.capacity
     # co-resident engines: shared burn rules refcount, last close wins
     e1 = _engine(net, params)
     e2 = _engine(net, params)

@@ -415,6 +415,12 @@ def test_reload_loop_leak_gate_with_replicas(_fresh_telemetry):
         de.generate([1], max_new_tokens=2, timeout=120)
         se.close()
         de.close()
+        # timeline plane (ISSUE 20): both engines drop their ring
+        # reference at close(); the bounded ring itself is process-
+        # wide and must never exceed its capacity across reloads
+        assert se._tl is None and de._tl is None
+        tl = telemetry.timeline.peek()
+        assert tl is None or len(tl.events()) <= tl.capacity
     # every per-engine AND per-replica series reclaimed
     for fam_name in ("mxnet_serve_replica_healthy",
                      "mxnet_serve_replica_inflight",

@@ -1,0 +1,370 @@
+"""Per-request waterfall autopsy: where did THIS request's time go,
+and what was the fleet doing while it went there.
+
+Given a request id (the ``request_id`` passed to
+``DecodeEngine.submit``) or a trace id (16-hex, prefixes accepted) and
+a telemetry document — a ``telemetry.dump_state()`` / rank snapshot /
+flight bundle file, or a live ``http://host:port`` endpoint — this
+renders the request's full wall-aligned waterfall (admission ->
+queue-wait -> route -> seat wait -> prefill -> decode steps ->
+per-token gaps), computes each stage's SELF time (duration minus
+instrumented children), names the **dominant interval**, and then
+cross-references the fleet timeline (``mxnet_tpu/telemetry/timeline``)
+for every event that overlapped it: injected faults, replica failures,
+alert transitions, lock-hold stalls, regulator pressure, supervisor
+actions.  The verdict line names the most damning overlapping event as
+the dominant cause — "slow because dispatch sat under an injected AOT
+fault" instead of "dispatch was slow"::
+
+  python tools/request_autopsy.py 7 telemetry.json
+  python tools/request_autopsy.py 1c96ce8a telemetry.json
+  python tools/request_autopsy.py 7 --url http://host:9100
+  python tools/request_autopsy.py 7 telemetry.json --json
+
+Requests are joined to traces via the ``request`` key the decode
+engine stamps into the retained trace's ``decode`` span meta, and to
+timeline token instants via ``args.request`` — both require tracing
+retention and the timeline plane (``MXNET_TELEMETRY_TIMELINE``) to
+have been on when the request ran.  Wall alignment uses the
+``t0_wall`` anchor every stored trace carries.
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_dump_tool():
+    """Share telemetry_dump.py's loaders (files, URLs, bundle/timeline
+    section discovery) instead of growing a second copy of each."""
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_dump", os.path.join(_HERE, "telemetry_dump.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_td = _load_dump_tool()
+
+
+# ---------------------------------------------------------------------------
+# trace lookup: trace id, trace-id prefix, or request id via span meta
+# ---------------------------------------------------------------------------
+
+def _traces_of(doc):
+    tr = doc.get("traces")
+    if isinstance(tr, dict):
+        return tr
+    # flight bundles / load_doc-normalized wrappers
+    inner = doc.get("metrics")
+    if isinstance(inner, dict) and isinstance(inner.get("traces"), dict):
+        return inner["traces"]
+    return {}
+
+
+def _span_requests(tree):
+    """Every ``request`` id stamped into this trace's span meta."""
+    out = set()
+
+    def walk(sp):
+        meta = sp.get("meta")
+        if isinstance(meta, dict) and meta.get("request") is not None:
+            out.add(str(meta["request"]))
+        for c in sp.get("children", ()):
+            walk(c)
+
+    walk(tree.get("root", {}))
+    return out
+
+
+def find_trace(doc, ident):
+    """Resolve ``ident`` to (trace_id, tree, request_id-or-None).
+
+    Precedence: exact trace id, unique trace-id prefix, then request
+    id matched against the ``request`` meta the decode engine stamps.
+    Ambiguity (a prefix matching two traces) is an error, not a
+    guess."""
+    traces = _traces_of(doc)
+    ident = str(ident)
+    if ident in traces:
+        return ident, traces[ident], next(
+            iter(_span_requests(traces[ident])), None)
+    pref = [t for t in traces if t.startswith(ident)]
+    if len(pref) == 1:
+        tid = pref[0]
+        return tid, traces[tid], next(
+            iter(_span_requests(traces[tid])), None)
+    if len(pref) > 1:
+        raise LookupError("trace-id prefix %r is ambiguous: %s"
+                          % (ident, ", ".join(sorted(pref))))
+    by_req = [tid for tid, tree in traces.items()
+              if ident in _span_requests(tree)]
+    if len(by_req) == 1:
+        return by_req[0], traces[by_req[0]], ident
+    if len(by_req) > 1:
+        # resubmitted id: newest trace wins but say so
+        tid = by_req[-1]
+        print("note: request id %r matches %d retained traces, "
+              "using the newest (%s)" % (ident, len(by_req), tid),
+              file=sys.stderr)
+        return tid, traces[tid], ident
+    raise LookupError(
+        "no retained trace matches %r — %d trace(s) in this document "
+        "(tail-biased retention keeps slow/failed requests; fast ones "
+        "are sampled).  Try `telemetry_dump.py traces <doc>`."
+        % (ident, len(traces)))
+
+
+# ---------------------------------------------------------------------------
+# waterfall: flatten the span tree onto the wall clock, self-time it
+# ---------------------------------------------------------------------------
+
+def flatten_spans(tree):
+    """Depth-first span rows with absolute wall intervals and SELF
+    time (duration minus instrumented children) — the quantity the
+    dominant-interval verdict ranks on, so a parent span never
+    outranks the child that actually burned its time."""
+    root = tree.get("root", {})
+    t0_wall = root.get("t0_wall")
+    rows = []
+
+    def walk(sp, depth):
+        start = sp.get("start_ms") or 0.0
+        dur = sp.get("dur_ms")
+        kids = sp.get("children", ())
+        child_ms = sum(c["dur_ms"] for c in kids
+                       if c.get("dur_ms") is not None)
+        self_ms = max(0.0, dur - child_ms) if dur is not None else None
+        row = {"name": sp.get("name"), "cat": sp.get("cat"),
+               "depth": depth, "start_ms": start, "dur_ms": dur,
+               "self_ms": self_ms, "meta": sp.get("meta")}
+        if t0_wall is not None:
+            row["wall0"] = t0_wall + start / 1e3
+            row["wall1"] = (row["wall0"] + dur / 1e3
+                            if dur is not None else row["wall0"])
+        rows.append(row)
+        for c in kids:
+            walk(c, depth + 1)
+
+    walk(root, 0)
+    return rows
+
+
+def token_gaps(events, request_id):
+    """Inter-token wall gaps for one request from the timeline's
+    ``decode.token`` instants: [(gap_s, wall_of_later_token, index)]
+    sorted chronologically.  Empty when the request streamed no tokens
+    (no SSE request id) or the ring already evicted them."""
+    if request_id is None:
+        return []
+    toks = sorted(
+        ((e.get("wall"), (e.get("args") or {}).get("index"))
+         for e in events
+         if e.get("name") == "decode.token"
+         and (e.get("args") or {}).get("request") == str(request_id)
+         and e.get("wall") is not None),
+        key=lambda t: t[0])
+    return [(t1 - t0, t1, i1)
+            for (t0, _), (t1, i1) in zip(toks, toks[1:])]
+
+
+def overlapping_events(events, wall0, wall1, exclude_trace=None):
+    """Every fleet-timeline event whose interval intersects
+    [wall0, wall1].  The trace's own mirrored spans (``args.trace`` ==
+    ``exclude_trace``) are excluded — a request is never its own
+    concurrent cause."""
+    out = []
+    for e in events:
+        if exclude_trace is not None \
+                and (e.get("args") or {}).get("trace") == exclude_trace:
+            continue
+        w = e.get("wall")
+        if w is None:
+            continue
+        dur = e.get("dur") if e.get("ph") == "X" else None
+        e0, e1 = w, w + (dur or 0.0)
+        if e0 <= wall1 and e1 >= wall0:
+            out.append(e)
+    out.sort(key=lambda e: (e.get("wall") or 0, e.get("seq") or 0))
+    return out
+
+
+# the verdict ladder: when several planes overlapped the dominant
+# interval, the most causally-damning one names the verdict
+_CAUSE_RANK = (
+    ("fault:", "injected fault"),
+    (".replica_failed", "replica failure"),
+    ("supervisor.", "supervisor action"),
+    ("alert.", "alert transition"),
+    ("lock:", "lock contention"),
+    ("regulator.", "regulator pressure"),
+)
+
+
+def dominant_cause(span, overlaps):
+    """(verdict_line, culprit_event-or-None) for the dominant span."""
+    for needle, label in _CAUSE_RANK:
+        for e in overlaps:
+            name = e.get("name") or ""
+            hit = name.endswith(needle) if needle.startswith(".") \
+                else name.startswith(needle)
+            if hit:
+                return ("%s '%s' overlapped '%s' — the dominant "
+                        "interval ran under it"
+                        % (label, name, span["name"]), e)
+    if overlaps:
+        return ("no fault/alert/lock/regulator event overlapped; %d "
+                "concurrent fleet event(s) listed above are "
+                "circumstantial" % len(overlaps), None)
+    return ("no concurrent fleet events — the time is intrinsic to "
+            "'%s'" % span["name"], None)
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def _bar(start_ms, dur_ms, total_ms, width=28):
+    if not total_ms or dur_ms is None:
+        return ""
+    lo = int(round(width * max(0.0, start_ms) / total_ms))
+    n = max(1, int(round(width * dur_ms / total_ms)))
+    lo = min(lo, width - 1)
+    n = min(n, width - lo)
+    return "[%s%s%s]" % (" " * lo, "#" * n, " " * (width - lo - n))
+
+
+def autopsy(doc, ident, last_gaps=3):
+    """Build the full autopsy record (JSON-able dict)."""
+    tid, tree, request_id = find_trace(doc, ident)
+    rows = flatten_spans(tree)
+    root = rows[0]
+    tl = _td.timeline_events(doc)
+    events = (tl or {}).get("events") or []
+
+    gaps = token_gaps(events, request_id)
+    dom = max((r for r in rows if r.get("self_ms") is not None),
+              key=lambda r: r["self_ms"], default=None)
+    # a single inter-token stall can dwarf every span's self time —
+    # token gaps compete for dominance on equal footing
+    max_gap = max(gaps, key=lambda g: g[0]) if gaps else None
+    if max_gap is not None and dom is not None \
+            and max_gap[0] * 1e3 > (dom["self_ms"] or 0.0):
+        dom = {"name": "inter-token gap (token %s)" % max_gap[2],
+               "depth": 1, "start_ms": None, "dur_ms": max_gap[0] * 1e3,
+               "self_ms": max_gap[0] * 1e3,
+               "wall0": max_gap[1] - max_gap[0], "wall1": max_gap[1],
+               "meta": None}
+
+    overlaps, verdict, culprit = [], None, None
+    if dom is not None and dom.get("wall0") is not None:
+        overlaps = overlapping_events(events, dom["wall0"],
+                                      dom["wall1"], exclude_trace=tid)
+        verdict, culprit = dominant_cause(dom, overlaps)
+    elif dom is not None:
+        verdict = ("trace carries no wall anchor (pre-timeline "
+                   "document) — concurrent-event analysis unavailable")
+    return {"trace_id": tid, "request_id": request_id,
+            "retained_by": tree.get("retained_by"),
+            "root": {"name": root["name"], "dur_ms": root["dur_ms"],
+                     "t0_wall": root.get("wall0")},
+            "spans": rows, "token_gaps_s": [g[0] for g in gaps],
+            "dominant": dom, "concurrent_events": overlaps,
+            "verdict": verdict,
+            "culprit": culprit}
+
+
+def render(rec, last_gaps=3):
+    lines = []
+    head = "request autopsy — trace %s" % rec["trace_id"]
+    if rec["request_id"] is not None:
+        head += "  (request id %s)" % rec["request_id"]
+    lines.append(head)
+    root = rec["root"]
+    total = root.get("dur_ms")
+    sub = "  %s: %s" % (root["name"],
+                        "%.3f ms total" % total if total is not None
+                        else "(open)")
+    if root.get("t0_wall"):
+        sub += "  started %s" % time.strftime(
+            "%Y-%m-%dT%H:%M:%S", time.localtime(root["t0_wall"]))
+    if rec.get("retained_by"):
+        sub += "  retained by %s" % rec["retained_by"]
+    lines.append(sub)
+    lines.append("")
+    lines.append("waterfall (self = time not inside an instrumented "
+                 "child):")
+    for r in rec["spans"]:
+        dur = ("%9.3f ms" % r["dur_ms"]) if r["dur_ms"] is not None \
+            else "  (open)  "
+        self_ms = (" self %8.3f ms" % r["self_ms"]) \
+            if r["self_ms"] is not None else ""
+        mark = " <-- dominant" if rec["dominant"] is r else ""
+        lines.append("  %-26s %s%s %s%s" % (
+            "  " * r["depth"] + (r["name"] or "?"), dur, self_ms,
+            _bar(r["start_ms"] or 0.0, r["dur_ms"], total), mark))
+    gaps = rec["token_gaps_s"]
+    if gaps:
+        lines.append("  tokens: %d gap(s), mean %.3f ms, max %.3f ms"
+                     % (len(gaps), sum(gaps) / len(gaps) * 1e3,
+                        max(gaps) * 1e3))
+    dom = rec["dominant"]
+    lines.append("")
+    if dom is None:
+        lines.append("dominant interval: (no finished spans)")
+        return "\n".join(lines)
+    pct = (" (%d%% of total)" % round(100 * dom["self_ms"] / total)) \
+        if total else ""
+    lines.append("dominant interval: %s — self %.3f ms%s"
+                 % (dom["name"], dom["self_ms"], pct))
+    if rec["concurrent_events"]:
+        lines.append("concurrent fleet events during it:")
+        body = _td.format_timeline(
+            {"events": rec["concurrent_events"], "dropped": 0})
+        lines.extend("  " + ln for ln in body.splitlines()[1:])
+    if rec["verdict"]:
+        lines.append("dominant cause: %s" % rec["verdict"])
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="per-request waterfall autopsy over a telemetry "
+                    "document's trace store + fleet timeline")
+    ap.add_argument("ident",
+                    help="request id (DecodeEngine.submit request_id) "
+                         "or trace id / unique prefix")
+    ap.add_argument("doc", nargs="?",
+                    help="telemetry JSON document (dump_state snapshot,"
+                         " rank snapshot, flight bundle) or http URL")
+    ap.add_argument("--url", help="scrape a live telemetry endpoint "
+                                  "(base http://host:port)")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="emit the machine-readable autopsy record")
+    args = ap.parse_args(argv)
+    src = args.url or args.doc
+    if not src:
+        ap.error("give a telemetry document or --url")
+    doc = _td.load_doc(src)
+    if "text" in doc and len(doc) == 1:
+        print("error: %s is not a JSON telemetry document" % src,
+              file=sys.stderr)
+        return 2
+    try:
+        rec = autopsy(doc, args.ident)
+    except LookupError as e:
+        print("error: %s" % e, file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(json.dumps(rec, indent=1, sort_keys=True))
+    else:
+        print(render(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

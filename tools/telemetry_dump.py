@@ -313,6 +313,89 @@ def format_gauge_spread(spread):
 
 
 # ---------------------------------------------------------------------------
+# fleet timeline
+# ---------------------------------------------------------------------------
+
+def timeline_events(doc):
+    """The timeline event list of ANY carrying document: a bare
+    ``/timeline`` response, a ``/metrics.json`` / rank snapshot, or a
+    flight bundle (all embed the same ``timeline`` section)."""
+    if isinstance(doc.get("events"), list):
+        return doc
+    tl = doc.get("timeline")
+    if isinstance(tl, dict) and isinstance(tl.get("events"), list):
+        return tl
+    # load_doc normalizes unknown JSON under {"metrics": ...}
+    tl = (doc.get("metrics") or {}).get("timeline") \
+        if isinstance(doc.get("metrics"), dict) else None
+    if isinstance(tl, dict) and isinstance(tl.get("events"), list):
+        return tl
+    if isinstance((doc.get("metrics") or {}).get("events"), list):
+        return doc["metrics"]
+    return None
+
+
+def merge_timelines(entries):
+    """Merge [(rank, doc)] timelines into one wall-ordered event list.
+
+    Alignment leans on each event's absolute wall stamp (every rank
+    converts its monotonic measurements through one process-local
+    anchor), so cross-rank ordering is exactly as good as the hosts'
+    wall clocks — the returned ``skew_est_s`` (the spread of the
+    documents' ``scrape_ts`` stamps, an upper bound observable without
+    a common reference clock) says how much to trust sub-second
+    ordering across ranks.  Events gain a ``rank`` key; ``dropped``
+    totals what the bounded rings already evicted."""
+    events, dropped, stamps = [], 0, {}
+    for rank, doc in entries:
+        tl = timeline_events(doc)
+        if tl is None:
+            continue
+        dropped += tl.get("dropped") or 0
+        for ev in tl["events"]:
+            events.append(dict(ev, rank=rank))
+        ts = doc.get("scrape_ts") or tl.get("scrape_ts")
+        if ts is not None:
+            stamps[rank] = ts
+    events.sort(key=lambda e: (e.get("wall") or 0, e.get("seq") or 0))
+    skew = (max(stamps.values()) - min(stamps.values())
+            if len(stamps) >= 2 else None)
+    return {"format": "mxnet_tpu.telemetry/timeline-merged-1",
+            "ranks": [r for r, _ in entries],
+            "skew_est_s": round(skew, 3) if skew is not None else None,
+            "dropped": dropped,
+            "events": events}
+
+
+def format_timeline(tl, last=None):
+    """One line per event, oldest first: wall offset, lane, kind,
+    name, duration, args."""
+    evs = tl.get("events") or []
+    if last:
+        evs = evs[-last:]
+    if not evs:
+        return "(no timeline events in window)"
+    t0 = min(e.get("wall") or 0 for e in evs)
+    lines = ["%d event(s) over %.3fs (dropped %s)%s" % (
+        len(evs), max(e.get("wall") or 0 for e in evs) - t0,
+        tl.get("dropped", 0),
+        "  [skew est %.3fs]" % tl["skew_est_s"]
+        if tl.get("skew_est_s") is not None else "")]
+    for ev in evs:
+        dur = ("%9.3f ms" % (ev["dur"] * 1e3)
+               if ev.get("ph") == "X" and ev.get("dur") is not None
+               else ("value=%s" % _num(ev.get("value"))
+                     if ev.get("ph") == "C" else "  (instant)"))
+        rank = ("r%s " % ev["rank"]) if ev.get("rank") is not None else ""
+        lines.append("  t+%9.3fs %s%-16s %-28s %s%s" % (
+            (ev.get("wall") or 0) - t0, rank,
+            ev.get("lane") or "-", ev.get("name") or "?", dur,
+            "  %s" % json.dumps(ev["args"], sort_keys=True)
+            if ev.get("args") else ""))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # alerts / history / flight bundles
 # ---------------------------------------------------------------------------
 
@@ -653,6 +736,29 @@ def format_ring(records, series=None, last=None):
     return "\n".join(lines)
 
 
+def _expand_sources(files):
+    """Expand each source that names a directory (its rank snapshots)
+    or a glob pattern into concrete files; URLs and plain paths pass
+    through.  Deterministically sorted so rank assignment is stable."""
+    import glob as _glob
+    import os as _os
+    out = []
+    for src in files:
+        if src.startswith("http://") or src.startswith("https://"):
+            out.append(src)
+        elif _os.path.isdir(src):
+            hits = sorted(_glob.glob(
+                _os.path.join(src, "telemetry_rank*.json")))
+            if not hits:
+                hits = sorted(_glob.glob(_os.path.join(src, "*.json")))
+            out.extend(hits)
+        elif any(c in src for c in "*?["):
+            out.extend(sorted(_glob.glob(src)))
+        else:
+            out.append(src)
+    return out
+
+
 def _resolve_source(args, what="snapshot file"):
     src = getattr(args, "url", None) or getattr(args, "file", None)
     if not src:
@@ -685,11 +791,32 @@ def main(argv=None):
         "top", help="K slowest retained traces with their dominant span")
     p_top.add_argument("--k", type=int, default=10)
     _add_source(p_top)
+    p_tl = sub.add_parser(
+        "timeline", help="render the fleet-event timeline (live "
+                         "/timeline endpoint, a snapshot/flight "
+                         "bundle's timeline section, or N rank "
+                         "documents merged wall-aligned)")
+    p_tl.add_argument("files", nargs="*",
+                      help="timeline/snapshot/bundle files (2+ merge "
+                           "cross-rank), or an http:// URL")
+    p_tl.add_argument("--url",
+                      help="scrape a live /timeline endpoint")
+    p_tl.add_argument("--window", type=float,
+                      help="trailing window in seconds (live scrape)")
+    p_tl.add_argument("--last", type=int,
+                      help="only the newest N events")
+    p_tl.add_argument("--chrome", metavar="OUT",
+                      help="write Chrome trace_event JSON here "
+                           "(open in Perfetto); cross-rank merges "
+                           "export one pid per rank")
+    p_tl.add_argument("--json", action="store_true", dest="as_json",
+                      help="print the (merged) timeline document")
     p_agg = sub.add_parser(
         "aggregate",
         help="merge rank-tagged snapshots into one rank-labeled document")
     p_agg.add_argument("files", nargs="+",
-                       help="two or more telemetry_rank<N>.json snapshots")
+                       help="telemetry_rank<N>.json snapshots, or a "
+                            "directory / glob of them")
     p_agg.add_argument("--json", action="store_true", dest="as_json",
                        help="print the merged document instead of text")
     p_agg.add_argument("--out", help="also write the merged document here")
@@ -750,6 +877,81 @@ def main(argv=None):
             print("ring: %s" % e, file=sys.stderr)
             return 2
         print(format_ring(records, series=args.series, last=args.last))
+        return 0
+
+    if args.cmd == "timeline":
+        sources = _expand_sources(args.files)
+        if args.url:
+            sources.append(args.url)
+        if not sources:
+            print("timeline: pass snapshot/bundle file(s) or --url "
+                  "http://host:port", file=sys.stderr)
+            return 2
+        used, entries = set(), []
+        for i, src in enumerate(sources):
+            if src.startswith("http://") or src.startswith("https://"):
+                from urllib.parse import urlparse, urlencode
+                if urlparse(src).path in ("", "/"):
+                    q = {}
+                    if args.window is not None:
+                        q["window"] = args.window
+                    src = (src.rstrip("/") + "/timeline"
+                           + ("?" + urlencode(q) if q else ""))
+            doc = load_doc(src)
+            if "text" in doc:
+                print("timeline needs JSON sources; %r is not"
+                      % src, file=sys.stderr)
+                return 2
+            if timeline_events(doc) is None:
+                print("%r carries no timeline section (plane off, or "
+                      "a pre-timeline snapshot)" % src, file=sys.stderr)
+                return 2
+            entries.append((_doc_rank(doc, src, i, used), doc))
+        if len(entries) == 1:
+            tl = dict(timeline_events(entries[0][1]))
+        else:
+            tl = merge_timelines(entries)
+        if args.chrome:
+            # export_chrome_trace loaded from timeline.py BY FILE PATH:
+            # the reader stays stdlib-only (no package import, no jax)
+            # and works run as a script, where sys.path[0] is tools/
+            import importlib.util
+            import os as _os
+            _tl_path = _os.path.join(_os.path.dirname(_os.path.dirname(
+                _os.path.abspath(__file__))), "mxnet_tpu", "telemetry",
+                "timeline.py")
+            _spec = importlib.util.spec_from_file_location(
+                "_mxnet_tpu_timeline_export", _tl_path)
+            _mod = importlib.util.module_from_spec(_spec)
+            _spec.loader.exec_module(_mod)
+            export_chrome_trace = _mod.export_chrome_trace
+            by_rank, order = {}, []
+            for ev in tl.get("events") or []:
+                r = ev.get("rank")
+                if r not in by_rank:
+                    by_rank[r] = []
+                    order.append(r)
+                by_rank[r].append(ev)
+            merged = {"traceEvents": [], "displayTimeUnit": "ms",
+                      "otherData": {"ranks": [str(r) for r in order],
+                                    "skew_est_s": tl.get("skew_est_s")}}
+            for pid, r in enumerate(order):
+                sub_doc = export_chrome_trace(
+                    by_rank[r], rank=pid,
+                    process_name=("rank %s" % r) if r is not None
+                    else "mxnet_tpu")
+                merged["traceEvents"].extend(sub_doc["traceEvents"])
+            with open(args.chrome, "w") as f:
+                json.dump(merged, f, indent=1)
+            print("wrote %d chrome trace event(s) to %s%s"
+                  % (len(merged["traceEvents"]), args.chrome,
+                     "  (skew est %.3fs)" % tl["skew_est_s"]
+                     if tl.get("skew_est_s") is not None else ""))
+            return 0
+        if args.as_json:
+            print(json.dumps(tl, indent=1, sort_keys=True))
+        else:
+            print(format_timeline(tl, last=args.last))
         return 0
 
     if args.cmd == "alerts":
@@ -827,8 +1029,13 @@ def main(argv=None):
         return 0
 
     if args.cmd == "aggregate":
+        sources = _expand_sources(args.files)
+        if not sources:
+            print("aggregate: %r matched no snapshot files"
+                  % (args.files,), file=sys.stderr)
+            return 2
         used, entries = set(), []
-        for i, src in enumerate(args.files):
+        for i, src in enumerate(sources):
             doc = load_doc(src)
             if "text" in doc:
                 print("aggregate needs JSON snapshots; %r is Prometheus "
@@ -857,6 +1064,14 @@ def main(argv=None):
                       "aggregated values mix different moments"
                       % (skew, lo_r, hi_r, args.max_skew),
                       file=sys.stderr)
+        # cross-rank fleet timeline: events from every rank that
+        # carried one, wall-ordered, tagged with their rank, the skew
+        # estimate carried alongside so sub-second cross-rank ordering
+        # is never over-trusted
+        tl = merge_timelines(entries)
+        if tl["events"]:
+            merged["timeline"] = tl
+            merged["timeline_skew_s"] = tl["skew_est_s"]
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(merged, f, indent=1, sort_keys=True)
